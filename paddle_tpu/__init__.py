@@ -1,0 +1,115 @@
+"""paddle_tpu — a TPU-native deep-learning training framework.
+
+A brand-new framework with the capabilities of PaddlePaddle's training stack
+(reference: JZ-LIANG/Paddle ~2.0-rc), designed idiomatically for TPU on top of
+JAX/XLA/Pallas/pjit:
+
+- modules are pytrees, training steps are pure functions under ``jax.jit``
+  (replaces the reference's ProgramDesc graphs + scope-based executors,
+  reference ``paddle/fluid/framework/executor.cc:180``),
+- distributed strategies are composable function transforms over a named
+  ``jax.sharding.Mesh`` (replaces NCCL ring-id collectives,
+  reference ``paddle/fluid/operators/collective/c_allreduce_op.h:109``),
+- hot kernels are Pallas TPU kernels (replaces hand-written CUDA in
+  ``paddle/fluid/operators/fused/``).
+
+Public API mirrors the reference's 2.0 ``paddle.*`` surface where that
+makes sense for users switching over: ``paddle_tpu.nn``,
+``paddle_tpu.optimizer``, ``paddle_tpu.amp``, ``paddle_tpu.distributed``,
+``paddle_tpu.Model`` (hapi), ``paddle_tpu.io``, ``paddle_tpu.metric``.
+"""
+
+from paddle_tpu.version import __version__
+
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.core.module import (
+    Module,
+    filter_grad,
+    named_parameters,
+    partition_specs,
+    tree_at,
+    trainable_mask,
+)
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.core import tensor as _tensor
+from paddle_tpu.core.tensor import (
+    Tensor,
+    to_tensor,
+    ones,
+    ones_like,
+    zeros,
+    zeros_like,
+    full,
+    full_like,
+    arange,
+    linspace,
+    eye,
+    rand,
+    randn,
+    randint,
+    randperm,
+    normal,
+    uniform,
+    seed,
+    get_default_dtype,
+    set_default_dtype,
+    save,
+    load,
+)
+
+# Submodules (imported lazily-ish; these are cheap, no TPU touch at import).
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu import optimizer  # noqa: E402
+from paddle_tpu import amp  # noqa: E402
+from paddle_tpu import metric  # noqa: E402
+from paddle_tpu import io  # noqa: E402
+
+__all__ = [
+    "__version__",
+    "Module",
+    "Tensor",
+    "DistributedStrategy",
+    "to_tensor",
+    "seed",
+    "set_flags",
+    "get_flags",
+    "named_parameters",
+    "partition_specs",
+    "filter_grad",
+    "trainable_mask",
+    "tree_at",
+    "nn",
+    "optimizer",
+    "amp",
+    "metric",
+    "io",
+]
+
+
+def __getattr__(name):
+    # Heavier subpackages load on first touch to keep import fast.
+    import importlib
+
+    try:
+        if name in ("distributed", "models", "hapi", "data", "ops",
+                    "parallel", "utils", "vision", "text", "jit", "static",
+                    "incubate"):
+            mod = importlib.import_module(f"paddle_tpu.{name}")
+            globals()[name] = mod
+            return mod
+        if name == "Model":
+            from paddle_tpu.hapi.model import Model
+
+            globals()["Model"] = Model
+            return Model
+        if name == "DataParallel":
+            from paddle_tpu.parallel.data_parallel import DataParallel
+
+            globals()["DataParallel"] = DataParallel
+            return DataParallel
+    except ImportError as e:
+        # keep the __getattr__ contract (hasattr must work)
+        raise AttributeError(
+            f"paddle_tpu.{name} is unavailable: {e}") from e
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,167 @@
+"""Automatic mixed precision.
+
+Reference: dygraph AMP autocast lists (``paddle/fluid/imperative/
+amp_auto_cast.h:31`` — AmpOperators allow/block lists, ``AutoCastGuard``
+``:56``), GradScaler (``python/paddle/fluid/dygraph/amp/loss_scaler.py:27``)
+and the static rewrite (``fluid/contrib/mixed_precision/fp16_utils.py:321``).
+
+TPU-native reading: the MXU's native dtype is bfloat16, which needs *no*
+loss scaling (8-bit exponent == fp32 range). The idiomatic path is therefore
+``amp.decorate(model, dtype="bfloat16")`` (cast params/compute, keep norms
+and softmax in fp32 — our functional ops already do their reductions in
+fp32). ``auto_cast`` + ``GradScaler`` implement the reference's fp16
+semantics for parity, as pure functions usable inside jit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.stateful import map_modules
+
+__all__ = ["auto_cast", "active_dtype", "decorate", "cast_model",
+           "master_weights", "GradScaler", "ScalerState",
+           "WHITE_LIST", "BLACK_LIST"]
+
+# Ops that are numerically safe (and fast) in low precision — mirrors the
+# reference allow list (amp_auto_cast.cc: conv2d, matmul, mul, ...).
+WHITE_LIST = frozenset({"matmul", "linear", "conv2d", "einsum", "attention"})
+# Ops kept in fp32 — mirrors the reference block list (softmax, layer_norm,
+# cross_entropy, ...).
+BLACK_LIST = frozenset({"softmax", "log_softmax", "layer_norm", "rms_norm",
+                        "cross_entropy", "softmax_with_cross_entropy",
+                        "mean", "sum", "exp", "log"})
+
+
+class _AmpState(NamedTuple):
+    dtype: Any
+    white: frozenset
+    black: frozenset
+
+
+_amp_var: ContextVar[_AmpState | None] = ContextVar("ptpu_amp", default=None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, dtype: str = "bfloat16",
+              custom_white_list=(), custom_black_list=()):
+    """Autocast context (reference ``paddle.amp.auto_cast``). Inside, the
+    white-listed functional ops cast their floating inputs to ``dtype``."""
+    if not enable:
+        yield
+        return
+    state = _AmpState(jnp.dtype(dtype),
+                      WHITE_LIST | frozenset(custom_white_list),
+                      BLACK_LIST | frozenset(custom_black_list))
+    token = _amp_var.set(state)
+    try:
+        yield
+    finally:
+        _amp_var.reset(token)
+
+
+def active_dtype(op: str = "matmul"):
+    """The autocast dtype for ``op``, or None when not autocasting."""
+    state = _amp_var.get()
+    if state is None or op in state.black:
+        return None
+    if op in state.white:
+        return state.dtype
+    return None
+
+
+def _is_float(x):
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        x.dtype, jnp.floating)
+
+
+def cast_model(model, dtype=jnp.bfloat16):
+    """Cast all floating parameters (pure dtype move, preserves structure)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float(x) else x, model)
+
+
+def decorate(model, optimizer=None, dtype: str = "bfloat16",
+             master_weight: bool = True):
+    """``paddle.amp.decorate`` equivalent: returns a low-precision compute
+    copy of the model (and the optimizer untouched — master fp32 weights are
+    the *caller's* model; see :func:`master_weights` for the pattern)."""
+    out = cast_model(model, jnp.dtype(dtype))
+    return (out, optimizer) if optimizer is not None else out
+
+
+def master_weights(model):
+    """fp32 master copy for the optimizer (reference
+    ``fluid/contrib/mixed_precision/decorator.py`` master-grad path)."""
+    return cast_model(model, jnp.float32)
+
+
+class ScalerState(NamedTuple):
+    loss_scaling: jnp.ndarray
+    good_steps: jnp.ndarray
+    bad_steps: jnp.ndarray
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference GradScaler / AmpScaler,
+    ``fluid/dygraph/amp/loss_scaler.py:27``; ops
+    ``operators/amp/check_finite_and_unscale_op.cu``,
+    ``update_loss_scaling_op.cu``). Pure-function API: state in, state out."""
+
+    def __init__(self, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 enable: bool = True):
+        self.init_loss_scaling = init_loss_scaling
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.enable = enable
+
+    def init(self) -> ScalerState:
+        return ScalerState(jnp.asarray(self.init_loss_scaling, jnp.float32),
+                           jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.int32))
+
+    def scale(self, loss, state: ScalerState):
+        if not self.enable:
+            return loss
+        return loss * state.loss_scaling.astype(loss.dtype)
+
+    def unscale(self, grads, state: ScalerState):
+        """Unscale grads; returns (grads, all_finite)."""
+        if not self.enable:
+            return grads, jnp.asarray(True)
+        inv = 1.0 / state.loss_scaling
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        finite = jnp.all(jnp.stack([
+            jnp.all(jnp.isfinite(g))
+            for g in jax.tree_util.tree_leaves(grads)]))
+        return grads, finite
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        """Adjust the scale after a step (update_loss_scaling_op semantics:
+        grow after ``incr_every_n_steps`` consecutive finite steps, shrink
+        after ``decr_every_n_nan_or_inf`` consecutive non-finite steps)."""
+        if not self.enable:
+            return state
+        good = jnp.where(found_inf, 0, state.good_steps + 1)
+        bad = jnp.where(found_inf, state.bad_steps + 1, 0)
+        incr = good >= self.incr_every_n_steps
+        decr = bad >= self.decr_every_n_nan_or_inf
+        scale = jnp.where(
+            decr, state.loss_scaling * self.decr_ratio,
+            jnp.where(incr, state.loss_scaling * self.incr_ratio,
+                      state.loss_scaling))
+        scale = jnp.maximum(scale, 1.0)
+        good = jnp.where(incr, 0, good)
+        bad = jnp.where(decr, 0, bad)
+        return ScalerState(scale, good, bad)

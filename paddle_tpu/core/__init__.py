@@ -1,0 +1,8 @@
+"""Core substrate: pytree module system, strategy config, rng, flags, logging.
+
+Replaces the reference's L0-L2 layers (platform runtime, memory, framework
+core — reference ``paddle/fluid/platform/``, ``paddle/fluid/framework/``)
+with the JAX-native equivalents: XLA owns device memory and compilation;
+what remains framework-level is the module/pytree substrate, configuration,
+and RNG policy.
+"""

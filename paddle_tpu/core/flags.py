@@ -1,0 +1,98 @@
+"""Global flag registry.
+
+The reference centralizes ~60 gflags in ``paddle/fluid/platform/flags.cc``
+and exposes them to Python through
+``paddle/fluid/pybind/global_value_getter_setter.cc`` (``paddle.set_flags``).
+Here flags are a plain validated registry; flags that map onto XLA/JAX
+behavior apply themselves (e.g. deterministic ops), the rest configure
+framework-level features (nan/inf checking, logging verbosity, allocator
+tuning for the host pipeline).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    on_set: Callable[[Any], None] | None = None
+    value: Any = None
+
+
+_REGISTRY: dict[str, _Flag] = {}
+_lock = threading.Lock()
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_set: Callable[[Any], None] | None = None) -> None:
+    with _lock:
+        if name in _REGISTRY:
+            raise KeyError(f"flag {name!r} already defined")
+        env = os.environ.get(f"FLAGS_{name}")
+        value = default if env is None else _coerce(env, default)
+        _REGISTRY[name] = _Flag(name, default, help, on_set, value)
+    if env is not None and _REGISTRY[name].on_set:
+        _REGISTRY[name].on_set(value)
+
+
+def _coerce(raw: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    """``paddle.set_flags`` equivalent."""
+    for name, value in flags.items():
+        with _lock:
+            if name not in _REGISTRY:
+                raise KeyError(f"unknown flag {name!r}")
+            f = _REGISTRY[name]
+            f.value = value
+        if f.on_set is not None:
+            f.on_set(value)
+
+
+def get_flags(names: list[str] | str | None = None) -> dict[str, Any]:
+    """``paddle.get_flags`` equivalent."""
+    if names is None:
+        names = list(_REGISTRY)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n].value for n in names}
+
+
+def flag(name: str) -> Any:
+    """Fast read of a single flag value."""
+    return _REGISTRY[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (the subset of platform/flags.cc that is meaningful on TPU).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "After each training step, sweep outputs/grads for NaN/Inf "
+            "(reference FLAGS_check_nan_inf, platform/flags.cc:44)")
+define_flag("benchmark", False,
+            "Block on each step for timing (reference FLAGS_benchmark)")
+define_flag("v", 0, "Logging verbosity (glog-style VLOG level)")
+define_flag("host_prefetch_buffer", 4,
+            "Host data-pipeline prefetch depth (reference reader capacity)")
+define_flag("deterministic", False,
+            "Force deterministic XLA reductions where possible")
+define_flag("amp_dtype", "bfloat16",
+            "Autocast compute dtype for AMP (bf16 is TPU-native; fp16 kept "
+            "for parity with reference AMP lists)")

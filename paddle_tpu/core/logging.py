@@ -1,0 +1,38 @@
+"""Framework logging: glog-style VLOG levels on top of stdlib logging.
+
+Reference: glog init in ``paddle/fluid/pybind/pybind.cc:1717`` and VLOG use
+throughout the C++ core.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from paddle_tpu.core.flags import flag
+
+_logger = logging.getLogger("paddle_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).1s paddle_tpu %(message)s", "%H:%M:%S"))
+    _logger.addHandler(h)
+    _logger.setLevel(logging.INFO)
+
+
+def get_logger() -> logging.Logger:
+    return _logger
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    """Verbose log gated on the ``v`` flag (glog VLOG semantics)."""
+    if flag("v") >= level:
+        _logger.info(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args)

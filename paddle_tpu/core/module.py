@@ -1,0 +1,312 @@
+"""Pytree-native module system.
+
+Replaces the reference's ``paddle.fluid.dygraph.Layer``
+(reference ``python/paddle/fluid/dygraph/layers.py``) with a functional,
+JAX-idiomatic design: a :class:`Module` *is* a pytree whose array-valued
+attributes are leaves (parameters / buffers) and whose scalar / string /
+callable attributes are static aux data. This means a module can be passed
+straight through ``jax.jit`` / ``jax.grad`` / ``jax.tree_util`` — there is
+no separate parameter dict, no scopes (reference
+``paddle/fluid/framework/scope.h``), and no variable name registry: the
+pytree *path* is the canonical parameter name.
+
+Sharding integration: modules may carry a static ``_pspecs`` dict mapping
+attribute names to ``jax.sharding.PartitionSpec``.
+:func:`partition_specs` walks the pytree-with-paths and produces a matching
+tree of PartitionSpecs — the TPU-native equivalent of the reference's
+per-op ``ring_id`` + program-rewriting distribution passes
+(reference ``python/paddle/distributed/fleet/meta_optimizers/common.py:49``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "Module",
+    "named_parameters",
+    "parameters",
+    "partition_specs",
+    "trainable_mask",
+    "filter_grad",
+    "tree_at",
+    "apply_updates",
+    "count_params",
+    "path_str",
+]
+
+
+def _is_data(value: Any) -> bool:
+    """Decide whether an attribute value belongs to the dynamic (pytree data)
+    half of a module. Arrays and (containers of) sub-modules are data;
+    everything else — ints, floats, strings, callables, dtypes, PartitionSpecs
+    — is static configuration."""
+    if isinstance(value, (jax.Array, np.ndarray, Module)):
+        return True
+    # array-likes that appear when a module's leaves are mapped to abstract
+    # values (jax.ShapeDtypeStruct, orbax restore args, ...)
+    if hasattr(value, "shape") and hasattr(value, "dtype") and not isinstance(
+            value, (int, float, bool, complex)):
+        return True
+    if isinstance(value, (list, tuple)):
+        return any(_is_data(v) for v in value)
+    if isinstance(value, dict):
+        return any(_is_data(v) for v in value.values())
+    return False
+
+
+class _Static(tuple):
+    """Hashable bag of (name, value) static attributes used as pytree aux
+    data. Values must be hashable; lists are rejected early to avoid
+    surprising treedef hash failures (use tuples)."""
+
+    def __new__(cls, items):
+        return super().__new__(cls, items)
+
+
+class Module:
+    """Base class for all layers and models.
+
+    Subclasses define ordinary ``__init__`` methods that assign attributes;
+    registration as a pytree node happens automatically per subclass.
+    Array-valued attributes become leaves. A module is immutable *by
+    convention* after construction — training never mutates a module, it
+    produces a new one (see :func:`apply_updates`).
+
+    Special static attributes (optional):
+
+    - ``_pspecs``: dict[str, PartitionSpec] — sharding annotation for array
+      attributes of *this* module.
+    - ``_nontrainable``: tuple[str, ...] — attribute names excluded from
+      gradients (e.g. batch-norm running stats).
+    """
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        jax.tree_util.register_pytree_with_keys(
+            cls,
+            flatten_with_keys=_flatten_module_with_keys,
+            flatten_func=_flatten_module,
+            unflatten_func=lambda aux, children: _unflatten_module(cls, aux, children),
+        )
+
+    # -- convenience ----------------------------------------------------
+    def replace(self, **changes) -> "Module":
+        """Return a copy of this module with the given attributes replaced."""
+        new = object.__new__(type(self))
+        new.__dict__.update(self.__dict__)
+        new.__dict__.update(changes)
+        return new
+
+    def named_parameters(self):
+        return named_parameters(self)
+
+    def parameters(self):
+        return parameters(self)
+
+    def __repr__(self):
+        cls = type(self).__name__
+        n = count_params(self)
+        return f"{cls}(params={n:,})"
+
+
+def _split_fields(mod: Module):
+    """Split attributes into (data_names, data_vals, static_items).
+
+    Modules created by ``__init__`` are split by value type (arrays and
+    sub-modules are data). Modules produced by *unflatten* carry a
+    ``_data_fields__`` override so that a tree_map that replaces array
+    leaves with arbitrary objects (PartitionSpecs, shardings, None, shape
+    structs ...) re-flattens with the SAME structure — this is what lets
+    ``partition_specs(model)`` trees be passed to ``jax.device_put`` /
+    ``jax.jit(in_shardings=...)``.
+    """
+    override = mod.__dict__.get("_data_fields__")
+    data_names, data_vals, static_items = [], [], []
+    for name in sorted(mod.__dict__):
+        if name == "_data_fields__":
+            continue
+        value = mod.__dict__[name]
+        if (name in override) if override is not None else _is_data(value):
+            data_names.append(name)
+            data_vals.append(value)
+        else:
+            if isinstance(value, list):
+                raise TypeError(
+                    f"static attribute {type(mod).__name__}.{name} is a list; "
+                    "use a tuple so the pytree aux data stays hashable"
+                )
+            static_items.append((name, value))
+    return data_names, data_vals, static_items
+
+
+def _flatten_module(mod: Module):
+    data_names, data_vals, static_items = _split_fields(mod)
+    aux = (tuple(data_names), _Static(static_items))
+    return data_vals, aux
+
+
+def _flatten_module_with_keys(mod: Module):
+    data_names, data_vals, static_items = _split_fields(mod)
+    keyed = [(jax.tree_util.GetAttrKey(n), v) for n, v in zip(data_names, data_vals)]
+    aux = (tuple(data_names), _Static(static_items))
+    return keyed, aux
+
+
+def _unflatten_module(cls, aux, children):
+    data_names, static_items = aux
+    mod = object.__new__(cls)
+    for name, value in static_items:
+        object.__setattr__(mod, name, value)
+    for name, value in zip(data_names, children):
+        object.__setattr__(mod, name, value)
+    # remember the split so re-flattening is structure-stable even if the
+    # children are no longer arrays (see _split_fields)
+    object.__setattr__(mod, "_data_fields__", frozenset(data_names))
+    return mod
+
+
+# ----------------------------------------------------------------------
+# Tree utilities
+# ----------------------------------------------------------------------
+
+def path_str(path) -> str:
+    """Render a jax key path as a dotted name, e.g. ``layers.0.weight``."""
+    parts = []
+    for key in path:
+        if isinstance(key, jax.tree_util.GetAttrKey):
+            parts.append(key.name)
+        elif isinstance(key, jax.tree_util.SequenceKey):
+            parts.append(str(key.idx))
+        elif isinstance(key, jax.tree_util.DictKey):
+            parts.append(str(key.key))
+        else:  # pragma: no cover
+            parts.append(str(key))
+    return ".".join(parts)
+
+
+def named_parameters(tree) -> Iterable[tuple[str, jax.Array]]:
+    """Yield ``(dotted_name, array)`` for every array leaf — the equivalent
+    of ``Layer.named_parameters()`` in the reference
+    (``python/paddle/fluid/dygraph/layers.py``)."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    return [(path_str(p), v) for p, v in leaves]
+
+
+def parameters(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def count_params(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "shape"))
+
+
+def _walk_owner(tree, path):
+    """Walk ``tree`` along ``path`` and return (owning object, final key).
+
+    The owning object is the object holding the *last* key in the path; used
+    to resolve per-module annotations like ``_pspecs``/``_nontrainable``.
+    Also returns the nearest enclosing Module and the attribute name under it
+    (for array fields nested in lists the attr is the list's name).
+    """
+    obj = tree
+    owner_module, attr_under_module = None, None
+    if isinstance(obj, Module):
+        owner_module = obj
+    for key in path:
+        if isinstance(key, jax.tree_util.GetAttrKey):
+            if isinstance(obj, Module):
+                owner_module, attr_under_module = obj, key.name
+            obj = getattr(obj, key.name)
+        elif isinstance(key, jax.tree_util.SequenceKey):
+            obj = obj[key.idx]
+        elif isinstance(key, jax.tree_util.DictKey):
+            obj = obj[key.key]
+        if isinstance(obj, Module):
+            owner_module, attr_under_module = obj, None
+    return owner_module, attr_under_module
+
+
+def partition_specs(tree, default: P | None = None):
+    """Build a pytree of ``PartitionSpec`` matching ``tree``'s structure.
+
+    Each module annotates its own arrays via a static ``_pspecs`` dict;
+    unannotated arrays are replicated (``P()``). This plays the role of the
+    reference's distributed program-rewriting passes: instead of inserting
+    ``c_broadcast``/``c_allreduce_sum`` ops into a ProgramDesc
+    (reference ``meta_optimizers/sharding_optimizer.py:100-114``), we
+    annotate shardings and let XLA's SPMD partitioner insert collectives.
+    """
+    default = default if default is not None else P()
+
+    def visit(path, leaf):
+        owner, attr = _walk_owner(tree, path)
+        if owner is not None and attr is not None:
+            specs = getattr(owner, "_pspecs", None)
+            if specs:
+                # stored as a tuple of (name, spec) pairs to stay hashable
+                specs = specs if isinstance(specs, dict) else dict(specs)
+                if attr in specs:
+                    return specs[attr]
+        return default
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def trainable_mask(tree):
+    """Pytree of bools: True for trainable parameters, False for buffers
+    (attributes listed in a module's ``_nontrainable`` tuple, e.g. BN
+    running statistics) — the ``stop_gradient`` equivalent of the
+    reference's ``ParamBase.trainable``."""
+
+    def visit(path, leaf):
+        owner, attr = _walk_owner(tree, path)
+        if owner is not None and attr is not None:
+            nt = getattr(owner, "_nontrainable", ())
+            if attr in nt:
+                return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def filter_grad(grads, mask):
+    """Zero out gradients where mask is False (buffers)."""
+    return jax.tree_util.tree_map(
+        lambda g, m: g if m else jnp.zeros_like(g), grads, mask
+    )
+
+
+def tree_at(where: Callable, tree, replace):
+    """Functional attribute surgery: return a copy of ``tree`` with the
+    leaf/subtree selected by ``where(tree)`` replaced by ``replace``.
+
+    Example: ``model = tree_at(lambda m: m.head.weight, model, new_w)``.
+    """
+    # Identify the selected node by object identity using a sentinel pass.
+    target = where(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree, is_leaf=lambda x: x is target)
+    hits = [i for i, l in enumerate(leaves) if l is target]
+    if len(hits) != 1:
+        raise ValueError(
+            f"tree_at: `where` selected {len(hits)} nodes; expected exactly 1"
+        )
+    leaves[hits[0]] = replace
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def apply_updates(model, updates):
+    """``model + updates`` leafwise — the optimizer step application."""
+    return jax.tree_util.tree_map(
+        lambda p, u: (p + u).astype(p.dtype) if u is not None else p,
+        model,
+        updates,
+        is_leaf=lambda x: x is None,
+    )

@@ -1,0 +1,96 @@
+"""RNG policy.
+
+The reference seeds per-device CPU/CUDA generators imperatively
+(``paddle.seed``, reference ``python/paddle/framework/random.py``). The
+TPU-native design is explicit-key JAX PRNG; for the paddle-like imperative
+construction API (``nn.Linear(4, 8)`` with no key argument) we keep a global
+default generator that hands out fresh fold-in keys. Everything inside jitted
+training steps takes explicit keys.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_seed = 0
+_counter = 0
+
+
+def seed(s: int) -> None:
+    """Set the global seed (equivalent of ``paddle.seed``)."""
+    global _seed, _counter
+    with _lock:
+        _seed = int(s)
+        _counter = 0
+
+
+def get_seed() -> int:
+    return _seed
+
+
+def next_key() -> jax.Array:
+    """Return a fresh PRNG key from the default generator.
+
+    Deterministic given the seed and the sequence of calls — mirrors the
+    reference's global generator semantics without threading keys through
+    every constructor.
+    """
+    global _counter
+    with _lock:
+        c = _counter
+        _counter += 1
+    return jax.random.fold_in(jax.random.PRNGKey(_seed), c)
+
+
+def split_key(key: jax.Array | None, num: int = 2):
+    """Split an explicit key, or draw from the default generator if None."""
+    if key is None:
+        key = next_key()
+    return jax.random.split(key, num)
+
+
+# ---------------------------------------------------------------------------
+# Key stream: lets stochastic layers (dropout) draw keys without threading
+# them through every __call__, while staying jit-safe. The trainer opens a
+# stream *inside* the traced step function with the step's key:
+#
+#     with rng.stream(step_key):
+#         y = model(x, training=True)
+#
+# Each stream_key() call splits deterministically off the step key.
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+from contextvars import ContextVar as _ContextVar
+
+
+class _KeyStream:
+    def __init__(self, key):
+        self._key = key
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_stream_var: _ContextVar[_KeyStream | None] = _ContextVar("ptpu_key_stream",
+                                                          default=None)
+
+
+@_contextlib.contextmanager
+def stream(key: jax.Array):
+    """Open an RNG stream for stochastic layers. Jit-safe: call inside the
+    traced function with a traced key."""
+    token = _stream_var.set(_KeyStream(key))
+    try:
+        yield
+    finally:
+        _stream_var.reset(token)
+
+
+def stream_key() -> jax.Array | None:
+    """Draw the next key from the ambient stream, or None if no stream."""
+    s = _stream_var.get()
+    return None if s is None else s.next()

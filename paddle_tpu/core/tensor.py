@@ -1,0 +1,138 @@
+"""Tensor API surface: factories, default dtype, save/load.
+
+Mirrors the user-facing subset of ``paddle.tensor`` creation ops
+(reference ``python/paddle/tensor/creation.py``, ``random.py``) on jnp.
+``paddle_tpu.Tensor`` is ``jax.Array`` — there is no wrapper class: a
+tensor in this framework is exactly an XLA array, which is what makes
+every op jit-traceable and shardable for free.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import rng
+
+Tensor = jax.Array
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = jnp.dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def seed(s: int) -> None:
+    """Global seed (``paddle.seed``)."""
+    rng.seed(s)
+
+
+def to_tensor(data: Any, dtype=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` equivalent (stop_gradient kept for API parity;
+    gradients in JAX are explicit so it is advisory)."""
+    del stop_gradient
+    arr = jnp.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif arr.dtype == jnp.float64:
+        arr = arr.astype(_default_dtype)
+    return arr
+
+
+def _dt(dtype):
+    return _default_dtype if dtype is None else dtype
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, _dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype)
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype)
+
+
+def arange(start, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt(dtype))
+
+
+def eye(n, m=None, dtype=None):
+    return jnp.eye(n, m, dtype=_dt(dtype))
+
+
+# -- random factories (default generator; explicit-key APIs live in jax) ----
+
+def rand(shape, dtype=None, key=None):
+    key = key if key is not None else rng.next_key()
+    return jax.random.uniform(key, shape, _dt(dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):
+    key = key if key is not None else rng.next_key()
+    return jax.random.uniform(key, shape, _dt(dtype), min, max)
+
+
+def randn(shape, dtype=None, key=None):
+    key = key if key is not None else rng.next_key()
+    return jax.random.normal(key, shape, _dt(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=(), key=None):
+    key = key if key is not None else rng.next_key()
+    return mean + std * jax.random.normal(key, shape, _default_dtype)
+
+
+def randint(low, high=None, shape=(), dtype=jnp.int32, key=None):
+    if high is None:
+        low, high = 0, low
+    key = key if key is not None else rng.next_key()
+    return jax.random.randint(key, shape, low, high, dtype)
+
+
+def randperm(n, dtype=jnp.int32, key=None):
+    key = key if key is not None else rng.next_key()
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+# -- save/load (``paddle.save``/``paddle.load`` for plain objects; sharded
+#    checkpoints live in paddle_tpu.io.checkpoint) --------------------------
+
+def save(obj: Any, path: str) -> None:
+    host = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, obj)
+    with open(path, "wb") as f:
+        pickle.dump(host, f)
+
+
+def load(path: str) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,17 @@
+"""paddle_tpu.io — checkpoint save/load, datasets, export.
+
+Reference: ``python/paddle/fluid/io.py`` (save/load_vars/inference_model),
+dygraph state-dict checkpoints (``fluid/dygraph/checkpoint.py``).
+"""
+
+from paddle_tpu.io.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    load_state_dict,
+    save_state_dict,
+    state_dict,
+    set_state_dict,
+)
+
+__all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
+           "load_state_dict", "state_dict", "set_state_dict"]

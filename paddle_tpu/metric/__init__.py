@@ -1,0 +1,127 @@
+"""Metrics — ``paddle.metric`` equivalent.
+
+Reference: ``python/paddle/metric/metrics.py`` (Metric base, Accuracy,
+Precision, Recall, Auc). Accumulation is host-side numpy (metrics are not in
+the jitted step; the step returns the raw correctness counts).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(pred, label, k: int = 1):
+    """Top-k accuracy of a batch (jit-friendly; reference
+    ``operators/metrics/accuracy_op.cu``)."""
+    import jax.numpy as jnp
+    topk = jnp.argsort(pred, axis=-1)[..., -k:]
+    hit = jnp.any(topk == label[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+class Metric:
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+    def reset(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update(self, *args):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def accumulate(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    def __init__(self, topk: int = 1):
+        self.topk = topk
+        self.reset()
+
+    def reset(self):
+        self._correct = 0
+        self._total = 0
+
+    def update(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(pred.shape[0], -1)[:, 0]
+        topk = np.argsort(pred, axis=-1)[:, -self.topk:]
+        hit = (topk == label[:, None]).any(axis=-1)
+        self._correct += int(hit.sum())
+        self._total += len(hit)
+        return hit.mean()
+
+    def accumulate(self) -> float:
+        return self._correct / max(self._total, 1)
+
+
+class Precision(Metric):
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self._tp = 0
+        self._fp = 0
+
+    def update(self, pred, label):
+        pred = np.asarray(pred).reshape(-1) > self.threshold
+        label = np.asarray(label).reshape(-1).astype(bool)
+        self._tp += int((pred & label).sum())
+        self._fp += int((pred & ~label).sum())
+
+    def accumulate(self) -> float:
+        return self._tp / max(self._tp + self._fp, 1)
+
+
+class Recall(Metric):
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.reset()
+
+    def reset(self):
+        self._tp = 0
+        self._fn = 0
+
+    def update(self, pred, label):
+        pred = np.asarray(pred).reshape(-1) > self.threshold
+        label = np.asarray(label).reshape(-1).astype(bool)
+        self._tp += int((pred & label).sum())
+        self._fn += int((~pred & label).sum())
+
+    def accumulate(self) -> float:
+        return self._tp / max(self._tp + self._fn, 1)
+
+
+class Auc(Metric):
+    """Histogram-bucket AUC (reference ``metrics.py`` Auc /
+    ``operators/metrics/auc_op``)."""
+
+    def __init__(self, num_thresholds: int = 4095):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, pred, label):
+        pred = np.asarray(pred)
+        if pred.ndim == 2 and pred.shape[1] == 2:
+            pred = pred[:, 1]
+        pred = pred.reshape(-1)
+        label = np.asarray(label).reshape(-1)
+        idx = np.clip((pred * self.num_thresholds).astype(int), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[label > 0.5], 1)
+        np.add.at(self._neg, idx[label <= 0.5], 1)
+
+    def accumulate(self) -> float:
+        tot_pos = self._pos[::-1].cumsum()[::-1]
+        tot_neg = self._neg[::-1].cumsum()[::-1]
+        tp, fp = np.r_[tot_pos, 0], np.r_[tot_neg, 0]
+        auc = np.sum((fp[:-1] - fp[1:]) * (tp[:-1] + tp[1:]) / 2.0)
+        denom = tot_pos[0] * tot_neg[0]
+        return float(auc / denom) if denom > 0 else 0.0

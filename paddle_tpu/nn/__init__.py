@@ -1,0 +1,38 @@
+"""paddle_tpu.nn — layers, losses, initializers, functional ops.
+
+Mirrors the reference's ``paddle.nn`` surface
+(reference ``python/paddle/nn/__init__.py``) on the pytree Module system.
+"""
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer
+from paddle_tpu.nn.activation import (
+    ELU, GELU, Hardsigmoid, Hardswish, LeakyReLU, LogSoftmax, Mish, ReLU,
+    ReLU6, Sigmoid, SiLU, Softmax, Softplus, Swish, Tanh,
+)
+from paddle_tpu.nn.attention import Cache, MultiHeadAttention
+from paddle_tpu.nn.common import (
+    Dropout, Embedding, Flatten, Identity, LayerList, Linear, Sequential,
+    call_layer,
+)
+from paddle_tpu.nn.conv import (
+    AdaptiveAvgPool2D, AvgPool2D, Conv1D, Conv2D, Conv2DTranspose, MaxPool2D,
+)
+from paddle_tpu.nn.loss import (
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
+    NLLLoss, SmoothL1Loss,
+)
+from paddle_tpu.nn.norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+    InstanceNorm2D, LayerNorm, RMSNorm, SyncBatchNorm,
+)
+from paddle_tpu.nn.rnn import GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNNCell
+from paddle_tpu.nn.stateful import map_modules, merge_state, state_tape
+from paddle_tpu.nn.transformer import (
+    Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+
+Layer = Module  # paddle calls the base class Layer

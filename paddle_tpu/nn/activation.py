@@ -1,0 +1,128 @@
+"""Activation layers (module wrappers over functional).
+
+Reference: ``python/paddle/nn/layer/activation.py``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+
+__all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh",
+           "LeakyReLU", "ELU", "Softmax", "LogSoftmax", "Softplus",
+           "Hardswish", "Hardsigmoid", "Mish"]
+
+
+class ReLU(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.relu6(x)
+
+
+class GELU(Module):
+    def __init__(self, approximate: bool = False):
+        self.approximate = bool(approximate)
+
+    def __call__(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class SiLU(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.silu(x)
+
+
+Swish = SiLU
+
+
+class Sigmoid(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.tanh(x)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope: float = 0.01):
+        self.negative_slope = float(negative_slope)
+
+    def __call__(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = float(alpha)
+
+    def __call__(self, x):
+        return F.elu(x, self.alpha)
+
+
+class Softmax(Module):
+    def __init__(self, axis: int = -1):
+        self.axis = int(axis)
+
+    def __call__(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Module):
+    def __init__(self, axis: int = -1):
+        self.axis = int(axis)
+
+    def __call__(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class Softplus(Module):
+    def __init__(self, beta: float = 1.0, threshold: float = 20.0):
+        self.beta, self.threshold = float(beta), float(threshold)
+
+    def __call__(self, x):
+        return F.softplus(x, self.beta, self.threshold)
+
+
+class Hardswish(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.hardswish(x)
+
+
+class Hardsigmoid(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.hardsigmoid(x)
+
+
+class Mish(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.mish(x)

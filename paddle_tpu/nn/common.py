@@ -1,0 +1,166 @@
+"""Common layers: Linear, Embedding, Dropout, containers.
+
+Reference: ``python/paddle/nn/layer/common.py`` and
+``python/paddle/fluid/dygraph/container.py``. Layers construct their
+parameters eagerly (paddle-style imperative API) using the default RNG
+stream, or an explicit ``key=``.
+
+Sharding: layers accept ``pspec=PartitionSpec(...)`` for their weight and
+record it in ``_pspecs`` so :func:`paddle_tpu.partition_specs` can build the
+model's sharding tree.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+__all__ = ["Linear", "Embedding", "Dropout", "Identity", "Flatten",
+           "Sequential", "LayerList", "call_layer"]
+
+_ACCEPTS_TRAINING: dict[type, bool] = {}
+
+
+def call_layer(layer, x, training: bool = False):
+    """Call a layer, passing ``training=`` only if its signature accepts it.
+    Lets containers thread train/eval mode through heterogeneous layers."""
+    cls = type(layer)
+    ok = _ACCEPTS_TRAINING.get(cls)
+    if ok is None:
+        try:
+            sig = inspect.signature(cls.__call__)
+            ok = "training" in sig.parameters or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values())
+        except (ValueError, TypeError):
+            ok = False
+        _ACCEPTS_TRAINING[cls] = ok
+    return layer(x, training=training) if ok else layer(x)
+
+
+class Linear(Module):
+    """y = x @ W + b, weight layout [in, out].
+
+    Reference: ``python/paddle/nn/layer/common.py`` Linear →
+    ``operators/matmul_v2_op.*`` + fc math. TP sharding: pass
+    ``pspec=P(None, "tp")`` (column parallel) or ``P("tp", None)`` (row
+    parallel); the bias inherits the output-dim axis.
+    """
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, weight_init=None, bias_init=None,
+                 dtype=jnp.float32, key=None, pspec: P | None = None):
+        k1, k2 = rng.split_key(key)
+        weight_init = weight_init or I.XavierUniform()
+        bias_init = bias_init or I.Constant(0.0)
+        self.weight = weight_init(k1, (in_features, out_features), dtype)
+        self.bias = bias_init(k2, (out_features,), dtype) if bias else None
+        self.in_features = int(in_features)
+        self.out_features = int(out_features)
+        if pspec is not None:
+            out_axis = pspec[-1] if len(pspec) >= 2 else None
+            self._pspecs = (("weight", pspec), ("bias", P(out_axis)))
+
+    def __call__(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Embedding(Module):
+    """Lookup table (reference ``operators/lookup_table_v2_op.cu``;
+    ``python/paddle/nn/layer/common.py`` Embedding). For TP, shard the
+    vocab or embedding axis via ``pspec``."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 padding_idx: int | None = None, weight_init=None,
+                 dtype=jnp.float32, key=None, pspec: P | None = None):
+        (k1,) = rng.split_key(key, 1)
+        weight_init = weight_init or I.Normal(0.0, 1.0)
+        w = weight_init(k1, (num_embeddings, embedding_dim), dtype)
+        if padding_idx is not None:
+            w = w.at[padding_idx].set(0.0)
+        self.weight = w
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.padding_idx = padding_idx
+        if pspec is not None:
+            self._pspecs = (("weight", pspec),)
+
+    def __call__(self, ids):
+        return F.embedding(ids, self.weight)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, training: bool = False, key=None):
+        return F.dropout(x, self.p, training=training, key=key)
+
+
+class Identity(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x, **kwargs):
+        return x
+
+
+class Flatten(Module):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def __call__(self, x):
+        stop = self.stop_axis if self.stop_axis >= 0 else x.ndim + self.stop_axis
+        shape = (x.shape[:self.start_axis]
+                 + (-1,)
+                 + x.shape[stop + 1:])
+        return x.reshape(shape)
+
+
+class Sequential(Module):
+    """``paddle.nn.Sequential``: callable chain of layers."""
+
+    def __init__(self, *layers):
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = tuple(layers[0])
+        self.layers = tuple(layers)
+
+    def __call__(self, x, training: bool = False):
+        for layer in self.layers:
+            x = call_layer(layer, x, training)
+        return x
+
+    def __getitem__(self, i):
+        return self.layers[i]
+
+    def __len__(self):
+        return len(self.layers)
+
+
+class LayerList(Module):
+    """``paddle.nn.LayerList``: an indexable container of sub-layers."""
+
+    def __init__(self, layers: Sequence = ()):
+        self.layers = tuple(layers)
+
+    def __getitem__(self, i):
+        return self.layers[i]
+
+    def __iter__(self):
+        return iter(self.layers)
+
+    def __len__(self):
+        return len(self.layers)
+
+    def append(self, layer) -> "LayerList":
+        return self.replace(layers=self.layers + (layer,))

@@ -1,0 +1,141 @@
+"""Convolution and pooling layers.
+
+Reference: ``python/paddle/nn/layer/conv.py`` / ``pooling.py`` backed by
+``operators/conv_cudnn_op.cu`` and ``operators/pool_op.*``. On TPU,
+``lax.conv_general_dilated`` lowers onto the MXU; layouts are handled by
+XLA so the logical NCHW default (reference parity) costs nothing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+__all__ = ["Conv1D", "Conv2D", "Conv2DTranspose", "MaxPool2D", "AvgPool2D",
+           "AdaptiveAvgPool2D"]
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Conv2D(Module):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size, *,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias: bool = True, weight_init=None, dtype=jnp.float32,
+                 data_format: str = "NCHW", key=None):
+        k1, k2 = rng.split_key(key)
+        ks = _pair(kernel_size)
+        weight_init = weight_init or I.KaimingUniform()
+        self.weight = weight_init(
+            k1, (out_channels, in_channels // groups, ks[0], ks[1]), dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride = _pair(stride)
+        self.padding = padding if isinstance(padding, str) else _pair(padding)
+        self.dilation = _pair(dilation)
+        self.groups = int(groups)
+        self.data_format = data_format
+        self.in_channels, self.out_channels = int(in_channels), int(out_channels)
+
+    def __call__(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv1D(Module):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 *, stride: int = 1, padding: int = 0, dilation: int = 1,
+                 groups: int = 1, bias: bool = True, dtype=jnp.float32,
+                 key=None):
+        k1, _ = rng.split_key(key)
+        winit = I.KaimingUniform()
+        self.weight = winit(
+            k1, (out_channels, in_channels // groups, kernel_size), dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride, self.padding = int(stride), int(padding)
+        self.dilation, self.groups = int(dilation), int(groups)
+
+    def __call__(self, x):
+        # run as a height-1 conv2d: [N, C, L] -> [N, C, 1, L]
+        w = self.weight[:, :, None, :]
+        y = F.conv2d(x[:, :, None, :], w, self.bias,
+                     stride=(1, self.stride), padding=(0, self.padding),
+                     dilation=(1, self.dilation), groups=self.groups)
+        return y[:, :, 0, :]
+
+
+class Conv2DTranspose(Module):
+    """Transposed conv with the reference's output-size semantics:
+    ``H_out = (H_in - 1) * stride - 2 * padding + kernel``
+    (reference ``operators/conv_transpose_op.cc``). Implemented as an
+    input-dilated forward conv with the kernel spatially flipped, which is
+    the formulation XLA lowers best on TPU."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size, *,
+                 stride=1, padding=0, bias: bool = True, dtype=jnp.float32,
+                 key=None):
+        k1, _ = rng.split_key(key)
+        ks = _pair(kernel_size)
+        winit = I.KaimingUniform()
+        # reference layout [in_c, out_c, kh, kw]
+        self.weight = winit(k1, (in_channels, out_channels, ks[0], ks[1]),
+                            dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.kernel_size = ks
+
+    def __call__(self, x):
+        from jax import lax
+        p, k = self.padding, self.kernel_size
+        # flip spatially and swap to OIHW: transpose of the forward conv
+        w = jnp.flip(self.weight, axis=(2, 3)).transpose(1, 0, 2, 3)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1),
+            padding=[(k[0] - 1 - p[0], k[0] - 1 - p[0]),
+                     (k[1] - 1 - p[1], k[1] - 1 - p[1])],
+            lhs_dilation=self.stride,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.bias is not None:
+            y = y + self.bias.reshape(1, -1, 1, 1)
+        return y
+
+
+class MaxPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCHW"):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCHW"):
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AdaptiveAvgPool2D(Module):
+    def __init__(self, output_size, data_format: str = "NCHW"):
+        self.output_size = _pair(output_size)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)

@@ -1,0 +1,528 @@
+"""Functional ops — the ``paddle.nn.functional`` equivalent.
+
+The reference implements these as ~657 registered C++/CUDA operators
+(reference ``paddle/fluid/operators/``, e.g. ``softmax_with_cross_entropy_op.cu``,
+``layer_norm_op.cu``, ``dropout_op.cu``, ``lookup_table_v2_op.cu``). On TPU
+the bulk is jax.numpy/lax — XLA fuses elementwise chains into matmul
+epilogues on its own — and the hot set additionally has Pallas kernels in
+``paddle_tpu.ops.pallas`` that these wrappers dispatch to on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import rng
+
+__all__ = [
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "leaky_relu", "elu", "softplus", "hardswish", "hardsigmoid", "mish",
+    "glu", "swiglu",
+    "softmax", "log_softmax", "one_hot", "embedding", "linear",
+    "dropout", "layer_norm", "rms_norm", "group_norm", "batch_norm",
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "label_smooth",
+    "scaled_dot_product_attention", "rotary_embedding", "apply_rotary",
+    "avg_pool2d", "max_pool2d", "adaptive_avg_pool2d", "conv2d", "pad",
+    "interpolate", "unfold", "clip", "normalize", "cosine_similarity",
+]
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference operators/activation_op.*)
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    xb = x * beta
+    return jnp.where(xb > threshold, x, jax.nn.softplus(xb) / beta)
+
+
+def hardswish(x):
+    return x * relu6(x + 3.0) / 6.0
+
+
+def hardsigmoid(x):
+    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * sigmoid(b)
+
+
+def swiglu(x, gate):
+    """SwiGLU combine used by Llama-style MLPs: silu(gate) * x."""
+    return silu(gate) * x
+
+
+# ---------------------------------------------------------------------------
+# Normalization / softmax
+# ---------------------------------------------------------------------------
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5, axis=-1):
+    """Reference kernel: ``operators/layer_norm_op.cu`` (Welford rows); on
+    TPU XLA fuses this; a Pallas version exists for the fused+residual form
+    (``paddle_tpu.ops.pallas.layer_norm``)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    """RMSNorm (no mean subtraction) — the Llama-family norm. Computed in
+    fp32 and cast back, matching standard practice for bf16 training."""
+    dtype = x.dtype
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + epsilon)
+    y = y.astype(dtype)
+    if weight is not None:
+        y = y * weight
+    return y
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = x.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(g - mean), axis=axes, keepdims=True)
+    g = (g - mean) * lax.rsqrt(var + epsilon)
+    y = g.reshape(n, c, *spatial)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    if data_format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+def batch_norm(x, mean, var, weight=None, bias=None, epsilon: float = 1e-5,
+               data_format: str = "NCHW"):
+    """Inference-mode batch norm with given statistics (training-mode stat
+    update lives in nn.BatchNorm; reference ``operators/batch_norm_op.cu``)."""
+    c_axis = 1 if data_format == "NCHW" else -1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    y = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+def normalize(x, p: float = 2.0, axis: int = -1, epsilon: float = 1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def cosine_similarity(a, b, axis: int = -1, eps: float = 1e-8):
+    a_n = jnp.linalg.norm(a, axis=axis)
+    b_n = jnp.linalg.norm(b, axis=axis)
+    dot = jnp.sum(a * b, axis=axis)
+    return dot / jnp.maximum(a_n * b_n, eps)
+
+
+# ---------------------------------------------------------------------------
+# Core layers
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """y = x @ W (+ b). Weight layout [in, out] like the reference's fc
+    (reference ``operators/math/fc.cc``) — feeds the MXU directly."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(ids, weight):
+    """Lookup-table gather (reference ``operators/lookup_table_v2_op.cu``)."""
+    return jnp.take(weight, ids, axis=0)
+
+
+def one_hot(ids, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(ids, num_classes, dtype=dtype)
+
+
+def dropout(x, p: float = 0.5, training: bool = True, key=None):
+    """Inverted dropout (reference ``operators/dropout_op.cu``,
+    upscale_in_train mode). Requires an RNG key while training — either
+    explicit or from the ambient ``rng.stream`` opened by the trainer."""
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = rng.stream_key()
+    if key is None:
+        raise ValueError(
+            "dropout(training=True) needs an RNG key: pass key= or open a "
+            "paddle_tpu.core.rng.stream(step_key) around the forward pass")
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def label_smooth(label, epsilon: float = 0.1):
+    num = label.shape[-1]
+    return label * (1.0 - epsilon) + epsilon / num
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+# ---------------------------------------------------------------------------
+# Losses (reference operators/softmax_with_cross_entropy_op.cu etc.)
+# ---------------------------------------------------------------------------
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, axis: int = -1):
+    """Fused softmax+xent — numerically stable log-softmax formulation.
+    The reference fuses this in CUDA; XLA fuses the same graph, and a
+    Pallas kernel covers the [B*T, V] hot case."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+    return jnp.where(valid, nll, 0.0)
+
+
+def cross_entropy(logits, label, soft_label: bool = False,
+                  ignore_index: int = -100, reduction: str = "mean",
+                  weight=None, axis: int = -1):
+    loss = softmax_with_cross_entropy(logits, label, soft_label,
+                                      ignore_index, axis)
+    if weight is not None and not soft_label:
+        w = jnp.take(weight, jnp.where(label == ignore_index, 0, label))
+        w = jnp.where(label == ignore_index, 0.0, w)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        if not soft_label:
+            valid = (label != ignore_index).astype(loss.dtype)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1.0)
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def nll_loss(log_probs, label, reduction: str = "mean"):
+    nll = -jnp.take_along_axis(log_probs, label[..., None], axis=-1)[..., 0]
+    return _reduce(nll, reduction)
+
+
+def binary_cross_entropy(probs, label, reduction: str = "mean",
+                         epsilon: float = 1e-12):
+    p = jnp.clip(probs, epsilon, 1.0 - epsilon)
+    loss = -(label * jnp.log(p) + (1.0 - label) * jnp.log1p(-p))
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logits, label, reduction: str = "mean",
+                                     pos_weight=None):
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    if pos_weight is not None:
+        loss = -(pos_weight * label * log_p + (1.0 - label) * log_not_p)
+    else:
+        loss = -(label * log_p + (1.0 - label) * log_not_p)
+    return _reduce(loss, reduction)
+
+
+def mse_loss(pred, target, reduction: str = "mean"):
+    return _reduce(jnp.square(pred - target), reduction)
+
+
+def l1_loss(pred, target, reduction: str = "mean"):
+    return _reduce(jnp.abs(pred - target), reduction)
+
+
+def smooth_l1_loss(pred, target, delta: float = 1.0, reduction: str = "mean"):
+    d = jnp.abs(pred - target)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def kl_div(log_pred, target, reduction: str = "mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - log_pred)
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Attention + RoPE
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(q, k, v, mask=None, *, causal: bool = False,
+                                 scale: float | None = None,
+                                 dropout_p: float = 0.0, training: bool = False,
+                                 use_pallas: str = "auto"):
+    """Attention core, [B, T, H, D] layout.
+
+    The reference fuses this as ``operators/fused/multihead_matmul_op.cu``
+    (cuBLAS batched GEMM + softmax kernel). Here: einsum formulation that
+    XLA maps onto the MXU; on TPU with supported shapes it dispatches to the
+    Pallas flash-attention kernel (``paddle_tpu.ops.pallas.flash_attention``)
+    which never materializes the [T, T] matrix.
+
+    Supports grouped-query attention: k/v may have fewer heads than q as
+    long as q_heads % kv_heads == 0.
+    """
+    B, Tq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    if use_pallas != "never" and dropout_p == 0.0 and mask is None:
+        try:
+            from paddle_tpu.ops.pallas import flash_attention as _fa
+            if _fa.supported(q, k, v, causal=causal):
+                return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            pass
+        if use_pallas == "always":
+            raise RuntimeError("Pallas flash attention unavailable for these "
+                               "inputs")
+
+    if Hkv != Hq:  # GQA: repeat kv heads
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    Tk = k.shape[1]
+    if causal:
+        i = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        j = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        causal_mask = (j <= i + (Tk - Tq))
+        logits = jnp.where(causal_mask, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=training)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def rotary_embedding(positions, dim: int, base: float = 10000.0,
+                     dtype=jnp.float32):
+    """Compute RoPE cos/sin tables for integer positions, shape [..., dim/2]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x, cos, sin):
+    """Apply rotary embedding to [B, T, H, D] (cos/sin [B?, T, D/2])."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    if cos.ndim == x.ndim - 2:          # [T, D/2] → broadcast over B, H
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    elif cos.ndim == x.ndim - 1:        # [B, T, D/2]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Conv / pooling / image (reference operators/conv_cudnn_op.cu, pool_op.*)
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    """2D convolution. Weight layout [out_c, in_c/groups, kh, kw] (reference
+    layout); lax.conv_general_dilated lets XLA pick the TPU-optimal internal
+    layout regardless of the logical data_format."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        p = _pair(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if y.dtype != x.dtype:
+        y = y.astype(x.dtype)
+    if bias is not None:
+        shape = [1] * y.ndim
+        shape[1 if data_format == "NCHW" else -1] = bias.shape[0]
+        y = y + bias.reshape(shape)
+    return y
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCHW"):
+    return _pool(x, kernel_size, stride, padding, data_format,
+                 init=-jnp.inf, op=lax.max)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCHW", exclusive: bool = True):
+    """Average pooling. ``exclusive=True`` (reference default) divides each
+    window by the count of *real* (non-padded) elements."""
+    k = _pair(kernel_size)
+    summed = _pool(x, kernel_size, stride, padding, data_format,
+                   init=0.0, op=lax.add)
+    p = _pair(padding)
+    if exclusive and (p[0] or p[1]):
+        ones = jnp.ones_like(x)
+        counts = _pool(ones, kernel_size, stride, padding, data_format,
+                       init=0.0, op=lax.add)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+def _pool(x, kernel_size, stride, padding, data_format, init, op):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    return lax.reduce_window(x, init, op, window, strides, pads)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
+    out = _pair(output_size)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out[0] or w % out[1]:
+        raise ValueError("adaptive_avg_pool2d requires divisible sizes on TPU "
+                         "(static shapes); got "
+                         f"{(h, w)} -> {out}")
+    k = (h // out[0], w // out[1])
+    return avg_pool2d(x, k, stride=k, padding=0, data_format=data_format)
+
+
+def pad(x, paddings, mode: str = "constant", value: float = 0.0):
+    if mode == "constant":
+        return jnp.pad(x, paddings, constant_values=value)
+    return jnp.pad(x, paddings, mode=mode)
+
+
+def interpolate(x, scale_factor=None, size=None, mode: str = "nearest",
+                data_format: str = "NCHW"):
+    """Resize (reference ``operators/interpolate_op.*``)."""
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[mode]
+    if data_format == "NCHW":
+        shape = (n, c, size[0], size[1])
+    else:
+        shape = (n, size[0], size[1], c)
+    return jax.image.resize(x, shape, method=method)
+
+
+def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
+    """im2col (reference ``operators/math/im2col.cu``) — rarely needed on
+    TPU since XLA lowers conv directly, provided for API parity."""
+    k, s, p, d = _pair(kernel_size), _pair(stride), _pair(padding), _pair(dilation)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding="VALID",
+        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * k[0] * k[1], -1)

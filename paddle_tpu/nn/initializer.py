@@ -1,0 +1,114 @@
+"""Weight initializers — ``paddle.nn.initializer`` equivalent.
+
+Reference: ``python/paddle/fluid/initializer.py`` (ConstantInitializer,
+UniformInitializer, NormalInitializer, XavierInitializer, MSRAInitializer,
+TruncatedNormal...). Here initializers are plain callables
+``init(key, shape, dtype) -> Array``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Constant", "Uniform", "Normal", "TruncatedNormal",
+           "XavierUniform", "XavierNormal", "KaimingUniform", "KaimingNormal",
+           "zeros_", "ones_"]
+
+
+def _fans(shape, fan_hint=None):
+    if fan_hint is not None:
+        return fan_hint
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv [out_c, in_c, kh, kw]
+    receptive = math.prod(shape[2:])
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant:
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+def zeros_(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones_(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+class Uniform:
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class Normal:
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+class TruncatedNormal:
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+
+
+class XavierUniform:
+    def __init__(self, gain: float = 1.0, fan_hint=None):
+        self.gain, self.fan_hint = gain, fan_hint
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape, self.fan_hint)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class XavierNormal:
+    def __init__(self, gain: float = 1.0, fan_hint=None):
+        self.gain, self.fan_hint = gain, fan_hint
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape, self.fan_hint)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class KaimingUniform:
+    """MSRAInitializer (uniform) in the reference."""
+
+    def __init__(self, negative_slope: float = 0.0, fan_hint=None):
+        self.a, self.fan_hint = negative_slope, fan_hint
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape, self.fan_hint)
+        gain = math.sqrt(2.0 / (1.0 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class KaimingNormal:
+    def __init__(self, negative_slope: float = 0.0, fan_hint=None):
+        self.a, self.fan_hint = negative_slope, fan_hint
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape, self.fan_hint)
+        gain = math.sqrt(2.0 / (1.0 + self.a ** 2))
+        return (gain / math.sqrt(fan_in)) * jax.random.normal(key, shape, dtype)

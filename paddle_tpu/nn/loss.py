@@ -1,0 +1,86 @@
+"""Loss layers (module wrappers).
+
+Reference: ``python/paddle/nn/layer/loss.py`` backed by
+``operators/softmax_with_cross_entropy_op.cu`` etc.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+
+__all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+           "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss"]
+
+
+class CrossEntropyLoss(Module):
+    def __init__(self, *, soft_label: bool = False, ignore_index: int = -100,
+                 reduction: str = "mean", weight=None):
+        self.soft_label = bool(soft_label)
+        self.ignore_index = int(ignore_index)
+        self.reduction = reduction
+        self.weight = weight
+
+    def __call__(self, logits, label):
+        return F.cross_entropy(logits, label, self.soft_label,
+                               self.ignore_index, self.reduction, self.weight)
+
+
+class MSELoss(Module):
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def __call__(self, pred, target):
+        return F.mse_loss(pred, target, self.reduction)
+
+
+class L1Loss(Module):
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def __call__(self, pred, target):
+        return F.l1_loss(pred, target, self.reduction)
+
+
+class NLLLoss(Module):
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def __call__(self, log_probs, label):
+        return F.nll_loss(log_probs, label, self.reduction)
+
+
+class BCELoss(Module):
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def __call__(self, probs, label):
+        return F.binary_cross_entropy(probs, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Module):
+    def __init__(self, reduction: str = "mean", pos_weight=None):
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def __call__(self, logits, label):
+        return F.binary_cross_entropy_with_logits(logits, label,
+                                                  self.reduction,
+                                                  self.pos_weight)
+
+
+class SmoothL1Loss(Module):
+    def __init__(self, delta: float = 1.0, reduction: str = "mean"):
+        self.delta = float(delta)
+        self.reduction = reduction
+
+    def __call__(self, pred, target):
+        return F.smooth_l1_loss(pred, target, self.delta, self.reduction)
+
+
+class KLDivLoss(Module):
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def __call__(self, log_pred, target):
+        return F.kl_div(log_pred, target, self.reduction)

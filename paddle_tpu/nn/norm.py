@@ -1,0 +1,150 @@
+"""Normalization layers.
+
+Reference: ``python/paddle/nn/layer/norm.py`` backed by
+``operators/layer_norm_op.cu`` / ``operators/batch_norm_op.cu`` /
+``operators/group_norm_op.cu``. BatchNorm running statistics use the
+functional state-tape (see ``paddle_tpu.nn.stateful``) instead of the
+reference's in-place buffer mutation.
+
+TPU note: under pjit with a batch-sharded input, ``jnp.mean`` over the
+batch axis is a *global* mean (XLA inserts the cross-replica collective),
+so plain BatchNorm here already has SyncBatchNorm semantics
+(reference ``python/paddle/nn/layer/norm.py`` SyncBatchNorm → c_sync ops)
+— SyncBatchNorm is therefore an alias.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.stateful import new_uid, record_state
+
+__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+           "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm2D"]
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, *, epsilon: float = 1e-5,
+                 weight: bool = True, bias: bool = True, dtype=jnp.float32,
+                 pspec: P | None = None):
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = float(epsilon)
+        self.weight = jnp.ones(self.normalized_shape, dtype) if weight else None
+        self.bias = jnp.zeros(self.normalized_shape, dtype) if bias else None
+        if pspec is not None:
+            self._pspecs = (("weight", pspec), ("bias", pspec))
+
+    def __call__(self, x):
+        axes = tuple(range(-len(self.normalized_shape), 0))
+        return F.layer_norm(x, self.weight, self.bias, self.epsilon, axes)
+
+
+class RMSNorm(Module):
+    """Llama-family norm — no reference equivalent (predates it); included
+    because the flagship models need it."""
+
+    def __init__(self, dim: int, *, epsilon: float = 1e-6, dtype=jnp.float32,
+                 pspec: P | None = None):
+        self.weight = jnp.ones((dim,), dtype)
+        self.epsilon = float(epsilon)
+        if pspec is not None:
+            self._pspecs = (("weight", pspec),)
+
+    def __call__(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class BatchNorm(Module):
+    """N-dimensional batch norm over the channel axis.
+
+    Training mode computes batch statistics (global under pjit — see module
+    docstring), records updated running stats on the state tape, and
+    normalizes with batch stats. Eval mode uses running stats.
+    """
+
+    _nontrainable = ("running_mean", "running_var")
+
+    def __init__(self, num_features: int, *, momentum: float = 0.9,
+                 epsilon: float = 1e-5, data_format: str = "NCHW",
+                 dtype=jnp.float32):
+        self.num_features = int(num_features)
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.data_format = data_format
+        self.weight = jnp.ones((num_features,), dtype)
+        self.bias = jnp.zeros((num_features,), dtype)
+        self.running_mean = jnp.zeros((num_features,), jnp.float32)
+        self.running_var = jnp.ones((num_features,), jnp.float32)
+        self._uid = new_uid()
+
+    def __call__(self, x, training: bool = False):
+        c_axis = 1 if self.data_format == "NCHW" else x.ndim - 1
+        if training:
+            axes = tuple(a for a in range(x.ndim) if a != c_axis)
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+            m = self.momentum
+            record_state(
+                self._uid,
+                running_mean=m * self.running_mean + (1 - m) * mean,
+                running_var=m * self.running_var + (1 - m) * var,
+            )
+        else:
+            mean, var = self.running_mean, self.running_var
+        return F.batch_norm(x, mean, var, self.weight, self.bias,
+                            self.epsilon, self.data_format)
+
+
+class BatchNorm1D(BatchNorm):
+    pass
+
+
+class BatchNorm2D(BatchNorm):
+    pass
+
+
+class BatchNorm3D(BatchNorm):
+    pass
+
+
+# Under pjit, batch statistics are already global across the sharded batch
+# axis; see module docstring.
+SyncBatchNorm = BatchNorm2D
+
+
+class GroupNorm(Module):
+    def __init__(self, num_groups: int, num_channels: int, *,
+                 epsilon: float = 1e-5, data_format: str = "NCHW",
+                 dtype=jnp.float32):
+        self.num_groups = int(num_groups)
+        self.num_channels = int(num_channels)
+        self.epsilon = float(epsilon)
+        self.data_format = data_format
+        self.weight = jnp.ones((num_channels,), dtype)
+        self.bias = jnp.zeros((num_channels,), dtype)
+
+    def __call__(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon, self.data_format)
+
+
+class InstanceNorm2D(Module):
+    def __init__(self, num_features: int, *, epsilon: float = 1e-5,
+                 dtype=jnp.float32):
+        self.num_features = int(num_features)
+        self.epsilon = float(epsilon)
+        self.weight = jnp.ones((num_features,), dtype)
+        self.bias = jnp.zeros((num_features,), dtype)
+
+    def __call__(self, x):
+        # instance norm = group norm with one group per channel
+        return F.group_norm(x, self.num_features, self.weight, self.bias,
+                            self.epsilon, "NCHW")

@@ -1,0 +1,155 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Reference: ``python/paddle/nn/layer/rnn.py`` backed by
+``operators/cudnn_lstm_op.cu.cc`` and the fluid math lstm/gru compute
+(``operators/math/lstm_compute.*``). TPU-native formulation: the recurrence
+is a ``lax.scan`` over time with the four gate matmuls batched into one MXU
+matmul per step; XLA unrolls nothing, keeping compile time flat in sequence
+length.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+__all__ = ["LSTMCell", "GRUCell", "SimpleRNNCell", "RNN", "LSTM", "GRU"]
+
+
+class SimpleRNNCell(Module):
+    def __init__(self, input_size: int, hidden_size: int, *,
+                 activation: str = "tanh", dtype=jnp.float32, key=None):
+        k1, k2 = rng.split_key(key)
+        winit = I.XavierUniform()
+        self.weight_ih = winit(k1, (input_size, hidden_size), dtype)
+        self.weight_hh = winit(k2, (hidden_size, hidden_size), dtype)
+        self.bias = jnp.zeros((hidden_size,), dtype)
+        self.hidden_size = int(hidden_size)
+        self.activation = activation
+
+    def init_state(self, batch_size: int, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def __call__(self, x, h):
+        act = jnp.tanh if self.activation == "tanh" else F.relu
+        h_new = act(x @ self.weight_ih + h @ self.weight_hh + self.bias)
+        return h_new, h_new
+
+
+class LSTMCell(Module):
+    def __init__(self, input_size: int, hidden_size: int, *, dtype=jnp.float32,
+                 key=None):
+        k1, k2 = rng.split_key(key)
+        winit = I.XavierUniform()
+        # gates packed [i, f, g, o] — one matmul per step feeds the MXU
+        self.weight_ih = winit(k1, (input_size, 4 * hidden_size), dtype)
+        self.weight_hh = winit(k2, (hidden_size, 4 * hidden_size), dtype)
+        self.bias = jnp.zeros((4 * hidden_size,), dtype)
+        self.hidden_size = int(hidden_size)
+
+    def init_state(self, batch_size: int, dtype=jnp.float32):
+        z = jnp.zeros((batch_size, self.hidden_size), dtype)
+        return (z, z)
+
+    def __call__(self, x, state):
+        h, c = state
+        gates = x @ self.weight_ih + h @ self.weight_hh + self.bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = F.sigmoid(f) * c + F.sigmoid(i) * jnp.tanh(g)
+        h_new = F.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Module):
+    def __init__(self, input_size: int, hidden_size: int, *, dtype=jnp.float32,
+                 key=None):
+        k1, k2 = rng.split_key(key)
+        winit = I.XavierUniform()
+        self.weight_ih = winit(k1, (input_size, 3 * hidden_size), dtype)
+        self.weight_hh = winit(k2, (hidden_size, 3 * hidden_size), dtype)
+        self.bias_ih = jnp.zeros((3 * hidden_size,), dtype)
+        self.bias_hh = jnp.zeros((3 * hidden_size,), dtype)
+        self.hidden_size = int(hidden_size)
+
+    def init_state(self, batch_size: int, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def __call__(self, x, h):
+        gi = x @ self.weight_ih + self.bias_ih
+        gh = h @ self.weight_hh + self.bias_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = F.sigmoid(i_r + h_r)
+        z = F.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new
+
+
+class RNN(Module):
+    """Run a cell over time via lax.scan (reference ``paddle.nn.RNN``).
+    Input [B, T, C] (time_major=False) like the reference default."""
+
+    def __init__(self, cell: Module, time_major: bool = False):
+        self.cell = cell
+        self.time_major = bool(time_major)
+
+    def __call__(self, x, initial_state=None):
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)  # [T, B, C]
+        if initial_state is None:
+            initial_state = self.cell.init_state(x.shape[1], x.dtype)
+        cell = self.cell
+
+        def step(state, xt):
+            out, new_state = cell(xt, state)
+            return new_state, out
+
+        final_state, outs = lax.scan(step, initial_state, x)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final_state
+
+
+class _MultiLayerRNN(Module):
+    def __init__(self, cell_type, input_size: int, hidden_size: int,
+                 num_layers: int = 1, *, time_major: bool = False,
+                 dtype=jnp.float32, key=None):
+        keys = rng.split_key(key, num_layers)
+        cells = []
+        for i in range(num_layers):
+            in_size = input_size if i == 0 else hidden_size
+            cells.append(cell_type(in_size, hidden_size, dtype=dtype,
+                                   key=keys[i]))
+        self.rnns = tuple(RNN(c, time_major=time_major) for c in cells)
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+
+    def __call__(self, x, initial_states=None):
+        states = []
+        out = x
+        for i, layer in enumerate(self.rnns):
+            init = initial_states[i] if initial_states is not None else None
+            out, st = layer(out, init)
+            states.append(st)
+        return out, states
+
+
+class LSTM(_MultiLayerRNN):
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 *, time_major: bool = False, dtype=jnp.float32, key=None):
+        super().__init__(LSTMCell, input_size, hidden_size, num_layers,
+                         time_major=time_major, dtype=dtype, key=key)
+
+
+class GRU(_MultiLayerRNN):
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 *, time_major: bool = False, dtype=jnp.float32, key=None):
+        super().__init__(GRUCell, input_size, hidden_size, num_layers,
+                         time_major=time_major, dtype=dtype, key=key)

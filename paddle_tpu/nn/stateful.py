@@ -1,0 +1,94 @@
+"""State tape: jit-safe functional updates for stateful layers (BatchNorm).
+
+The reference mutates running statistics in-place inside the CUDA batch-norm
+kernel (reference ``operators/batch_norm_op.cu``, in/out MeanOut/VarianceOut
+share buffers with the inputs). A functional framework can't mutate, so:
+stateful layers carry a unique static ``_uid`` and, during a training-mode
+forward, record their new statistics on an ambient *tape*; the trainer (all
+inside the same jit trace) merges the tape back into the model pytree:
+
+    with state_tape() as tape:
+        y = model(x, training=True)
+    model = merge_state(model, tape)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from contextvars import ContextVar
+
+from paddle_tpu.core.module import Module
+
+_uid_counter = itertools.count()
+_uid_lock = threading.Lock()
+
+_tape_var: ContextVar[dict | None] = ContextVar("ptpu_state_tape", default=None)
+
+
+def new_uid() -> int:
+    with _uid_lock:
+        return next(_uid_counter)
+
+
+@contextlib.contextmanager
+def state_tape():
+    tape: dict[int, dict] = {}
+    token = _tape_var.set(tape)
+    try:
+        yield tape
+    finally:
+        _tape_var.reset(token)
+
+
+def record_state(uid: int, **updates) -> bool:
+    """Record new state arrays for the module with the given uid. Returns
+    False if no tape is active (eval mode / user skipped the tape)."""
+    tape = _tape_var.get()
+    if tape is None:
+        return False
+    tape[uid] = updates
+    return True
+
+
+def map_modules(fn, tree):
+    """Bottom-up map over every Module in a pytree (children first)."""
+
+    def rec(obj):
+        if isinstance(obj, Module):
+            changes = {}
+            for name, value in list(obj.__dict__.items()):
+                new = rec(value)
+                if new is not value:
+                    changes[name] = new
+            out = obj.replace(**changes) if changes else obj
+            return fn(out)
+        if isinstance(obj, (list, tuple)):
+            vals = [rec(v) for v in obj]
+            if all(a is b for a, b in zip(vals, obj)):
+                return obj
+            return type(obj)(vals)
+        if isinstance(obj, dict):
+            vals = {k: rec(v) for k, v in obj.items()}
+            if all(vals[k] is obj[k] for k in obj):
+                return obj
+            return vals
+        return obj
+
+    return rec(tree)
+
+
+def merge_state(model, tape: dict):
+    """Return a copy of ``model`` with taped state merged in (matched by
+    each stateful module's static ``_uid``)."""
+    if not tape:
+        return model
+
+    def fn(m):
+        uid = getattr(m, "_uid", None)
+        if uid is not None and uid in tape:
+            return m.replace(**tape[uid])
+        return m
+
+    return map_modules(fn, model)

@@ -1,0 +1,182 @@
+"""Transformer encoder/decoder layers.
+
+Reference: ``python/paddle/nn/layer/transformer.py``
+(TransformerEncoderLayer/TransformerEncoder/TransformerDecoderLayer/
+TransformerDecoder/Transformer). The reference *clones* a prototype layer
+``num_layers`` times; here the containers take a builder callable so each
+layer gets fresh parameters, which is the natural functional formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.attention import MultiHeadAttention
+from paddle_tpu.nn.common import Linear, Dropout
+from paddle_tpu.nn.norm import LayerNorm
+
+__all__ = ["TransformerEncoderLayer", "TransformerEncoder",
+           "TransformerDecoderLayer", "TransformerDecoder", "Transformer"]
+
+_ACTS = {"relu": F.relu, "gelu": F.gelu, "silu": F.silu}
+
+
+class TransformerEncoderLayer(Module):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int, *,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout: float | None = None,
+                 act_dropout: float | None = None,
+                 normalize_before: bool = False, dtype=jnp.float32, key=None):
+        keys = rng.split_key(key, 3)
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None
+            else dropout, dtype=dtype, key=keys[0])
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype, key=keys[1])
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype, key=keys[2])
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(act_dropout if act_dropout is not None
+                                   else dropout)
+        self.activation = activation
+        self.normalize_before = bool(normalize_before)
+
+    def __call__(self, src, mask=None, training: bool = False):
+        act = _ACTS[self.activation]
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = self.self_attn(x, mask=mask, training=training)
+        x = residual + self.dropout1(x, training=training)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.act_dropout(act(self.linear1(y)),
+                                          training=training))
+        y = residual + self.dropout2(y, training=training)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y
+
+
+class TransformerEncoder(Module):
+    def __init__(self, layer_builder: Callable[[], Module] | Module,
+                 num_layers: int, norm: Module | None = None):
+        if isinstance(layer_builder, Module):
+            raise TypeError(
+                "pass a builder callable (e.g. lambda: "
+                "TransformerEncoderLayer(...)) so each layer gets fresh "
+                "parameters; the reference clones a prototype instead")
+        self.layers = tuple(layer_builder() for _ in range(num_layers))
+        self.norm = norm
+        self.num_layers = int(num_layers)
+
+    def __call__(self, src, mask=None, training: bool = False):
+        x = src
+        for layer in self.layers:
+            x = layer(x, mask=mask, training=training)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class TransformerDecoderLayer(Module):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int, *,
+                 dropout: float = 0.1, activation: str = "relu",
+                 normalize_before: bool = False, dtype=jnp.float32, key=None):
+        keys = rng.split_key(key, 4)
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            dtype=dtype, key=keys[0])
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                             dtype=dtype, key=keys[1])
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype, key=keys[2])
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype, key=keys[3])
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.norm3 = LayerNorm(d_model, dtype=dtype)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = activation
+        self.normalize_before = bool(normalize_before)
+
+    def __call__(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                 training: bool = False):
+        act = _ACTS[self.activation]
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = self.self_attn(x, mask=tgt_mask, causal=tgt_mask is None,
+                           training=training)
+        x = residual + self.dropout1(x, training=training)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.cross_attn(y, memory, memory, mask=memory_mask,
+                            training=training)
+        y = residual + self.dropout2(y, training=training)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = self.linear2(act(self.linear1(z)))
+        z = residual + self.dropout3(z, training=training)
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Module):
+    def __init__(self, layer_builder: Callable[[], Module], num_layers: int,
+                 norm: Module | None = None):
+        self.layers = tuple(layer_builder() for _ in range(num_layers))
+        self.norm = norm
+        self.num_layers = int(num_layers)
+
+    def __call__(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                 training: bool = False):
+        x = tgt
+        for layer in self.layers:
+            x = layer(x, memory, tgt_mask=tgt_mask, memory_mask=memory_mask,
+                      training=training)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class Transformer(Module):
+    """Full encoder-decoder transformer (reference ``paddle.nn.Transformer``)."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu", normalize_before: bool = False,
+                 dtype=jnp.float32, key=None):
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout=dropout,
+                activation=activation, normalize_before=normalize_before,
+                dtype=dtype),
+            num_encoder_layers,
+            norm=LayerNorm(d_model, dtype=dtype) if normalize_before else None)
+        self.decoder = TransformerDecoder(
+            lambda: TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout=dropout,
+                activation=activation, normalize_before=normalize_before,
+                dtype=dtype),
+            num_decoder_layers,
+            norm=LayerNorm(d_model, dtype=dtype) if normalize_before else None)
+        self.d_model = int(d_model)
+        self.nhead = int(nhead)
+
+    def __call__(self, src, tgt, src_mask=None, tgt_mask=None,
+                 memory_mask=None, training: bool = False):
+        memory = self.encoder(src, mask=src_mask, training=training)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask, training=training)

@@ -1,0 +1,43 @@
+"""paddle_tpu.optimizer — optimizers, LR schedules, gradient clips.
+
+Mirrors ``paddle.optimizer`` (reference ``python/paddle/optimizer/``).
+"""
+
+from paddle_tpu.optimizer import lr
+from paddle_tpu.optimizer import transform
+from paddle_tpu.optimizer.optimizers import (
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    Optimizer, RMSProp,
+)
+from paddle_tpu.optimizer.transform import (
+    GradientTransformation, apply_if_finite, chain, clip_by_global_norm,
+    clip_by_norm, clip_by_value, global_norm,
+)
+
+# paddle-style clip classes (reference python/paddle/fluid/clip.py)
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def transform(self):
+        return clip_by_global_norm(self.clip_norm)
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def transform(self):
+        return clip_by_norm(self.clip_norm)
+
+
+class ClipGradByValue:
+    def __init__(self, max: float, min: float | None = None):
+        # reference semantics: clip to [min, max]; default min = -max
+        self.max = float(max)
+        self.min = float(min) if min is not None else None
+
+    def transform(self):
+        return clip_by_value(self.max, self.min)

@@ -1,0 +1,184 @@
+"""Learning-rate schedules.
+
+Reference: ``python/paddle/optimizer/lr.py`` (LRScheduler and its 14
+subclasses). TPU-native formulation: schedules are pure functions of the
+*step counter array* so they trace into the jitted update — no host-side
+``scheduler.step()`` mutation (which would force a recompile per epoch).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "NoamDecay", "ExponentialDecay", "NaturalExpDecay",
+           "InverseTimeDecay", "PolynomialDecay", "PiecewiseDecay",
+           "CosineAnnealingDecay", "LinearWarmup", "StepDecay",
+           "MultiStepDecay", "LambdaDecay", "warmup_cosine", "constant"]
+
+
+class LRScheduler:
+    """Base: a callable step -> lr. Subclasses implement ``get_lr``."""
+
+    def __init__(self, learning_rate: float = 0.1):
+        self.base_lr = float(learning_rate)
+
+    def __call__(self, step):
+        return self.get_lr(jnp.asarray(step, jnp.float32))
+
+    def get_lr(self, step):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def constant(lr: float) -> Callable:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model: int, warmup_steps: int,
+                 learning_rate: float = 1.0):
+        super().__init__(learning_rate)
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+
+    def get_lr(self, step):
+        step = jnp.maximum(step, 1.0)
+        a = step ** -0.5
+        b = step * self.warmup_steps ** -1.5
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(a, b)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 decay_steps: int = 1):
+        super().__init__(learning_rate)
+        self.gamma, self.decay_steps = gamma, decay_steps
+
+    def get_lr(self, step):
+        return self.base_lr * self.gamma ** (step / self.decay_steps)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float):
+        super().__init__(learning_rate)
+        self.gamma = gamma
+
+    def get_lr(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float):
+        super().__init__(learning_rate)
+        self.gamma = gamma
+
+    def get_lr(self, step):
+        return self.base_lr / (1.0 + self.gamma * step)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0,
+                 cycle: bool = False):
+        super().__init__(learning_rate)
+        self.decay_steps, self.end_lr = decay_steps, end_lr
+        self.power, self.cycle = power, cycle
+
+    def get_lr(self, step):
+        if self.cycle:
+            decay_steps = self.decay_steps * jnp.ceil(
+                jnp.maximum(step, 1.0) / self.decay_steps)
+        else:
+            decay_steps = self.decay_steps
+            step = jnp.minimum(step, decay_steps)
+        frac = (1.0 - step / decay_steps) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float]):
+        super().__init__(values[0])
+        self.boundaries = tuple(boundaries)
+        self.values = tuple(values)
+
+    def get_lr(self, step):
+        lr = jnp.asarray(self.values[0], jnp.float32)
+        for b, v in zip(self.boundaries, self.values[1:]):
+            lr = jnp.where(step >= b, v, lr)
+        return lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, t_max: int, eta_min: float = 0.0):
+        super().__init__(learning_rate)
+        self.t_max, self.eta_min = t_max, eta_min
+
+    def get_lr(self, step):
+        cos = jnp.cos(math.pi * jnp.minimum(step, self.t_max) / self.t_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class LinearWarmup(LRScheduler):
+    """Wrap another schedule (or constant) with linear warmup
+    (reference ``paddle.optimizer.lr.LinearWarmup``)."""
+
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float = 0.0,
+                 end_lr: float | None = None):
+        base = learning_rate if isinstance(learning_rate, (int, float)) else 0.0
+        super().__init__(base)
+        self.inner = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+
+    def get_lr(self, step):
+        if callable(self.inner):
+            after = self.inner(jnp.maximum(step - self.warmup_steps, 0.0))
+            end = self.end_lr if self.end_lr is not None else self.inner(0.0)
+        else:
+            after = jnp.asarray(self.inner, jnp.float32)
+            end = self.end_lr if self.end_lr is not None else self.inner
+        frac = jnp.minimum(step / jnp.maximum(self.warmup_steps, 1), 1.0)
+        warm = self.start_lr + (end - self.start_lr) * frac
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int,
+                 gamma: float = 0.1):
+        super().__init__(learning_rate)
+        self.step_size, self.gamma = step_size, gamma
+
+    def get_lr(self, step):
+        return self.base_lr * self.gamma ** jnp.floor(step / self.step_size)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones: Sequence[int],
+                 gamma: float = 0.1):
+        super().__init__(learning_rate)
+        self.milestones = tuple(milestones)
+        self.gamma = gamma
+
+    def get_lr(self, step):
+        count = sum(jnp.where(step >= m, 1.0, 0.0) for m in self.milestones)
+        return self.base_lr * self.gamma ** count
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda: Callable):
+        super().__init__(learning_rate)
+        self.lr_lambda = lr_lambda
+
+    def get_lr(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr: float = 0.0) -> Callable:
+    """The standard LLM pretraining schedule."""
+    return LinearWarmup(
+        CosineAnnealingDecay(peak_lr, max(total_steps - warmup_steps, 1),
+                             end_lr),
+        warmup_steps, start_lr=0.0, end_lr=peak_lr)

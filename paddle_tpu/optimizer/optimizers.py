@@ -1,0 +1,206 @@
+"""Paddle-style optimizer classes over the functional core.
+
+Reference: ``python/paddle/optimizer/__init__.py`` (SGD, Momentum, Adam,
+AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb) and
+``python/paddle/fluid/optimizer.py`` (LarsMomentum ``:1603``,
+Lamb ``:2960``). Usage is functional:
+
+    opt = AdamW(learning_rate=3e-4, weight_decay=0.1)
+    state = opt.init(model)
+    updates, state = opt.update(grads, state, model)
+    model = apply_updates(model, updates)
+
+or in one shot ``model, state = opt.apply_gradients(model, grads, state)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import apply_updates
+from paddle_tpu.optimizer import transform as T
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum"]
+
+
+def _as_schedule(lr) -> Callable:
+    if callable(lr):
+        return lr
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+class Optimizer:
+    """Wraps a transformation chain; subclasses define ``_build``."""
+
+    def __init__(self, learning_rate=0.001, *, grad_clip=None,
+                 weight_decay: float = 0.0, multi_precision: bool = True,
+                 **kwargs):
+        self.learning_rate = learning_rate
+        self.grad_clip = grad_clip
+        self.weight_decay = float(weight_decay)
+        self.multi_precision = multi_precision  # moments always fp32 here
+        transforms = []
+        if grad_clip is not None:
+            transforms.append(grad_clip if isinstance(
+                grad_clip, T.GradientTransformation) else grad_clip.transform())
+        transforms.extend(self._build(**kwargs))
+        transforms.append(
+            T.scale_by_schedule(_as_schedule(learning_rate)))
+        self._tx = T.chain(*transforms)
+
+    def _build(self, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def init(self, params) -> Any:
+        return self._tx.init(params)
+
+    def update(self, grads, state, params=None):
+        return self._tx.update(grads, state, params)
+
+    def apply_gradients(self, params, grads, state):
+        updates, state = self._tx.update(grads, state, params)
+        return apply_updates(params, updates), state
+
+
+class SGD(Optimizer):
+    def _build(self):
+        out = []
+        if self.weight_decay:
+            out.append(T.add_decayed_weights(self.weight_decay))
+        return out
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 use_nesterov: bool = False, **kwargs):
+        self._momentum, self._nesterov = momentum, use_nesterov
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        out = []
+        if self.weight_decay:
+            out.append(T.add_decayed_weights(self.weight_decay))
+        out.append(T.trace(self._momentum, self._nesterov))
+        return out
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kwargs):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        out = []
+        if self.weight_decay:
+            # L2 regularization: wd*p joins the *gradient* before moment
+            # accumulation (reference Adam semantics; AdamW decouples it)
+            out.append(T.add_decayed_weights(self.weight_decay))
+        out.append(T.scale_by_adam(self._b1, self._b2, self._eps))
+        return out
+
+
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference ``python/paddle/optimizer/adamw.py``).
+    ``apply_decay_param_fun``/mask: decay only where mask is True (the
+    reference excludes LayerNorm/bias via that callback)."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.01, decay_mask=None, **kwargs):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._decay_mask = decay_mask
+        super().__init__(learning_rate, weight_decay=weight_decay, **kwargs)
+
+    def _build(self):
+        out = [T.scale_by_adam(self._b1, self._b2, self._eps)]
+        if self.weight_decay:
+            out.append(T.add_decayed_weights(self.weight_decay,
+                                             self._decay_mask))
+        return out
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kwargs):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        return [T.scale_by_adamax(self._b1, self._b2, self._eps)]
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kwargs):
+        self._eps, self._init_acc = epsilon, initial_accumulator_value
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        return [T.scale_by_adagrad(self._eps, self._init_acc)]
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho: float = 0.95,
+                 epsilon: float = 1e-6, **kwargs):
+        self._rho, self._eps = rho, epsilon
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        return [T.scale_by_adadelta(self._rho, self._eps)]
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, **kwargs):
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        return [T.scale_by_rms(self._rho, self._eps, self._momentum,
+                               self._centered)]
+
+
+class Lamb(Optimizer):
+    """Layer-adaptive large-batch optimizer
+    (reference ``fluid/optimizer.py:2960`` LambOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-6, **kwargs):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        out = [T.scale_by_adam(self._b1, self._b2, self._eps)]
+        if self._lamb_wd:
+            out.append(T.add_decayed_weights(self._lamb_wd))
+        out.append(T.scale_by_lamb_trust())
+        return out
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference ``fluid/optimizer.py:1603`` LarsMomentumOptimizer,
+    CUDA kernel ``optimizers/lars_momentum_op.cu``)."""
+
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 lars_coeff: float = 0.001, lars_weight_decay: float = 0.0005,
+                 **kwargs):
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        out = []
+        if self._lars_wd:
+            out.append(T.add_decayed_weights(self._lars_wd))
+        out.append(T.scale_by_lars_trust(self._coeff))
+        out.append(T.trace(self._momentum))
+        return out

@@ -1,0 +1,377 @@
+"""Functional optimizer core: composable gradient transformations.
+
+The reference implements optimizer updates as per-parameter CUDA kernels
+(reference ``paddle/fluid/operators/optimizers/adam_op.cu``,
+``momentum_op.*``, ``lamb_op.*``, ``lars_momentum_op.cu``) driven by a
+Python Optimizer that appends them to the program
+(``python/paddle/fluid/optimizer.py``). The TPU-native design is pure
+update functions over the parameter pytree — XLA fuses the whole update
+into a handful of elementwise kernels, and under pjit the update runs
+sharded exactly like the parameters (which is what makes ZeRO stage-1
+free: shard the optimizer state's pspec and the update follows).
+
+API shape: ``init(params) -> state``; ``update(grads, state, params) ->
+(updates, new_state)``; compose with :func:`chain`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradientTransformation", "chain", "identity", "scale",
+    "scale_by_schedule", "trace", "scale_by_adam", "scale_by_adamax",
+    "scale_by_rms", "scale_by_adadelta", "scale_by_adagrad", "scale_by_lamb_trust",
+    "add_decayed_weights", "clip_by_global_norm", "clip_by_norm",
+    "clip_by_value", "apply_if_finite", "global_norm", "scale_by_lars_trust",
+]
+
+
+class GradientTransformation(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], tuple[Any, Any]]
+
+
+def _map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def identity() -> GradientTransformation:
+    return GradientTransformation(lambda p: (), lambda g, s, p=None: (g, s))
+
+
+def chain(*transforms: GradientTransformation) -> GradientTransformation:
+    def init(params):
+        return tuple(t.init(params) for t in transforms)
+
+    def update(grads, state, params=None):
+        new_state = []
+        for t, s in zip(transforms, state):
+            grads, s = t.update(grads, s, params)
+            new_state.append(s)
+        return grads, tuple(new_state)
+
+    return GradientTransformation(init, update)
+
+
+def scale(factor: float) -> GradientTransformation:
+    return GradientTransformation(
+        lambda p: (),
+        lambda g, s, p=None: (_map(lambda x: x * factor, g), s))
+
+
+class ScheduleState(NamedTuple):
+    count: jnp.ndarray
+
+
+def scale_by_schedule(schedule: Callable[[jnp.ndarray], jnp.ndarray],
+                      flip_sign: bool = True) -> GradientTransformation:
+    """Multiply updates by -schedule(step) (the learning-rate application)."""
+    sign = -1.0 if flip_sign else 1.0
+
+    def init(params):
+        return ScheduleState(jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        lr = schedule(state.count)
+        out = _map(lambda g: sign * lr * g, grads)
+        return out, ScheduleState(state.count + 1)
+
+    return GradientTransformation(init, update)
+
+
+class TraceState(NamedTuple):
+    momentum: Any
+
+
+def trace(decay: float, nesterov: bool = False) -> GradientTransformation:
+    """Momentum accumulator (reference ``operators/optimizers/momentum_op``)."""
+
+    def init(params):
+        return TraceState(_map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None):
+        m = _map(lambda g, t: g + decay * t, grads, state.momentum)
+        if nesterov:
+            out = _map(lambda g, t: g + decay * t, grads, m)
+        else:
+            out = m
+        return out, TraceState(m)
+
+    return GradientTransformation(init, update)
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def scale_by_adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                  eps_root: float = 0.0) -> GradientTransformation:
+    """Adam moment scaling (reference ``operators/optimizers/adam_op.cu``).
+    Moments are kept in fp32 regardless of param dtype (matches the
+    reference's master-weight AMP path, ``optimizers/adam_op.h`` fp32 path)."""
+
+    def init(params):
+        mu = _map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        nu = _map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+        return AdamState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        mu = _map(lambda g, m: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                  grads, state.mu)
+        nu = _map(lambda g, v: b2 * v + (1 - b2) * jnp.square(
+            g.astype(jnp.float32)), grads, state.nu)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+        out = _map(
+            lambda m, v, g: (m / c1 / (jnp.sqrt(v / c2 + eps_root) + eps)
+                             ).astype(g.dtype),
+            mu, nu, grads)
+        return out, AdamState(count, mu, nu)
+
+    return GradientTransformation(init, update)
+
+
+def scale_by_adamax(b1: float = 0.9, b2: float = 0.999,
+                    eps: float = 1e-8) -> GradientTransformation:
+    def init(params):
+        mu = _map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        nu = _map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return AdamState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        mu = _map(lambda g, m: b1 * m + (1 - b1) * g.astype(jnp.float32),
+                  grads, state.mu)
+        nu = _map(lambda g, v: jnp.maximum(b2 * v, jnp.abs(
+            g.astype(jnp.float32))), grads, state.nu)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        out = _map(lambda m, v, g: (m / c1 / (v + eps)).astype(g.dtype),
+                   mu, nu, grads)
+        return out, AdamState(count, mu, nu)
+
+    return GradientTransformation(init, update)
+
+
+class RMSState(NamedTuple):
+    nu: Any
+    mom: Any
+    mg: Any
+
+
+def scale_by_rms(rho: float = 0.95, eps: float = 1e-6,
+                 momentum: float = 0.0, centered: bool = False
+                 ) -> GradientTransformation:
+    """RMSProp (reference ``operators/optimizers/rmsprop_op``). ``centered``
+    subtracts the running gradient mean from the second moment (the
+    reference's centered=True path)."""
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, jnp.float32)
+        return RMSState(_map(z, params), _map(z, params), _map(z, params))
+
+    def update(grads, state, params=None):
+        nu = _map(lambda g, v: rho * v + (1 - rho) * jnp.square(
+            g.astype(jnp.float32)), grads, state.nu)
+        if centered:
+            mg = _map(lambda g, m: rho * m + (1 - rho) * g.astype(jnp.float32),
+                      grads, state.mg)
+            denom = _map(lambda v, m: jnp.sqrt(v - jnp.square(m) + eps),
+                         nu, mg)
+        else:
+            mg = state.mg
+            denom = _map(lambda v: jnp.sqrt(v) + eps, nu)
+        scaled = _map(lambda g, d: g.astype(jnp.float32) / d, grads, denom)
+        if momentum > 0.0:
+            mom = _map(lambda s, m: momentum * m + s, scaled, state.mom)
+            out = mom
+        else:
+            mom = state.mom
+            out = scaled
+        out = _map(lambda o, g: o.astype(g.dtype), out, grads)
+        return out, RMSState(nu, mom, mg)
+
+    return GradientTransformation(init, update)
+
+
+class AdagradState(NamedTuple):
+    sum_sq: Any
+
+
+def scale_by_adagrad(eps: float = 1e-6,
+                     initial_accumulator: float = 0.0) -> GradientTransformation:
+    def init(params):
+        return AdagradState(_map(
+            lambda p: jnp.full_like(p, initial_accumulator, jnp.float32),
+            params))
+
+    def update(grads, state, params=None):
+        s = _map(lambda g, a: a + jnp.square(g.astype(jnp.float32)),
+                 grads, state.sum_sq)
+        out = _map(lambda g, a: (g.astype(jnp.float32)
+                                 / (jnp.sqrt(a) + eps)).astype(g.dtype),
+                   grads, s)
+        return out, AdagradState(s)
+
+    return GradientTransformation(init, update)
+
+
+class AdadeltaState(NamedTuple):
+    acc_grad: Any
+    acc_update: Any
+
+
+def scale_by_adadelta(rho: float = 0.95,
+                      eps: float = 1e-6) -> GradientTransformation:
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, jnp.float32)
+        return AdadeltaState(_map(z, params), _map(z, params))
+
+    def update(grads, state, params=None):
+        acc_g = _map(lambda g, a: rho * a + (1 - rho) * jnp.square(
+            g.astype(jnp.float32)), grads, state.acc_grad)
+        upd = _map(
+            lambda g, ag, au: (jnp.sqrt(au + eps) / jnp.sqrt(ag + eps)
+                               ) * g.astype(jnp.float32),
+            grads, acc_g, state.acc_update)
+        acc_u = _map(lambda u, a: rho * a + (1 - rho) * jnp.square(u),
+                     upd, state.acc_update)
+        out = _map(lambda u, g: u.astype(g.dtype), upd, grads)
+        return out, AdadeltaState(acc_g, acc_u)
+
+    return GradientTransformation(init, update)
+
+
+def add_decayed_weights(weight_decay: float,
+                        mask: Any | None = None) -> GradientTransformation:
+    """Decoupled weight decay (AdamW; reference ``optimizers/adamw`` via
+    AdamW python wrapper). ``mask``: pytree of bools, True where decayed."""
+
+    def init(params):
+        return ()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("add_decayed_weights needs params")
+        if mask is None:
+            out = _map(lambda g, p: g + weight_decay * p.astype(g.dtype),
+                       grads, params)
+        else:
+            out = _map(
+                lambda g, p, m: g + weight_decay * p.astype(g.dtype)
+                if m else g, grads, params, mask)
+        return out, state
+
+    return GradientTransformation(init, update)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))
+
+
+def clip_by_global_norm(max_norm: float) -> GradientTransformation:
+    """Reference ``ClipGradByGlobalNorm``
+    (``python/paddle/fluid/clip.py`` GradientClipByGlobalNorm)."""
+
+    def update(grads, state, params=None):
+        norm = global_norm(grads)
+        factor = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return _map(lambda g: (g.astype(jnp.float32) * factor
+                               ).astype(g.dtype), grads), state
+
+    return GradientTransformation(lambda p: (), update)
+
+
+def clip_by_norm(max_norm: float) -> GradientTransformation:
+    """Per-tensor norm clip (reference GradientClipByNorm)."""
+
+    def update(grads, state, params=None):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * factor).astype(g.dtype)
+        return _map(clip_one, grads), state
+
+    return GradientTransformation(lambda p: (), update)
+
+
+def clip_by_value(max_value: float,
+                  min_value: float | None = None) -> GradientTransformation:
+    """Clip grads to [min_value, max_value] (default min = -max, reference
+    GradientClipByValue semantics)."""
+    lo = -max_value if min_value is None else min_value
+
+    def update(grads, state, params=None):
+        return _map(lambda g: jnp.clip(g, lo, max_value), grads), state
+
+    return GradientTransformation(lambda p: (), update)
+
+
+def _trust_ratio_update(grads, params, trust_fn):
+    def one(g, p):
+        pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        gn = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        ratio = trust_fn(pn, gn)
+        return (g.astype(jnp.float32) * ratio).astype(g.dtype)
+    return _map(one, grads, params)
+
+
+def scale_by_lars_trust(coeff: float = 0.001,
+                        eps: float = 0.0) -> GradientTransformation:
+    """LARS local-lr trust ratio (reference ``optimizers/lars_momentum_op.cu``)."""
+
+    def update(grads, state, params=None):
+        out = _trust_ratio_update(
+            grads, params,
+            lambda pn, gn: jnp.where(
+                (pn > 0) & (gn > 0), coeff * pn / (gn + eps * pn + 1e-12), 1.0))
+        return out, state
+
+    return GradientTransformation(lambda p: (), update)
+
+
+def scale_by_lamb_trust() -> GradientTransformation:
+    """LAMB trust ratio (reference ``optimizers/lamb_op.h``)."""
+
+    def update(grads, state, params=None):
+        out = _trust_ratio_update(
+            grads, params,
+            lambda pn, gn: jnp.where((pn > 0) & (gn > 0), pn / gn, 1.0))
+        return out, state
+
+    return GradientTransformation(lambda p: (), update)
+
+
+class ApplyIfFiniteState(NamedTuple):
+    inner: Any
+    notfinite_count: jnp.ndarray
+
+
+def apply_if_finite(inner: GradientTransformation) -> GradientTransformation:
+    """Skip the update when grads contain NaN/Inf — the dynamic-loss-scaling
+    companion (reference ``check_finite_and_unscale`` +
+    ``update_loss_scaling`` ops, ``operators/amp/``)."""
+
+    def init(params):
+        return ApplyIfFiniteState(inner.init(params), jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        isfinite = jnp.all(jnp.stack([
+            jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)
+        ]))
+        upd, new_inner = inner.update(grads, state.inner, params)
+        upd = _map(lambda u: jnp.where(isfinite, u, jnp.zeros_like(u)), upd)
+        new_inner = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(isfinite, n, o), new_inner, state.inner)
+        count = state.notfinite_count + jnp.where(isfinite, 0, 1)
+        return upd, ApplyIfFiniteState(new_inner, count)
+
+    return GradientTransformation(init, update)

@@ -1,0 +1,46 @@
+"""Checkpoint tests: state dicts and orbax sharded save/restore."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import io
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "model")
+    io.save_state_dict(m, path)
+
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = jnp.ones((2, 4))
+    assert not np.allclose(m(x), m2(x))
+    m2 = io.load_state_dict(m2, path)
+    np.testing.assert_allclose(m(x), m2(x), rtol=1e-6)
+
+
+def test_state_dict_strict_mismatch(tmp_path):
+    m = nn.Linear(4, 8)
+    path = str(tmp_path / "model")
+    io.save_state_dict(m, path)
+    wrong = nn.Linear(4, 9)
+    with pytest.raises(ValueError):
+        io.load_state_dict(wrong, path)
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    m = nn.Linear(4, 4)
+    from paddle_tpu import optimizer as opt
+
+    o = opt.Adam(1e-3)
+    state = o.init(m)
+    tree = {"model": m, "opt": state, "step": jnp.asarray(7)}
+    d = str(tmp_path / "ckpt")
+    io.save_checkpoint(tree, d, step=7)
+    io.checkpoint.wait_until_finished(d)
+    restored = io.load_checkpoint(tree, d)
+    assert int(restored["step"]) == 7
+    np.testing.assert_allclose(restored["model"].weight, m.weight)

@@ -1,0 +1,114 @@
+"""Core module-system tests: pytree registration, specs, masks, surgery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core.module import (
+    apply_updates, count_params, named_parameters, partition_specs,
+    trainable_mask, tree_at,
+)
+
+
+def make_mlp():
+    return nn.Sequential(
+        nn.Linear(4, 8, pspec=P(None, "tp")),
+        nn.ReLU(),
+        nn.Linear(8, 2),
+    )
+
+
+def test_module_is_pytree():
+    m = make_mlp()
+    leaves = jax.tree_util.tree_leaves(m)
+    # 2 weights + 2 biases
+    assert len(leaves) == 4
+    # round trip
+    flat, treedef = jax.tree_util.tree_flatten(m)
+    m2 = jax.tree_util.tree_unflatten(treedef, flat)
+    assert isinstance(m2, nn.Sequential)
+    y1 = m(jnp.ones((3, 4)))
+    y2 = m2(jnp.ones((3, 4)))
+    np.testing.assert_allclose(y1, y2)
+
+
+def test_named_parameters_paths():
+    m = make_mlp()
+    names = dict(named_parameters(m)).keys()
+    assert "layers.0.weight" in names
+    assert "layers.2.bias" in names
+    assert count_params(m) == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_jit_and_grad_through_module():
+    m = make_mlp()
+    x = jnp.ones((3, 4))
+
+    @jax.jit
+    def loss_fn(model, x):
+        return jnp.sum(model(x) ** 2)
+
+    g = jax.grad(loss_fn)(m, x)
+    assert isinstance(g, nn.Sequential)
+    assert g.layers[0].weight.shape == (4, 8)
+    # static fields preserved in grad pytree
+    assert g.layers[0].in_features == 4
+
+
+def test_partition_specs():
+    m = make_mlp()
+    specs = partition_specs(m)
+    assert specs.layers[0].weight == P(None, "tp")
+    assert specs.layers[0].bias == P("tp")
+    assert specs.layers[2].weight == P()
+
+
+def test_trainable_mask_batchnorm():
+    bn = nn.BatchNorm2D(3)
+    mask = trainable_mask(bn)
+    assert mask.weight is True
+    assert mask.running_mean is False
+    assert mask.running_var is False
+
+
+def test_tree_at_surgery():
+    m = make_mlp()
+    new_w = jnp.zeros((4, 8))
+    m2 = tree_at(lambda t: t.layers[0].weight, m, new_w)
+    assert float(jnp.sum(jnp.abs(m2.layers[0].weight))) == 0.0
+    # original untouched
+    assert float(jnp.sum(jnp.abs(m.layers[0].weight))) > 0.0
+
+
+def test_apply_updates_dtype_preserved():
+    m = nn.Linear(2, 2, dtype=jnp.bfloat16)
+    upd = jax.tree_util.tree_map(lambda p: jnp.ones_like(p, jnp.float32), m)
+    m2 = apply_updates(m, upd)
+    assert m2.weight.dtype == jnp.bfloat16
+
+
+def test_static_list_rejected():
+    class Bad(nn.Module):
+        def __init__(self):
+            self.config = [1, 2, 3]  # list static -> error
+
+    with pytest.raises(TypeError):
+        jax.tree_util.tree_leaves(Bad())
+
+
+def test_strategy_roundtrip(tmp_path):
+    s = paddle_tpu.DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 4
+    s.amp.enable = True
+    p = tmp_path / "strategy.json"
+    s.save(str(p))
+    s2 = paddle_tpu.DistributedStrategy.load(str(p))
+    assert s2.sharding.stage == 3
+    assert s2.amp.enable is True
+    assert s2.parallel_degrees()["fsdp"] == 4

@@ -1,0 +1,233 @@
+"""Layer & functional op tests, OpTest-style (golden + numeric grad)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core import rng
+from paddle_tpu.nn import functional as F
+
+from op_test import check_grad, check_output
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(6, 3)
+    x = np.random.randn(5, 6).astype(np.float32)
+    y = layer(jnp.asarray(x))
+    ref = x @ np.asarray(layer.weight) + np.asarray(layer.bias)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_layer_norm_golden_and_grad():
+    x = np.random.randn(4, 8).astype(np.float32)
+    w = np.random.randn(8).astype(np.float32)
+    b = np.random.randn(8).astype(np.float32)
+
+    def ref(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (np.asarray(x) - mu) / np.sqrt(var + 1e-5) * w + b
+
+    check_output(lambda x, w, b: F.layer_norm(x, w, b), ref,
+                 [jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)],
+                 rtol=1e-4, atol=1e-5)
+    check_grad(lambda x, w, b: F.layer_norm(x, w, b),
+               [x, w, b], wrt=(0, 1, 2))
+
+
+def test_rms_norm_grad():
+    x = np.random.randn(3, 16).astype(np.float32)
+    w = np.random.randn(16).astype(np.float32)
+    check_grad(lambda x, w: F.rms_norm(x, w), [x, w], wrt=(0, 1))
+
+
+def test_softmax_cross_entropy_golden():
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, (6,))
+
+    def ref(lg, lb):
+        e = np.exp(lg - lg.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return -np.log(p[np.arange(6), np.asarray(lb)])
+
+    check_output(lambda lg, lb: F.softmax_with_cross_entropy(lg, lb), ref,
+                 [jnp.asarray(logits), jnp.asarray(labels)],
+                 rtol=1e-5, atol=1e-6)
+    check_grad(lambda lg: F.softmax_with_cross_entropy(
+        lg, jnp.asarray(labels)), [logits])
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.asarray(np.random.randn(4, 5).astype(np.float32))
+    labels = jnp.asarray([1, -100, 3, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    # mean over the 2 valid entries only
+    per = F.softmax_with_cross_entropy(logits, labels, ignore_index=-100)
+    assert float(per[1]) == 0.0
+    np.testing.assert_allclose(float(loss),
+                               float((per[0] + per[2]) / 2), rtol=1e-6)
+
+
+def test_dropout_needs_key_and_scales():
+    x = jnp.ones((100, 100))
+    with pytest.raises(ValueError):
+        F.dropout(x, 0.5, training=True)
+    with rng.stream(jax.random.PRNGKey(0)):
+        y = F.dropout(x, 0.5, training=True)
+    keep_frac = float(jnp.mean((y > 0).astype(jnp.float32)))
+    assert 0.45 < keep_frac < 0.55
+    # inverted dropout preserves expectation
+    assert 0.9 < float(jnp.mean(y)) < 1.1
+    # eval mode = identity
+    np.testing.assert_allclose(F.dropout(x, 0.5, training=False), x)
+
+
+def test_attention_causal_masks_future():
+    B, T, H, D = 2, 6, 2, 8
+    q = jnp.asarray(np.random.randn(B, T, H, D).astype(np.float32))
+    k, v = q, q
+    out = F.scaled_dot_product_attention(q, k, v, causal=True,
+                                         use_pallas="never")
+    # position 0 attends only to itself -> output = v[0]
+    np.testing.assert_allclose(out[:, 0], v[:, 0], rtol=1e-5, atol=1e-5)
+
+
+def test_attention_gqa_equals_repeated_kv():
+    B, T, D = 2, 4, 8
+    q = jnp.asarray(np.random.randn(B, T, 4, D).astype(np.float32))
+    k = jnp.asarray(np.random.randn(B, T, 2, D).astype(np.float32))
+    v = jnp.asarray(np.random.randn(B, T, 2, D).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, causal=True,
+                                         use_pallas="never")
+    k2 = jnp.repeat(k, 2, axis=2)
+    v2 = jnp.repeat(v, 2, axis=2)
+    ref = F.scaled_dot_product_attention(q, k2, v2, causal=True,
+                                         use_pallas="never")
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_preserves_norm_and_relative():
+    B, T, H, D = 1, 8, 2, 16
+    x = jnp.asarray(np.random.randn(B, T, H, D).astype(np.float32))
+    cos, sin = F.rotary_embedding(jnp.arange(T), D)
+    y = F.apply_rotary(x, cos, sin)
+    np.testing.assert_allclose(jnp.linalg.norm(y, axis=-1),
+                               jnp.linalg.norm(x, axis=-1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mha_cache_matches_full():
+    attn = nn.MultiHeadAttention(16, 4, use_rope=True)
+    x = jnp.asarray(np.random.randn(2, 5, 16).astype(np.float32))
+    full = attn(x, causal=True)
+    cache = attn.init_cache(2)
+    outs = []
+    for t in range(5):
+        o, cache = attn(x[:, t:t + 1], causal=False, cache=cache)
+        outs.append(o)
+    np.testing.assert_allclose(full, jnp.concatenate(outs, axis=1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_state_tape():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = jnp.asarray(np.random.randn(4, 3, 2, 2).astype(np.float32) * 2 + 1)
+    with nn.state_tape() as tape:
+        y = bn(x, training=True)
+    assert len(tape) == 1
+    bn2 = nn.merge_state(bn, tape)
+    # running mean moved toward batch mean
+    batch_mean = np.asarray(x).mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(bn2.running_mean, 0.5 * batch_mean, rtol=1e-4)
+    # training output is standardized
+    np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 2, 3)),
+                               np.zeros(3), atol=1e-5)
+
+
+def test_conv2d_matches_naive():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = jnp.asarray(np.random.randn(1, 2, 5, 5).astype(np.float32))
+    y = conv(x)
+    assert y.shape == (1, 3, 5, 5)
+    # compare against explicit im2col computation at one position
+    w = np.asarray(conv.weight)
+    xp = np.pad(np.asarray(x), ((0, 0), (0, 0), (1, 1), (1, 1)))
+    patch = xp[0, :, 2:5, 2:5]
+    expect = (w * patch[None]).sum(axis=(1, 2, 3)) + np.asarray(conv.bias)
+    np.testing.assert_allclose(y[0, :, 2, 2], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_shapes_and_grad_flow():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = jnp.asarray(np.random.randn(3, 7, 4).astype(np.float32))
+    out, states = lstm(x)
+    assert out.shape == (3, 7, 8)
+    assert len(states) == 2
+
+    def loss(m):
+        y, _ = m(x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss)(lstm)
+    gn = float(jnp.sqrt(sum(jnp.sum(l ** 2)
+                            for l in jax.tree_util.tree_leaves(g))))
+    assert gn > 0
+
+
+def test_transformer_encoder_forward():
+    enc = nn.TransformerEncoder(
+        lambda: nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0), 2)
+    x = jnp.asarray(np.random.randn(2, 5, 16).astype(np.float32))
+    y = enc(x)
+    assert y.shape == (2, 5, 16)
+
+
+def test_sequential_threads_training_flag():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5), nn.Linear(4, 2))
+    x = jnp.ones((2, 4))
+    # eval works without key
+    y = model(x, training=False)
+    assert y.shape == (2, 2)
+    with rng.stream(jax.random.PRNGKey(0)):
+        y2 = model(x, training=True)
+    assert y2.shape == (2, 2)
+
+
+def test_conv2d_transpose_output_size():
+    # classic 2x upsampler: k=4, s=2, p=1 -> H_out = 2*H_in
+    deconv = nn.Conv2DTranspose(3, 5, 4, stride=2, padding=1)
+    x = jnp.ones((1, 3, 8, 8), jnp.float32)
+    y = deconv(x)
+    assert y.shape == (1, 5, 16, 16)
+    # adjoint property: <conv(a), b> == <a, conv_T(b)>. conv maps 5ch->3ch,
+    # its transpose maps 3ch->5ch; layouts [O=3,I=5,kh,kw] vs [in=3,out=5,..]
+    # line up directly.
+    conv = nn.Conv2D(5, 3, 4, stride=2, padding=1, bias=False)
+    deconv2 = nn.Conv2DTranspose(3, 5, 4, stride=2, padding=1, bias=False)
+    deconv2 = deconv2.replace(weight=conv.weight)
+    a = jnp.asarray(np.random.randn(1, 5, 16, 16).astype(np.float32))
+    b = jnp.asarray(np.random.randn(1, 3, 8, 8).astype(np.float32))
+    lhs = jnp.sum(conv(a) * b)
+    rhs = jnp.sum(a * deconv2(b))
+    np.testing.assert_allclose(float(lhs), float(rhs), rtol=1e-4)
+
+
+def test_avg_pool_exclusive_padding():
+    x = jnp.ones((1, 1, 4, 4))
+    # exclusive (reference default): padded borders still average to 1
+    y = F.avg_pool2d(x, 3, stride=1, padding=1)
+    np.testing.assert_allclose(y, jnp.ones_like(y), rtol=1e-6)
+    # inclusive: corner window has 4 real cells / 9
+    y2 = F.avg_pool2d(x, 3, stride=1, padding=1, exclusive=False)
+    np.testing.assert_allclose(float(y2[0, 0, 0, 0]), 4 / 9, rtol=1e-6)
+
+
+def test_group_norm_bias_without_weight():
+    x = jnp.asarray(np.random.randn(2, 4, 3, 3).astype(np.float32))
+    b = jnp.asarray(np.arange(4, dtype=np.float32))
+    y = F.group_norm(x, 2, weight=None, bias=b)
+    y0 = F.group_norm(x, 2, weight=None, bias=None)
+    np.testing.assert_allclose(y, y0 + b.reshape(1, 4, 1, 1), rtol=1e-5)
