@@ -209,17 +209,21 @@ def count_params(tree) -> int:
 
 
 def _walk_owner(tree, path):
-    """Walk ``tree`` along ``path`` and return (owning object, final key).
+    """Walk ``tree`` along ``path``; return (owner_module, attr, prefix).
 
-    The owning object is the object holding the *last* key in the path; used
-    to resolve per-module annotations like ``_pspecs``/``_nontrainable``.
-    Also returns the nearest enclosing Module and the attribute name under it
-    (for array fields nested in lists the attr is the list's name).
+    ``owner_module``/``attr`` resolve per-module annotations
+    (``_pspecs``/``_nontrainable``): the nearest enclosing Module and the
+    attribute name under it (for arrays nested in containers the attr is
+    the container's name). ``prefix`` accumulates ``_spec_prefix`` entries
+    from every enclosing module that stacks its children's arrays (e.g. a
+    scan-over-layers container adds a leading layer dim).
     """
     obj = tree
     owner_module, attr_under_module = None, None
+    prefix: tuple = ()
     if isinstance(obj, Module):
         owner_module = obj
+        prefix += getattr(obj, "_spec_prefix", ())
     for key in path:
         if isinstance(key, jax.tree_util.GetAttrKey):
             if isinstance(obj, Module):
@@ -231,7 +235,8 @@ def _walk_owner(tree, path):
             obj = obj[key.key]
         if isinstance(obj, Module):
             owner_module, attr_under_module = obj, None
-    return owner_module, attr_under_module
+            prefix += getattr(obj, "_spec_prefix", ())
+    return owner_module, attr_under_module, prefix
 
 
 def partition_specs(tree, default: P | None = None):
@@ -247,15 +252,18 @@ def partition_specs(tree, default: P | None = None):
     default = default if default is not None else P()
 
     def visit(path, leaf):
-        owner, attr = _walk_owner(tree, path)
+        owner, attr, prefix = _walk_owner(tree, path)
+        spec = default
         if owner is not None and attr is not None:
             specs = getattr(owner, "_pspecs", None)
             if specs:
                 # stored as a tuple of (name, spec) pairs to stay hashable
                 specs = specs if isinstance(specs, dict) else dict(specs)
                 if attr in specs:
-                    return specs[attr]
-        return default
+                    spec = specs[attr]
+        if prefix:
+            spec = P(*prefix, *spec)
+        return spec
 
     return jax.tree_util.tree_map_with_path(visit, tree)
 
@@ -267,7 +275,7 @@ def trainable_mask(tree):
     reference's ``ParamBase.trainable``."""
 
     def visit(path, leaf):
-        owner, attr = _walk_owner(tree, path)
+        owner, attr, _ = _walk_owner(tree, path)
         if owner is not None and attr is not None:
             nt = getattr(owner, "_nontrainable", ())
             if attr in nt:

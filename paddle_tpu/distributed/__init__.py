@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed — fleet facade, launcher, collectives.
+
+Mirrors the reference's ``python/paddle/distributed`` package: the Fleet
+strategy compiler (``distributed/fleet/base/fleet_base.py``), the process
+launcher (``fleet/launch.py``), and functional collectives
+(``distributed/collective.py``).
+"""
+
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.parallel.collective import (
+    all_gather, all_reduce, all_to_all, barrier, broadcast, reduce,
+    reduce_scatter, ReduceOp,
+)
+from paddle_tpu.parallel.env import (
+    ParallelEnv, get_rank, get_world_size, init_parallel_env,
+)
+from paddle_tpu.distributed import fleet
+
+__all__ = ["fleet", "DistributedStrategy", "init_parallel_env",
+           "ParallelEnv", "get_rank", "get_world_size", "all_reduce",
+           "all_gather", "reduce_scatter", "broadcast", "reduce",
+           "all_to_all", "barrier", "ReduceOp"]

@@ -1,0 +1,73 @@
+"""Fleet — the distributed strategy facade.
+
+Reference: ``python/paddle/distributed/fleet/base/fleet_base.py`` —
+``fleet.init(strategy)`` (:129), ``fleet.distributed_optimizer(opt)``
+(:583), ``minimize`` (:978) which ranks applicable meta-optimizers and
+rewrites the program. Here ``minimize`` becomes *compile*: the strategy
+compiler composes pure-function transforms and returns a jitted sharded
+train step (see ``strategy_compiler.py``).
+
+Typical use:
+
+    import paddle_tpu.distributed as dist
+    strategy = dist.DistributedStrategy()
+    strategy.sharding.enable = True; strategy.sharding.stage = 3
+    strategy.tensor_parallel.enable = True; strategy.tensor_parallel.degree = 4
+    dist.fleet.init(strategy=strategy)
+    step = dist.fleet.distributed_optimizer(opt, strategy).build_train_step(
+        model, loss_fn)
+    state = step.init_state(model)
+    state, metrics = step(state, batch, key)
+"""
+
+from paddle_tpu.distributed.fleet.strategy_compiler import (
+    CompiledTrainStep,
+    TrainState,
+    build_train_step,
+)
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.parallel import mesh as _mesh_mod
+from paddle_tpu.parallel.env import init_parallel_env
+
+_state = {"strategy": None, "mesh": None, "initialized": False}
+
+
+def init(strategy: DistributedStrategy | None = None, mesh=None,
+         is_collective: bool = True) -> None:
+    """``fleet.init`` — wire the process group (multi-host jax.distributed
+    if the launcher env is set) and build the device mesh from the
+    strategy's parallel degrees."""
+    del is_collective
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    if mesh is None:
+        mesh = _mesh_mod.mesh_from_strategy(strategy)
+    _mesh_mod.set_mesh(mesh)
+    _state.update(strategy=strategy, mesh=mesh, initialized=True)
+
+
+def get_strategy() -> DistributedStrategy:
+    return _state["strategy"] or DistributedStrategy()
+
+
+def get_mesh():
+    return _state["mesh"]
+
+
+class DistributedOptimizer:
+    """``fleet.distributed_optimizer`` result: pairs a base optimizer with
+    the strategy; ``build_train_step`` is the ``minimize`` analogue."""
+
+    def __init__(self, optimizer, strategy: DistributedStrategy | None = None):
+        self.optimizer = optimizer
+        self.strategy = strategy or get_strategy()
+
+    def build_train_step(self, model, loss_fn=None,
+                         mesh=None) -> CompiledTrainStep:
+        return build_train_step(
+            model, self.optimizer, loss_fn=loss_fn,
+            strategy=self.strategy, mesh=mesh or get_mesh())
+
+
+def distributed_optimizer(optimizer, strategy=None) -> DistributedOptimizer:
+    return DistributedOptimizer(optimizer, strategy)

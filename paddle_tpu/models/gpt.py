@@ -1,0 +1,122 @@
+"""GPT-3 family (pre-LN, learned positions, GELU MLP), TPU-sharded.
+
+BASELINE.json config: "ERNIE-3.0 / GPT-3 6.7B with tensor+pipeline parallel
+over ICI". Same fsdp×tp sharding recipe as the Llama model.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Dropout, Embedding, Linear
+from paddle_tpu.nn.initializer import Normal
+from paddle_tpu.nn.norm import LayerNorm
+from paddle_tpu.nn.scan import ScannedBlocks
+
+__all__ = ["GPTConfig", "GPTForCausalLM", "GPTBlock"]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304            # 50257 padded to a multiple of 128
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    init_std: float = 0.02
+
+    @classmethod
+    def gpt3_6_7b(cls) -> "GPTConfig":
+        return cls(hidden_size=4096, num_layers=32, num_heads=32)
+
+    @classmethod
+    def gpt3_1_3b(cls) -> "GPTConfig":
+        return cls(hidden_size=2048, num_layers=24, num_heads=16)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dtype="float32",
+                    remat=False)
+        base.update(kw)
+        return cls(**base)
+
+
+class GPTBlock(Module):
+    def __init__(self, cfg: GPTConfig, key=None):
+        keys = rng.split_key(key, 6)
+        E = cfg.hidden_size
+        dtype = jnp.dtype(cfg.dtype)
+        init = Normal(0.0, cfg.init_std)
+        out_init = Normal(0.0, cfg.init_std / math.sqrt(2 * cfg.num_layers))
+        self.ln1 = LayerNorm(E, dtype=dtype)
+        self.wqkv = Linear(E, 3 * E, weight_init=init, dtype=dtype,
+                           key=keys[0], pspec=P("fsdp", "tp"))
+        self.wo = Linear(E, E, weight_init=out_init, dtype=dtype,
+                         key=keys[1], pspec=P("tp", "fsdp"))
+        self.ln2 = LayerNorm(E, dtype=dtype)
+        self.fc1 = Linear(E, 4 * E, weight_init=init, dtype=dtype,
+                          key=keys[2], pspec=P("fsdp", "tp"))
+        self.fc2 = Linear(4 * E, E, weight_init=out_init, dtype=dtype,
+                          key=keys[3], pspec=P("tp", "fsdp"))
+        self.drop = Dropout(cfg.dropout)
+        self.num_heads = cfg.num_heads
+        self.head_dim = E // cfg.num_heads
+
+    def __call__(self, x, training: bool = False):
+        B, T, E = x.shape
+        h = self.ln1(x)
+        qkv = self.wqkv(h).reshape(B, T, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = F.scaled_dot_product_attention(q, k, v, causal=True)
+        x = x + self.drop(self.wo(a.reshape(B, T, E)), training=training)
+        h = self.ln2(x)
+        h = self.fc2(F.gelu(self.fc1(h), approximate=True))
+        return x + self.drop(h, training=training)
+
+
+class GPTForCausalLM(Module):
+    def __init__(self, cfg: GPTConfig, key=None):
+        keys = rng.split_key(key, 3 + cfg.num_layers)
+        dtype = jnp.dtype(cfg.dtype)
+        init = Normal(0.0, cfg.init_std)
+        self.embed = Embedding(cfg.vocab_size, cfg.hidden_size,
+                               weight_init=init, dtype=dtype, key=keys[0],
+                               pspec=P("tp", "fsdp"))
+        self.pos_embed = Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                   weight_init=init, dtype=dtype,
+                                   key=keys[1], pspec=P(None, "fsdp"))
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = ScannedBlocks(
+            lambda i: GPTBlock(cfg, key=keys[3 + i]), cfg.num_layers,
+            remat=cfg.remat, remat_policy=cfg.remat_policy)
+        self.ln_f = LayerNorm(cfg.hidden_size, dtype=dtype)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias=False,
+                              weight_init=init, dtype=dtype, key=keys[2],
+                              pspec=P("fsdp", "tp"))
+        self.config = cfg
+
+    def __call__(self, input_ids, training: bool = False):
+        T = input_ids.shape[1]
+        x = self.embed(input_ids) + self.pos_embed(jnp.arange(T))
+        x = self.drop(x, training=training)
+        x = self.blocks(x, training=training)
+        return self.lm_head(self.ln_f(x))
+
+    def loss(self, input_ids, labels, ignore_index: int = -100,
+             training: bool = True):
+        logits = self(input_ids, training=training)
+        return F.cross_entropy(
+            logits[:, :-1].astype(jnp.float32), labels[:, 1:],
+            ignore_index=ignore_index)

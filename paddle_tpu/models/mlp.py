@@ -1,0 +1,36 @@
+"""Small MLP models — the reference's "book" starter workloads
+(``tests/book/test_recognize_digits.py`` MNIST MLP)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.common import Dropout, Flatten, Linear, Sequential
+from paddle_tpu.nn.activation import ReLU
+
+__all__ = ["MLP", "MNISTClassifier"]
+
+
+def MLP(sizes, activation=ReLU, dropout: float = 0.0, key=None):
+    keys = rng.split_key(key, max(len(sizes) - 1, 1))
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        layers.append(Linear(a, b, key=keys[i]))
+        if i < len(sizes) - 2:
+            layers.append(activation())
+            if dropout:
+                layers.append(Dropout(dropout))
+    return Sequential(*layers)
+
+
+class MNISTClassifier(Module):
+    def __init__(self, key=None):
+        self.net = Sequential(
+            Flatten(),
+            *MLP([784, 256, 128, 10], key=key).layers,
+        )
+
+    def __call__(self, x, training: bool = False):
+        return self.net(x, training=training)

@@ -1,0 +1,86 @@
+"""Scan-over-layers container.
+
+The TPU-idiomatic way to stack N identical transformer blocks: parameters
+are stored stacked with a leading layer dimension and the forward is a
+``lax.scan``, so XLA compiles ONE block regardless of depth (compile time
+and HBM code size O(1) in n_layers). This replaces the reference's python
+loop over cloned layers (``python/paddle/nn/layer/transformer.py``
+TransformerEncoder) — a loop is fine under eager CUDA, hostile under jit.
+
+Recompute (reference RecomputeOptimizer, ``fluid/optimizer.py:4491``;
+checkpoint segmentation in ``fluid/backward.py:689``) maps to
+``jax.checkpoint`` around the scanned body with a selectable policy —
+exactly the reference's "checkpoint every segment" with segment = layer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+
+__all__ = ["ScannedBlocks", "REMAT_POLICIES"]
+
+REMAT_POLICIES = {
+    "none": None,
+    # save matmul outputs, recompute elementwise — the usual LLM sweet spot
+    "dots_saveable": jax.checkpoint_policies.checkpoint_dots,
+    "dots_with_no_batch_dims":
+        jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    # recompute everything (max memory saving, ZeRO-3 friendly)
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+}
+
+
+class ScannedBlocks(Module):
+    """N structurally-identical blocks, parameters stacked on a leading
+    layer axis, forward = scan.
+
+    ``builder(i)`` must return block i (fresh params each call). The
+    blocks' own ``_pspecs`` annotations survive: partition_specs sees the
+    ``_spec_prefix`` and prepends the layer dim (replicated by default,
+    or the ``pp`` axis when pipelining shards layers across stages).
+    """
+
+    def __init__(self, builder: Callable[[int], Module], n_layers: int, *,
+                 remat: bool = False, remat_policy: str = "nothing_saveable",
+                 layer_axis: str | None = None):
+        blocks = [builder(i) for i in range(n_layers)]
+        self.block = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)
+        self.n_layers = int(n_layers)
+        self.remat = bool(remat)
+        self.remat_policy = remat_policy
+        self._spec_prefix = (layer_axis,)
+
+    def __call__(self, x, *args, training: bool = False, **kwargs):
+        # per-layer RNG keys so dropout differs across layers under scan
+        stream_key = rng.stream_key() if training else None
+
+        def body(carry, layer_and_key):
+            layer, key = layer_and_key
+            if key is not None:
+                with rng.stream(key):
+                    y = layer(carry, *args, training=training, **kwargs)
+            else:
+                y = layer(carry, *args, training=training, **kwargs)
+            return y, None
+
+        if self.remat:
+            policy = REMAT_POLICIES[self.remat_policy]
+            body = jax.checkpoint(
+                body, policy=policy, prevent_cse=False)
+
+        keys = (jax.random.split(stream_key, self.n_layers)
+                if stream_key is not None else None)
+        x, _ = lax.scan(body, x, (self.block, keys))
+        return x
+
+    def layer(self, i: int) -> Module:
+        """Materialize block i (host-side inspection/debugging)."""
+        return jax.tree_util.tree_map(lambda x: x[i], self.block)

@@ -1,0 +1,25 @@
+"""paddle_tpu.parallel — mesh construction, collectives, parallel strategies.
+
+The TPU-native replacement for the reference's NCCL ring machinery
+(reference ``paddle/fluid/platform/collective_helper.h:63`` comm registry,
+``operators/collective/`` ring-id ops): communication groups are *named
+mesh axes* of a ``jax.sharding.Mesh``; collectives are XLA ops inserted by
+the SPMD partitioner (via shardings) or called explicitly inside
+``shard_map`` (via ``paddle_tpu.parallel.collective``).
+"""
+
+from paddle_tpu.parallel.mesh import (
+    MeshContext,
+    batch_spec,
+    create_mesh,
+    get_mesh,
+    mesh_from_strategy,
+    set_mesh,
+)
+from paddle_tpu.parallel.env import ParallelEnv, init_parallel_env
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.sharding import (
+    opt_state_specs,
+    param_specs_for_stage,
+    shard_tree,
+)

@@ -1,0 +1,136 @@
+"""Functional collectives.
+
+Mirrors the reference's dygraph collective API
+(``python/paddle/distributed/collective.py:99-455``: broadcast, all_reduce,
+reduce, all_gather, scatter, barrier) and the graph-level collective ops
+(``operators/collective/c_allreduce_op.h:109`` etc.).
+
+Two modes, matching how TPU programs are written:
+
+- **Inside ``shard_map``** (the SPMD region): thin wrappers over
+  ``jax.lax`` collectives keyed by mesh-axis name — the direct equivalent
+  of the reference's ring-id NCCL calls, riding ICI.
+- **Eager/global** (outside any mapped region): operate on globally-sharded
+  arrays by jitting the collective over the ambient mesh.
+
+The reference's ``ring_id`` becomes the ``axis`` name; ``use_calc_stream``
+disappears (XLA schedules compute/comm overlap itself).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "reduce", "all_to_all", "ppermute", "send_next", "recv_prev",
+           "barrier", "axis_index", "axis_size", "ReduceOp"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, axis: str = "dp"):
+    """``c_allreduce_{sum,max,min,prod}`` equivalent inside shard_map."""
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    if op == ReduceOp.PROD:
+        # sign-and-magnitude decomposition: exp(psum(log|x|)) handles only
+        # positive reals, so track sign parity and zeros separately
+        magnitude = jnp.exp(lax.psum(jnp.log(jnp.maximum(jnp.abs(x), 1e-300)),
+                                     axis))
+        neg_count = lax.psum((x < 0).astype(jnp.int32), axis)
+        has_zero = lax.pmax((x == 0).astype(jnp.int32), axis)
+        sign = jnp.where(neg_count % 2 == 0, 1.0, -1.0).astype(x.dtype)
+        return jnp.where(has_zero > 0, jnp.zeros_like(x),
+                         sign * magnitude.astype(x.dtype))
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis: str = "dp", tiled_axis: int = 0):
+    """``c_allgather``: concatenate shards along ``tiled_axis``."""
+    return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x, axis: str = "dp", scatter_axis: int = 0,
+                   op: str = ReduceOp.SUM):
+    """``c_reducescatter``."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError("reduce_scatter supports sum/avg")
+    out = lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                           tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / lax.axis_size(axis)
+    return out
+
+
+def broadcast(x, src: int = 0, axis: str = "dp"):
+    """``c_broadcast``: everyone gets rank ``src``'s value. Formulated as
+    mask+psum (zero every contribution except the source's, then
+    all-reduce), which XLA lowers to an efficient collective."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, axis: str = "dp"):
+    """``c_reduce_*``: reduced value lands on rank ``dst``; others keep
+    zeros (functional reading of the reference's in-place semantics)."""
+    total = all_reduce(x, op, axis)
+    idx = lax.axis_index(axis)
+    return jnp.where(idx == dst, total, jnp.zeros_like(total))
+
+
+def all_to_all(x, axis: str = "sp", split_axis: int = 0,
+               concat_axis: int = 0):
+    """``alltoall`` — the Ulysses sequence-parallel primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, perm: Sequence[tuple[int, int]], axis: str = "pp"):
+    return lax.ppermute(x, axis, perm)
+
+
+def send_next(x, axis: str = "pp"):
+    """``send_v2``/``recv_v2`` ring shift: rank i -> rank i+1 (wrapping).
+    The pipeline-parallel activation hop."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def recv_prev(x, axis: str = "pp"):
+    """Ring shift the other way: rank i -> rank i-1."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def barrier(axis: str | None = None):
+    """``barrier`` op equivalent. Inside shard_map: a psum no-op forces
+    rendezvous. Outside: block on all live arrays (host-level)."""
+    if axis is not None:
+        return lax.psum(jnp.ones(()), axis)
+    jax.effects_barrier()
+    return None

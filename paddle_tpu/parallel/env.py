@@ -1,0 +1,76 @@
+"""Process/parallel environment bootstrap.
+
+Reference: ``python/paddle/distributed/parallel.py:57`` (init_parallel_env:
+env parsing + NCCL id exchange over TCP, ``imperative/nccl_context.cc:20``)
+and ``ParallelEnv``. TPU-native: multi-host wiring is
+``jax.distributed.initialize`` (the coordination service replaces the
+hand-rolled TCP store); intra-host there is nothing to do — XLA already
+sees all local chips.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "ParallelEnv", "get_rank", "get_world_size"]
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: str | None = None,
+                      num_processes: int | None = None,
+                      process_id: int | None = None) -> "ParallelEnv":
+    """Initialize multi-host JAX if the fleetrun-style env is present.
+
+    Env contract (set by ``paddle_tpu.distributed.launch``):
+    ``PTPU_COORDINATOR`` (host:port), ``PTPU_NUM_PROCESSES``, ``PTPU_RANK``.
+    Single-process use needs no call at all (parity: the reference requires
+    init_parallel_env before any dygraph collective; here it is a no-op).
+    """
+    global _initialized
+    coordinator = coordinator_address or os.environ.get("PTPU_COORDINATOR")
+    if coordinator and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes or int(
+                os.environ.get("PTPU_NUM_PROCESSES", "1")),
+            process_id=process_id if process_id is not None else int(
+                os.environ.get("PTPU_RANK", "0")),
+        )
+        _initialized = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    """Rank/size/device info (reference ParallelEnv: rank from
+    PADDLE_TRAINER_ID, world size from PADDLE_TRAINERS_NUM)."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def device_count(self) -> int:
+        return jax.device_count()
+
+    @property
+    def dev_id(self) -> int:
+        return 0
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()

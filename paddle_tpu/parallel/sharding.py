@@ -1,0 +1,122 @@
+"""ZeRO-style sharding over the ``fsdp`` mesh axis.
+
+Reference: ``fleet/meta_optimizers/sharding_optimizer.py:33`` — the
+reference rewrites the program: params assigned to ranks
+(``sharding/shard.py``), ``c_broadcast`` inserted for weights,
+``c_allreduce_sum`` routed to the owning rank for grads, non-owned
+optimizer states pruned (``_prune_main_program:224``). That machinery is
+what the XLA SPMD partitioner does from sharding annotations alone:
+
+- **stage 1** (opt states sharded): params replicated over ``fsdp``,
+  optimizer moments sharded → XLA all-gathers updates after the step.
+- **stage 2** (+grad shards): with sharded moments the grad contraction
+  becomes a reduce-scatter automatically (XLA rewrites allreduce+slice).
+- **stage 3** (+param shards, beyond the reference snapshot — the
+  north-star): parameters carry ``fsdp`` in their own spec; XLA inserts
+  gather-on-use in forward/backward, keeping memory flat. With
+  ``jax.checkpoint`` on blocks the gathers re-run in backward instead of
+  being saved — the remat boundary the SURVEY calls out.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.module import partition_specs
+
+__all__ = ["param_specs_for_stage", "opt_state_specs", "shard_tree",
+           "strip_axis", "add_fsdp_axis"]
+
+
+def strip_axis(spec: P, axis: str) -> P:
+    """Remove ``axis`` from a PartitionSpec (replicate over it instead)."""
+    out = []
+    for entry in spec:
+        if entry == axis:
+            out.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a != axis)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry)
+    return P(*out)
+
+
+def add_fsdp_axis(spec: P, shape, mesh: Mesh, axis: str = "fsdp") -> P:
+    """Add ``axis`` to the first divisible, unsharded dimension of a spec
+    — the param-to-rank assignment rule (reference ``sharding/shard.py``
+    splits by size; here we split the leading dim, which XLA handles
+    uniformly)."""
+    size = mesh.shape[axis]
+    if size == 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for entry in entries:
+        if entry == axis or (isinstance(entry, tuple) and axis in entry):
+            return P(*entries)  # already sharded on it somewhere
+    for i, (entry, dim) in enumerate(zip(entries, shape)):
+        if entry is None and dim % size == 0:
+            entries[i] = axis
+            return P(*entries)
+    return P(*entries)  # nothing divisible: stay replicated
+
+
+def param_specs_for_stage(model, mesh: Mesh, stage: int):
+    """Parameter PartitionSpecs under a given ZeRO stage.
+
+    Model annotations (``_pspecs``) carry tp/fsdp axes. Stage >= 3 keeps
+    the fsdp axis on parameters; stages 1/2 replicate parameters over fsdp
+    (grads/opt-state sharding is expressed on the optimizer state instead).
+    """
+    specs = partition_specs(model)
+
+    def fix(path_spec_leaf):
+        return path_spec_leaf if stage >= 3 else strip_axis(
+            path_spec_leaf, "fsdp")
+
+    return jax.tree_util.tree_map(
+        fix, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def opt_state_specs(opt_state, param_specs, params, mesh: Mesh, stage: int):
+    """PartitionSpecs for the optimizer state pytree.
+
+    Optimizer state built as ``tree_map(zeros_like, params)`` (moments,
+    momentum, accumulators) has the params' *tree structure*; any such
+    subtree inherits the parameter specs leaf-for-leaf, plus an extra
+    ``fsdp`` shard for stage >= 1 (the ZeRO-1 memory win). Everything else
+    (step counts, scalars) stays replicated.
+    """
+    params_def = jax.tree_util.tree_structure(params)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+
+    def is_param_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == params_def
+        except Exception:  # pragma: no cover - defensive
+            return False
+
+    def visit(node):
+        if is_param_like(node):
+            leaves, treedef = jax.tree_util.tree_flatten(node)
+            out = []
+            for leaf, spec in zip(leaves, spec_leaves):
+                if stage >= 1 and hasattr(leaf, "shape"):
+                    spec = add_fsdp_axis(spec, leaf.shape, mesh)
+                out.append(spec)
+            return jax.tree_util.tree_unflatten(treedef, out)
+        # unmatched leaf: replicate (scalars / counters)
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(visit, opt_state, is_leaf=is_param_like)
+
+
+def shard_tree(tree, spec_tree, mesh: Mesh):
+    """device_put a pytree according to a PartitionSpec tree."""
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(tree, shardings)

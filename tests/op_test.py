@@ -56,15 +56,17 @@ def numeric_grad(fn: Callable, args: list, idx: int, eps: float = 1e-3):
 def check_grad(fn: Callable, args: Sequence, wrt: Sequence[int] = (0,),
                rtol: float = 5e-3, atol: float = 1e-4, eps: float = 1e-3):
     """Compare jax.grad of sum(fn) against finite differences. Runs in
-    float64 (x64 enabled in conftest) so FD noise stays below tolerance —
-    the reference instead loosens per-op thresholds
+    float64 (x64 scoped via jax.enable_x64) so FD noise stays below
+    tolerance — the reference instead loosens per-op thresholds
     (op_test white_list/op_accuracy_white_list.py)."""
-    args = [jnp.asarray(a, jnp.float64) if np.issubdtype(
-        np.asarray(a).dtype, np.floating) else jnp.asarray(a) for a in args]
+    with jax.enable_x64(True):
+        args = [jnp.asarray(np.asarray(a), jnp.float64) if np.issubdtype(
+            np.asarray(a).dtype, np.floating) else jnp.asarray(a)
+            for a in args]
 
-    for idx in wrt:
-        analytic = jax.grad(lambda *a: jnp.sum(fn(*a)), argnums=idx)(*args)
-        numeric = numeric_grad(fn, list(args), idx, eps)
-        np.testing.assert_allclose(np.asarray(analytic, np.float64), numeric,
-                                   rtol=rtol, atol=atol,
-                                   err_msg=f"grad mismatch wrt arg {idx}")
+        for idx in wrt:
+            analytic = jax.grad(lambda *a: jnp.sum(fn(*a)), argnums=idx)(*args)
+            numeric = numeric_grad(fn, list(args), idx, eps)
+            np.testing.assert_allclose(np.asarray(analytic, np.float64),
+                                       numeric, rtol=rtol, atol=atol,
+                                       err_msg=f"grad mismatch wrt arg {idx}")

@@ -1,0 +1,232 @@
+"""Fleet strategy compiler integration tests: tiny Llama/GPT trained under
+composed strategies on the 8-device CPU mesh — the analogue of the
+reference's TestDistBase loss-vs-local comparison
+(``tests/unittests/test_dist_base.py:1119``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer as optim
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.models import GPTConfig, GPTForCausalLM, LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel import mesh as M
+
+
+def make_batch(bs=8, seq=16, vocab=256, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, vocab, (bs, seq)).astype(np.int32)
+    return {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids)}
+
+
+def run_steps(strategy, n=6, model_cls=LlamaForCausalLM, cfg=None, lr=1e-2):
+    paddle_tpu.seed(42)
+    cfg = cfg or LlamaConfig.tiny()
+    model = model_cls(cfg)
+    mesh = M.mesh_from_strategy(strategy)
+    with M.MeshContext(mesh):
+        opt = optim.AdamW(lr, grad_clip=optim.ClipGradByGlobalNorm(1.0))
+        step = dist.fleet.build_train_step(model, optimizer=opt,
+                                           strategy=strategy, mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch(make_batch())
+        losses = []
+        for i in range(n):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            losses.append(float(metrics["loss"]))
+    return losses, state, step
+
+
+def test_fleet_dp_only(devices8):
+    s = DistributedStrategy()  # 8-way dp inferred
+    losses, state, _ = run_steps(s)
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 6
+
+
+def test_fleet_zero3_tp_hybrid(devices8):
+    s = DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 2
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    losses, state, step = run_steps(s)
+    assert losses[-1] < losses[0], losses
+    # parameters actually sharded: wq spec has fsdp AND tp
+    wq = state.model.blocks.block.attn.wq.weight
+    assert wq.sharding.spec == P(None, "fsdp", "tp")
+
+
+def test_fleet_hybrid_matches_dp_losses(devices8):
+    """Same seed => sharded/TP run must reproduce pure-DP losses (the
+    TestDistBase check_with_place tolerance comparison)."""
+    s1 = DistributedStrategy()
+    s2 = DistributedStrategy()
+    s2.sharding.enable = True
+    s2.sharding.stage = 3
+    s2.sharding.degree = 2
+    s2.tensor_parallel.enable = True
+    s2.tensor_parallel.degree = 2
+    l1, _, _ = run_steps(s1)
+    l2, _, _ = run_steps(s2)
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-5)
+
+
+def test_fleet_gradient_merge(devices8):
+    s = DistributedStrategy()
+    s.gradient_merge.enable = True
+    s.gradient_merge.k_steps = 2
+    losses, state, step = run_steps(s, n=4)
+    # params must only move on steps 2 and 4; after step1 the model equals
+    # init. We can't see intermediates here, so check the accumulator is
+    # zeroed after an apply step (step 4 = 2nd apply).
+    acc_norm = float(sum(jnp.sum(jnp.abs(l)) for l in
+                         jax.tree_util.tree_leaves(state.merge_grads)))
+    assert acc_norm == 0.0
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_amp_bf16(devices8):
+    s = DistributedStrategy()
+    s.amp.enable = True
+    s.amp.dtype = "bfloat16"
+    losses, _, _ = run_steps(s)
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_amp_fp16_scaler(devices8):
+    s = DistributedStrategy()
+    s.amp.enable = True
+    s.amp.dtype = "float16"
+    losses, state, _ = run_steps(s, n=4)
+    # dynamic loss scaling active
+    assert float(state.scaler.loss_scaling) > 0
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_recompute_same_losses(devices8):
+    s1 = DistributedStrategy()
+    s2 = DistributedStrategy()
+    s2.recompute.enable = True
+    s2.recompute.policy = "nothing_saveable"
+    l1, _, _ = run_steps(s1)
+    l2, _, _ = run_steps(s2)
+    # remat must not change numerics
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_fleet_gpt_model(devices8):
+    s = DistributedStrategy()
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    losses, _, _ = run_steps(s, model_cls=GPTForCausalLM,
+                             cfg=GPTConfig.tiny())
+    assert losses[-1] < losses[0]
+
+
+def test_localsgd_unsupported(devices8):
+    s = DistributedStrategy()
+    s.localsgd.enable = True
+    with pytest.raises(NotImplementedError):
+        run_steps(s, n=1)
+
+
+def test_scanned_blocks_match_loop():
+    """Scan-over-layers must equal an explicit python loop."""
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(num_layers=3)
+    from paddle_tpu.models.llama import LlamaBlock
+    from paddle_tpu.nn.scan import ScannedBlocks
+
+    paddle_tpu.seed(7)
+    blocks = [LlamaBlock(cfg) for _ in range(3)]
+    paddle_tpu.seed(7)
+    scanned = ScannedBlocks(lambda i: LlamaBlock(cfg), 3)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, cfg.hidden_size)
+                    .astype(np.float32))
+    y_loop = x
+    for b in blocks:
+        y_loop = b(y_loop)
+    y_scan = scanned(x)
+    np.testing.assert_allclose(y_loop, y_scan, rtol=2e-5, atol=2e-5)
+
+
+def test_fleet_pipeline_matches_dp_losses(devices8):
+    """GPipe over pp=2 (+tp=2, dp=2) must reproduce pure-DP losses: the
+    pipeline is a pure re-scheduling of the same math."""
+    s1 = DistributedStrategy()
+    s2 = DistributedStrategy()
+    s2.pipeline.enable = True
+    s2.pipeline.degree = 2
+    s2.pipeline.num_microbatches = 2
+    s2.tensor_parallel.enable = True
+    s2.tensor_parallel.degree = 2
+    cfg = LlamaConfig.tiny(num_layers=4)
+    l1, _, _ = run_steps(s1, cfg=cfg)
+    l2, state2, _ = run_steps(s2, cfg=cfg)
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-5)
+    # layer dim actually sharded over pp
+    wq = state2.model.blocks.block.attn.wq.weight
+    assert wq.sharding.spec[0] == "pp"
+
+
+def test_fleet_pipeline_with_zero3(devices8):
+    """4D-style composition: pp=2 x fsdp=2 x tp=2 on 8 devices."""
+    s = DistributedStrategy()
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.pipeline.num_microbatches = 2
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 2
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    cfg = LlamaConfig.tiny(num_layers=4)
+    losses, _, _ = run_steps(s, cfg=cfg)
+    assert losses[-1] < losses[0], losses
+
+
+def test_merge_accumulator_skips_overflow_step(devices8):
+    """fp16 scaling + gradient merge: a NaN/overflow step must not poison
+    the merge window."""
+    import paddle_tpu.distributed.fleet.strategy_compiler as sc
+    from paddle_tpu import optimizer as optim
+
+    s = DistributedStrategy()
+    s.amp.enable = True
+    s.amp.dtype = "float16"
+    s.gradient_merge.enable = True
+    s.gradient_merge.k_steps = 2
+    s.amp.init_loss_scaling = 2.0 ** 60  # guarantee overflow on step 1
+    paddle_tpu.seed(1)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    mesh = M.mesh_from_strategy(s)
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.AdamW(1e-3), strategy=s, mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch(make_batch())
+        state, m1 = step(state, batch, jax.random.PRNGKey(0))
+        assert not bool(m1["all_finite"])  # overflow detected
+        acc_finite = all(bool(jnp.all(jnp.isfinite(l))) for l in
+                         jax.tree_util.tree_leaves(state.merge_grads))
+        assert acc_finite, "overflow grads leaked into merge accumulator"
+
+
+def test_pipeline_dropout_per_layer(devices8):
+    """Pipelined GPT with dropout: trains and stays finite (per-layer keys
+    threaded through the tick/stage scans)."""
+    s = DistributedStrategy()
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.pipeline.num_microbatches = 2
+    cfg = GPTConfig.tiny(num_layers=4, dropout=0.2)
+    losses, _, _ = run_steps(s, model_cls=GPTForCausalLM, cfg=cfg)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0]

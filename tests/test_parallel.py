@@ -1,0 +1,145 @@
+"""Parallelism tests: mesh construction, collectives in shard_map, ZeRO
+spec derivation — all on the virtual 8-device CPU mesh (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.parallel import collective as C
+from paddle_tpu.parallel import mesh as M
+from paddle_tpu.parallel.sharding import (
+    add_fsdp_axis, opt_state_specs, param_specs_for_stage, strip_axis,
+)
+
+
+def test_create_mesh_from_strategy(devices8):
+    s = DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.degree = 2
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    mesh = M.mesh_from_strategy(s)
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == 2  # leftover folded into dp
+    assert mesh.shape["pp"] == 1
+    assert mesh.axis_names == M.AXIS_ORDER
+
+
+def test_create_mesh_indivisible_raises(devices8):
+    with pytest.raises(ValueError):
+        M.create_mesh({"tp": 3})
+
+
+def _mesh2d(devices8):
+    return Mesh(np.array(devices8).reshape(2, 4), ("dp", "tp"))
+
+
+def test_collectives_in_shard_map(devices8):
+    mesh = _mesh2d(devices8)
+    x = jnp.arange(8.0)
+
+    def body(x):  # x: [1] shard on dp axis? use tp axis of size 4
+        s = C.all_reduce(x, axis="tp")
+        g = C.all_gather(x, axis="tp")
+        rs = C.reduce_scatter(g, axis="tp")
+        b = C.broadcast(x, src=2, axis="tp")
+        return s, g, rs, b
+
+    f = shard_map(body, mesh=mesh, in_specs=P(("dp", "tp")),
+                  out_specs=(P(("dp", "tp")), P("dp"),
+                             P(("dp", "tp")), P(("dp", "tp"))),
+                  check_vma=False)
+    s, g, rs, b = f(x)
+    # all_reduce over tp groups: ranks 0-3 sum to 6, ranks 4-7 sum to 22
+    np.testing.assert_allclose(s[:4], [6, 6, 6, 6])
+    np.testing.assert_allclose(s[4:], [22, 22, 22, 22])
+    # gather: every tp rank holds its group's full vector (replicated over
+    # tp, so the global view stacks one copy per dp group)
+    np.testing.assert_allclose(g[:4], [0, 1, 2, 3])
+    np.testing.assert_allclose(g[4:], [4, 5, 6, 7])
+    # reduce_scatter of the gathered (each rank holds its group's [a..d]):
+    # sum over 4 identical copies then scatter -> rank i gets 4*chunk_i
+    np.testing.assert_allclose(rs[:4], [0, 4, 8, 12])
+    np.testing.assert_allclose(rs[4:], [16, 20, 24, 28])
+    # broadcast from tp-rank 2
+    np.testing.assert_allclose(b[:4], [2, 2, 2, 2])
+    np.testing.assert_allclose(b[4:], [6, 6, 6, 6])
+
+
+def test_all_to_all_ulysses_swap(devices8):
+    mesh = Mesh(np.array(devices8[:4]).reshape(4), ("sp",))
+    # [seq=4, heads=4]: seq sharded; all_to_all -> heads sharded
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def body(x):  # local [1, 4]
+        return C.all_to_all(x, axis="sp", split_axis=1, concat_axis=0)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("sp", None),
+                  out_specs=P(None, "sp"))
+    y = f(x)
+    # transpose of blocks: y[:, j] on rank j holds column-block j of all seq
+    np.testing.assert_allclose(y, x)  # with 1-wide blocks this is identity
+
+
+def test_send_next_ring(devices8):
+    mesh = Mesh(np.array(devices8[:4]).reshape(4), ("pp",))
+    x = jnp.arange(4.0)
+
+    f = shard_map(lambda v: C.send_next(v, axis="pp"), mesh=mesh,
+                  in_specs=P("pp"), out_specs=P("pp"))
+    y = f(x)
+    np.testing.assert_allclose(y, [3, 0, 1, 2])  # rank i receives from i-1
+
+
+def test_strip_and_add_fsdp_axis(devices8):
+    assert strip_axis(P("fsdp", "tp"), "fsdp") == P(None, "tp")
+    assert strip_axis(P(("dp", "fsdp"), None), "fsdp") == P("dp", None)
+    mesh = M.create_mesh({"fsdp": 2, "tp": 2, "dp": 2})
+    # adds to first divisible unsharded dim
+    assert add_fsdp_axis(P(None, "tp"), (8, 4), mesh) == P("fsdp", "tp")
+    # respects existing shard
+    assert add_fsdp_axis(P("fsdp", None), (8, 4), mesh) == P("fsdp", None)
+    # indivisible: replicated
+    assert add_fsdp_axis(P(None,), (7,), mesh) == P(None)
+
+
+def test_param_and_opt_specs_stages(devices8):
+    from paddle_tpu import optimizer as opt
+
+    mesh = M.create_mesh({"fsdp": 2, "tp": 2, "dp": 2})
+    model = nn.Sequential(
+        nn.Linear(8, 16, pspec=P("fsdp", "tp")),
+        nn.Linear(16, 8, pspec=P("tp", "fsdp")),
+    )
+    # stage 2: params replicated over fsdp (tp kept)
+    specs2 = param_specs_for_stage(model, mesh, stage=2)
+    assert specs2.layers[0].weight == P(None, "tp")
+    # stage 3: params keep fsdp
+    specs3 = param_specs_for_stage(model, mesh, stage=3)
+    assert specs3.layers[0].weight == P("fsdp", "tp")
+
+    o = opt.Adam(1e-3)
+    state = o.init(model)
+    ospecs = opt_state_specs(state, specs2, model, mesh, stage=2)
+    # moments get the fsdp shard stage>=1; counters stay replicated
+    adam_state = ospecs[0]
+    assert adam_state.mu.layers[0].weight == P("fsdp", "tp")
+    assert adam_state.count == P()
+
+
+def test_all_reduce_prod_signs_and_zeros(devices8):
+    mesh = Mesh(np.array(devices8[:4]).reshape(4), ("g",))
+    x = jnp.asarray([-2.0, 3.0, -1.0, 4.0])
+    f = shard_map(lambda v: C.all_reduce(v, op=C.ReduceOp.PROD, axis="g"),
+                  mesh=mesh, in_specs=P("g"), out_specs=P("g"),
+                  check_vma=False)
+    np.testing.assert_allclose(f(x), jnp.full(4, 24.0), rtol=1e-5)
+    x0 = jnp.asarray([-2.0, 0.0, 5.0, 4.0])
+    np.testing.assert_allclose(f(x0), jnp.zeros(4), atol=1e-7)
