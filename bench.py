@@ -1,0 +1,136 @@
+"""Benchmark: Llama decoder training throughput on the available TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is measured MFU divided by 0.40 — the A100-class MFU the
+north-star asks to match (BASELINE.json: "match A100 MFU on Llama-2";
+the reference publishes no numbers, BASELINE.md). vs_baseline >= 1.0 means
+A100-parity-or-better utilization on this chip.
+
+Usage: python bench.py [--smoke] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# per-chip peak bf16 FLOP/s by TPU generation
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+A100_CLASS_MFU = 0.40
+
+
+def detect_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return 197e12  # conservative default
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny fast config")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import lr as lr_mod
+    from paddle_tpu.parallel import mesh as M
+
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    peak = detect_peak_flops(dev)
+
+    if args.smoke:
+        cfg = LlamaConfig.tiny(num_layers=2)
+        batch, seq = 4, 128
+    else:
+        # ~303M-param Llama shaped to fit one v5e chip in bf16 + fp32 moments.
+        # nothing_saveable remat: dots_saveable would save the [B,H,T,T]
+        # attention scores (GBs/layer at seq 2048) until the Pallas flash
+        # kernel removes them.
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=2048,
+            dtype="bfloat16", remat=True, remat_policy="nothing_saveable")
+        batch, seq = 8, 2048
+
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = cfg.num_params()
+
+    strategy = dist.DistributedStrategy()
+    if n_chips > 1:
+        strategy.sharding.enable = True
+        strategy.sharding.stage = 3
+        strategy.sharding.degree = n_chips
+    mesh = M.mesh_from_strategy(strategy, jax.devices())
+    with M.MeshContext(mesh):
+        sched = lr_mod.warmup_cosine(3e-4, 100, 10000)
+        step = dist.fleet.build_train_step(
+            model,
+            optimizer=optim.AdamW(sched,
+                                  grad_clip=optim.ClipGradByGlobalNorm(1.0)),
+            strategy=strategy, mesh=mesh)
+        state = step.init_state(model)
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        data = step.shard_batch({"input_ids": jnp.asarray(ids),
+                                 "labels": jnp.asarray(ids)})
+
+        for i in range(args.warmup):
+            state, metrics = step(state, data, jax.random.PRNGKey(i))
+        jax.block_until_ready(metrics["loss"])
+
+        # sync every step: under the axon remote tunnel, blocking only on
+        # the final step's output reports impossible times (dispatch-side
+        # caching); per-step sync costs ~ms against ~0.6s steps
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, metrics = step(state, data, jax.random.PRNGKey(100 + i))
+            float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * args.steps / dt
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+    # training FLOPs/token: 6N weight flops + attention 12*L*E*T
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    mfu = tokens_per_sec_chip * flops_per_token / peak
+
+    result = {
+        "metric": (f"llama-{n_params/1e6:.0f}M bf16 train throughput "
+                   f"(seq={seq}, bs={batch}, "
+                   f"{'zero3' if n_chips > 1 else 'single-chip'}, "
+                   f"{getattr(dev, 'device_kind', 'unknown')})"),
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / A100_CLASS_MFU, 4),
+    }
+    print(json.dumps(result))
+    print(f"# mfu={mfu:.3f} steps/sec={args.steps/dt:.3f} "
+          f"loss={float(metrics['loss']):.4f} params={n_params/1e6:.1f}M",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

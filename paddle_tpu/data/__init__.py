@@ -1,0 +1,20 @@
+"""paddle_tpu.data — datasets, samplers, DataLoader.
+
+Mirrors ``paddle.io`` (reference ``python/paddle/fluid/reader.py:147``
+DataLoader, ``python/paddle/fluid/dataloader/``): map/iterable datasets,
+batch samplers, and a prefetching loader. The TPU-native difference: the
+loader's job is to keep the *host→device* pipe full (XLA owns the device),
+so prefetch = background threads + ``jax.device_put`` double-buffering
+instead of the reference's multiprocess workers + LoDTensor queues; a C++
+packed-feed path (``paddle_tpu.native``) covers the hot case.
+"""
+
+from paddle_tpu.data.dataset import (
+    ChainDataset, Dataset, IterableDataset, Subset, TensorDataset,
+    random_split,
+)
+from paddle_tpu.data.sampler import (
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler,
+)
+from paddle_tpu.data.dataloader import DataLoader, default_collate

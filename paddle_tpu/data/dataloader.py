@@ -1,0 +1,124 @@
+"""DataLoader with threaded prefetch.
+
+Reference: ``python/paddle/fluid/reader.py:147`` (DataLoader facade),
+multiprocess iter ``fluid/dataloader/dataloader_iter.py:469``. The TPU
+host pipeline differs: workers are *threads* (numpy collation releases
+the GIL for the heavy copies) feeding a bounded queue, and an optional
+device-prefetch stage overlaps ``device_put`` with compute — the role the
+reference's pinned-memory + async memcpy path plays on CUDA.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable
+
+import numpy as np
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.data.dataset import Dataset, IterableDataset
+from paddle_tpu.data.sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate"]
+
+_STOP = object()
+
+
+def default_collate(samples):
+    """Stack a list of samples into a batch (numpy), matching the
+    reference's default_collate_fn semantics (nested tuples/dicts ok)."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DataLoader:
+    def __init__(self, dataset, *, batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Callable | None = None,
+                 num_workers: int = 0, prefetch_factor: int | None = None,
+                 batch_sampler: BatchSampler | None = None,
+                 device_put: bool = False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate
+        self.num_workers = int(num_workers)
+        self.prefetch = (prefetch_factor if prefetch_factor is not None
+                         else flag("host_prefetch_buffer"))
+        self.device_put = device_put
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset=dataset, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------------
+    def _batches(self):
+        if self._iterable:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._maybe_device(self._batches())
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        err: list[BaseException] = []
+
+        def producer():
+            try:
+                for batch in self._batches():
+                    q.put(batch)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(_STOP)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        def drain():
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        yield from self._maybe_device(drain())
+
+    def _maybe_device(self, it: Iterable):
+        if not self.device_put:
+            yield from it
+            return
+        # double-buffer: keep one batch in flight on the device
+        import jax
+
+        prev = None
+        for batch in it:
+            nxt = jax.tree_util.tree_map(jax.device_put, batch)
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev

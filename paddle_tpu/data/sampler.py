@@ -1,0 +1,123 @@
+"""Samplers (reference ``python/paddle/fluid/dataloader/batch_sampler.py``
+and ``python/paddle/io`` DistributedBatchSampler)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+           "DistributedBatchSampler"]
+
+
+class Sampler:
+    def __iter__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __len__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __init__(self, data_source):
+        self.n = len(data_source)
+
+    def __iter__(self):
+        return iter(range(self.n))
+
+    def __len__(self):
+        return self.n
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, seed: int | None = None):
+        self.n = len(data_source)
+        self.seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        seed = (self.seed if self.seed is not None else 0) + self._epoch
+        self._epoch += 1
+        return iter(np.random.RandomState(seed).permutation(self.n).tolist())
+
+    def __len__(self):
+        return self.n
+
+
+class BatchSampler(Sampler):
+    def __init__(self, sampler: Sampler | None = None, dataset=None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False):
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shard batches across data-parallel processes (reference
+    DistributedBatchSampler). On TPU each *process* feeds its local chips;
+    rank/world default to jax process info."""
+
+    def __init__(self, dataset, batch_size: int, num_replicas: int | None = None,
+                 rank: int | None = None, shuffle: bool = False,
+                 drop_last: bool = False, seed: int = 0):
+        import jax
+
+        self.num_replicas = (num_replicas if num_replicas is not None
+                             else jax.process_count())
+        self.rank = rank if rank is not None else jax.process_index()
+        self.dataset_len = len(dataset)
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+        self.num_samples = math.ceil(self.dataset_len / self.num_replicas)
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def __iter__(self):
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self._epoch).permutation(
+                self.dataset_len).tolist()
+            self._epoch += 1
+        else:
+            order = list(range(self.dataset_len))
+        # pad to be evenly divisible, then take this rank's strided slice
+        pad = self.num_samples * self.num_replicas - len(order)
+        order += order[:pad]
+        local = order[self.rank::self.num_replicas]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return math.ceil(self.num_samples / self.batch_size)

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu import amp as amp_mod
 from paddle_tpu.core import rng
-from paddle_tpu.core.module import apply_updates
+from paddle_tpu.core.module import apply_updates, trainable_mask
 from paddle_tpu.core.strategy import DistributedStrategy
 from paddle_tpu.nn.stateful import map_modules
 from paddle_tpu.nn.scan import ScannedBlocks
@@ -151,6 +151,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
     # ---- sharding layout -------------------------------------------------
     param_specs = param_specs_for_stage(model, mesh, stage)
+    train_mask = trainable_mask(model)
 
     sp_enabled = (strategy.sequence_parallel.enable
                   and strategy.sequence_parallel.degree > 1)
@@ -189,19 +190,23 @@ def build_train_step(model, optimizer, loss_fn=None, *,
         def compute_loss(m):
             if amp_enabled:
                 m = amp_mod.cast_model(m, amp_dtype)
+            from paddle_tpu.nn.stateful import state_tape
             with rng.stream(key):
                 with amp_mod.auto_cast(
                         enable=amp_enabled,
                         dtype=str(amp_dtype) if amp_enabled else "bfloat16",
                         custom_white_list=amp_cfg.custom_white_list,
                         custom_black_list=amp_cfg.custom_black_list):
-                    loss = loss_fn(m, batch, training=True)
+                    with state_tape() as tape:
+                        loss = loss_fn(m, batch, training=True)
+            # the tape (BatchNorm running stats etc.) rides has_aux out of
+            # the grad trace and is merged into the updated model below
             if use_scaler:
-                return scaler.scale(loss, state.scaler), loss
-            return loss, loss
+                return scaler.scale(loss, state.scaler), (loss, dict(tape))
+            return loss, (loss, dict(tape))
 
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (_, loss), grads = grad_fn(model)
+        (_, (loss, tape)), grads = grad_fn(model)
         grads, all_finite = (scaler.unscale(grads, state.scaler)
                              if use_scaler else (grads, jnp.asarray(True)))
 
@@ -227,10 +232,17 @@ def build_train_step(model, optimizer, loss_fn=None, *,
         apply_gate = jnp.logical_and(do_apply, all_finite)
         updates = jax.tree_util.tree_map(
             lambda u: jnp.where(apply_gate, u, jnp.zeros_like(u)), updates)
+        # buffers (BN running stats) never take optimizer updates — they
+        # change only through the state tape merge below
+        updates = jax.tree_util.tree_map(
+            lambda u, t: u if t else jnp.zeros_like(u), updates, train_mask)
         new_opt = jax.tree_util.tree_map(
             lambda n, o: jnp.where(apply_gate, n, o) if hasattr(n, "shape")
             else n, new_opt, state.opt_state)
         new_model = apply_updates(model, updates)
+        if tape:
+            from paddle_tpu.nn.stateful import merge_state
+            new_model = merge_state(new_model, tape)
         if k_steps > 1:
             acc = jax.tree_util.tree_map(
                 lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), acc)

@@ -1,0 +1,11 @@
+"""paddle_tpu.hapi — high-level Keras-like training API.
+
+Reference: ``python/paddle/hapi/model.py:808`` (Model.fit/prepare/
+evaluate/predict, callbacks, progbar).
+"""
+
+from paddle_tpu.hapi.callbacks import (
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint,
+    ProgBarLogger,
+)
+from paddle_tpu.hapi.model import Model

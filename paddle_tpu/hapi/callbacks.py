@@ -1,0 +1,115 @@
+"""Training callbacks (reference ``python/paddle/hapi/callbacks.py``)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRSchedulerCallback", "CallbackList"]
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model):
+        self.callbacks = list(callbacks or [])
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        def call(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, name)(*args, **kwargs)
+        return call
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress logging (reference ProgBarLogger)."""
+
+    def __init__(self, log_freq: int = 10):
+        self.log_freq = log_freq
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        self._steps = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps += 1
+        if self._steps % self.log_freq == 0:
+            items = " ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                             if isinstance(v, (int, float)))
+            rate = self._steps / max(time.time() - self._t0, 1e-9)
+            print(f"epoch {self._epoch} step {self._steps}: {items} "
+                  f"({rate:.1f} steps/s)", file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        items = " ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                         if isinstance(v, (int, float)))
+        print(f"epoch {epoch} done in {time.time()-self._t0:.1f}s {items}",
+              file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    """Periodic checkpoint save (reference ModelCheckpoint)."""
+
+    def __init__(self, save_dir: str, save_freq: int = 1):
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self.model.save_checkpoint(self.save_dir, step=epoch)
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 mode: str = "min", min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.min_delta = min_delta
+        self.best = float("inf")
+        self.wait = 0
+        self.stopped = False
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        score = self.sign * float(value)
+        if score < self.best - self.min_delta:
+            self.best = score
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped = True
+
+
+class LRSchedulerCallback(Callback):
+    """No-op placeholder for parity: schedules in this framework are pure
+    functions of the step traced into the update (see optimizer.lr), so
+    there is nothing to step on epoch end."""

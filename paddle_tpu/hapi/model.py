@@ -1,0 +1,179 @@
+"""High-level Model API — ``paddle.Model`` (hapi) equivalent.
+
+Reference: ``python/paddle/hapi/model.py:808`` (prepare ``:1241``,
+fit ``:1296``, train_batch ``:895``; auto distributed context ``:165``).
+The TPU version wraps the fleet strategy compiler: ``prepare`` builds the
+jitted sharded train step (single-chip is just the degenerate mesh), and
+``fit`` drives it from a DataLoader with callbacks/metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.hapi.callbacks import CallbackList, ProgBarLogger
+from paddle_tpu.nn.common import call_layer
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network: Module, strategy: DistributedStrategy | None = None):
+        self.network = network
+        self.strategy = strategy or DistributedStrategy()
+        self._step = None
+        self._state = None
+        self._loss = None
+        self._metrics = []
+        self._eval_jit = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics: Sequence | None = None):
+        """Bind optimizer/loss/metrics and compile the train step
+        (reference ``Model.prepare``)."""
+        from paddle_tpu.distributed.fleet.strategy_compiler import (
+            build_train_step,
+        )
+        from paddle_tpu.parallel.mesh import mesh_from_strategy
+
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        if optimizer is not None:
+            mesh = mesh_from_strategy(self.strategy)
+
+            def loss_fn(net, batch, training=True):
+                # BN running stats ride the strategy compiler's state tape
+                # (build_train_step opens it around this call)
+                x, y = batch
+                out = call_layer(net, x, training=training)
+                return loss(out, y)
+
+            self._step = build_train_step(
+                self.network, optimizer, loss_fn=loss_fn,
+                strategy=self.strategy, mesh=mesh)
+            self._state = self._step.init_state(self.network)
+        return self
+
+    @property
+    def network_live(self) -> Module:
+        return self._state.model if self._state is not None else self.network
+
+    # ------------------------------------------------------------------
+    def train_batch(self, x, y):
+        batch = (jnp.asarray(x), jnp.asarray(y))
+        batch = self._step.shard_batch(batch)
+        self._state, metrics = self._step(self._state, batch)
+        return {k: float(v) for k, v in metrics.items()
+                if jnp.ndim(v) == 0 and k != "all_finite"}
+
+    def eval_batch(self, x, y):
+        if self._eval_jit is None:
+            loss = self._loss
+
+            @jax.jit
+            def eval_fn(net, x, y):
+                out = call_layer(net, x, training=False)
+                return out, loss(out, y) if loss else jnp.zeros(())
+
+            self._eval_jit = eval_fn
+        out, l = self._eval_jit(self.network_live, jnp.asarray(x),
+                                jnp.asarray(y))
+        return out, float(l)
+
+    def predict_batch(self, x):
+        if not hasattr(self, "_pred_jit") or self._pred_jit is None:
+            @jax.jit
+            def pred(net, x):
+                return call_layer(net, x, training=False)
+            self._pred_jit = pred
+        return self._pred_jit(self.network_live, jnp.asarray(x))
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, epochs: int = 1,
+            callbacks: Sequence | None = None, log_freq: int = 10,
+            verbose: int = 1):
+        """Train from a DataLoader (reference ``Model.fit:1296``)."""
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.append(ProgBarLogger(log_freq))
+        cblist = CallbackList(cbs, self)
+        cblist.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            logs = {}
+            for step_idx, batch in enumerate(train_data):
+                x, y = batch
+                logs = self.train_batch(x, y)
+                cblist.on_train_batch_end(step_idx, logs)
+            if eval_data is not None:
+                logs.update(self.evaluate(eval_data, verbose=0))
+            cblist.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if any(getattr(c, "stopped", False) for c in cbs):
+                break
+        cblist.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, verbose: int = 0) -> dict:
+        for m in self._metrics:
+            m.reset()
+        total_loss, batches = 0.0, 0
+        for x, y in eval_data:
+            out, l = self.eval_batch(x, y)
+            total_loss += l
+            batches += 1
+            for m in self._metrics:
+                m.update(np.asarray(out), np.asarray(y))
+        logs = {"eval_loss": total_loss / max(batches, 1)}
+        for m in self._metrics:
+            logs[f"eval_{m.name()}"] = m.accumulate()
+        return logs
+
+    def predict(self, test_data):
+        outs = []
+        for batch in test_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(np.asarray(self.predict_batch(x)))
+        return np.concatenate(outs, axis=0)
+
+    # ------------------------------------------------------------------
+    def save(self, path: str):
+        from paddle_tpu.io import save_state_dict
+
+        save_state_dict(self.network_live, path)
+
+    def load(self, path: str):
+        from paddle_tpu.io import load_state_dict
+
+        net = load_state_dict(self.network_live, path)
+        if self._state is not None:
+            self._state = self._state._replace(model=net)
+        else:
+            self.network = net
+        return self
+
+    def save_checkpoint(self, directory: str, step: int):
+        from paddle_tpu.io import save_checkpoint
+
+        save_checkpoint(self._state, directory, step)
+
+    def load_checkpoint(self, directory: str, step: int | None = None):
+        from paddle_tpu.io import load_checkpoint
+
+        self._state = load_checkpoint(self._state, directory, step)
+        return self
+
+    def summary(self) -> str:
+        from paddle_tpu.core.module import count_params
+
+        lines = [f"{type(self.network).__name__}: "
+                 f"{count_params(self.network):,} parameters"]
+        return "\n".join(lines)

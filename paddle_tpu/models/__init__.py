@@ -8,4 +8,5 @@ configs: Llama-2 7B/70B, GPT-3 6.7B, ERNIE, ViT-L, Mamba-2).
 
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.mamba import MambaConfig, MambaForCausalLM
 from paddle_tpu.models.mlp import MLP, MNISTClassifier

@@ -1,0 +1,152 @@
+"""Mamba (selective state-space model) — BASELINE.json config
+"Mamba-2 selective-scan".
+
+TPU-native formulation: the selective recurrence
+``h_t = exp(Δ_t A) h_{t-1} + Δ_t B_t x_t`` is a linear first-order
+recurrence, so it runs as ``jax.lax.associative_scan`` (parallel prefix
+scan, log-depth on TPU) instead of the reference-style sequential CUDA
+kernel. A Pallas chunked-scan kernel can replace the inner scan for the
+hot path; the math here is the specification it must match.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Embedding, Linear
+from paddle_tpu.nn.initializer import Normal, Uniform
+from paddle_tpu.nn.norm import RMSNorm
+from paddle_tpu.nn.scan import ScannedBlocks
+
+__all__ = ["MambaConfig", "MambaBlock", "MambaForCausalLM",
+           "selective_scan"]
+
+
+@dataclass(frozen=True)
+class MambaConfig:
+    vocab_size: int = 50277
+    hidden_size: int = 768
+    num_layers: int = 24
+    state_size: int = 16
+    conv_kernel: int = 4
+    expand: int = 2
+    dt_rank: int | None = None        # defaults to ceil(hidden/16)
+    dtype: str = "float32"
+    remat: bool = False
+
+    @property
+    def inner_size(self) -> int:
+        return self.expand * self.hidden_size
+
+    @property
+    def rank(self) -> int:
+        return self.dt_rank or -(-self.hidden_size // 16)
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    state_size=8, dtype="float32")
+        base.update(kw)
+        return cls(**base)
+
+
+def selective_scan(u, delta, A, B, C, D):
+    """y = SSM(u) via parallel associative scan.
+
+    u:[B,T,Ei] delta:[B,T,Ei] A:[Ei,N] B,C:[B,T,N] D:[Ei]
+    """
+    # discretize: a = exp(Δ A)  [B,T,Ei,N];  b = Δ B u
+    dA = jnp.exp(delta[..., None] * A)                       # [B,T,Ei,N]
+    dBu = (delta * u)[..., None] * B[:, :, None, :]          # [B,T,Ei,N]
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    _, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+    y = jnp.einsum("btin,btn->bti", h, C)
+    return y + u * D
+
+
+class MambaBlock(Module):
+    def __init__(self, cfg: MambaConfig, key=None):
+        keys = rng.split_key(key, 5)
+        E, Ei, N, R = (cfg.hidden_size, cfg.inner_size, cfg.state_size,
+                       cfg.rank)
+        dtype = jnp.dtype(cfg.dtype)
+        self.in_proj = Linear(E, 2 * Ei, bias=False, key=keys[0], dtype=dtype)
+        # depthwise causal conv weights [Ei, K]
+        self.conv_weight = Uniform(-1, 1)(
+            keys[1], (Ei, cfg.conv_kernel), dtype) / math.sqrt(cfg.conv_kernel)
+        self.conv_bias = jnp.zeros((Ei,), dtype)
+        self.x_proj = Linear(Ei, R + 2 * N, bias=False, key=keys[2],
+                             dtype=dtype)
+        self.dt_proj = Linear(R, Ei, key=keys[3], dtype=dtype)
+        # S4D-real init: A_log so A = -exp(A_log) stays negative (stable)
+        self.A_log = jnp.log(jnp.broadcast_to(
+            jnp.arange(1, N + 1, dtype=jnp.float32), (Ei, N)).copy())
+        self.D = jnp.ones((Ei,), jnp.float32)
+        self.out_proj = Linear(Ei, E, bias=False, key=keys[4], dtype=dtype)
+        self.norm = RMSNorm(E, dtype=dtype)
+        self.state_size = N
+        self.rank = R
+        self.conv_kernel = cfg.conv_kernel
+
+    def __call__(self, x, training: bool = False):
+        residual = x
+        x = self.norm(x)
+        xz = self.in_proj(x)
+        u, z = jnp.split(xz, 2, axis=-1)                     # [B,T,Ei]
+        # causal depthwise conv over time
+        K = self.conv_kernel
+        pad = jnp.pad(u, ((0, 0), (K - 1, 0), (0, 0)))
+        windows = jnp.stack([pad[:, i:i + u.shape[1]] for i in range(K)],
+                            axis=-1)                          # [B,T,Ei,K]
+        u = jnp.einsum("btek,ek->bte", windows, self.conv_weight)
+        u = F.silu(u + self.conv_bias)
+
+        proj = self.x_proj(u)
+        dt, Bc, Cc = jnp.split(proj, [self.rank,
+                                      self.rank + self.state_size], axis=-1)
+        delta = F.softplus(self.dt_proj(dt))                  # [B,T,Ei]
+        A = -jnp.exp(self.A_log)                              # [Ei,N]
+        y = selective_scan(u.astype(jnp.float32),
+                           delta.astype(jnp.float32), A,
+                           Bc.astype(jnp.float32), Cc.astype(jnp.float32),
+                           self.D)
+        y = y.astype(x.dtype) * F.silu(z)
+        return residual + self.out_proj(y)
+
+
+class MambaForCausalLM(Module):
+    def __init__(self, cfg: MambaConfig, key=None):
+        keys = rng.split_key(key, 2 + cfg.num_layers)
+        dtype = jnp.dtype(cfg.dtype)
+        self.embed = Embedding(cfg.vocab_size, cfg.hidden_size,
+                               weight_init=Normal(0.0, 0.02), dtype=dtype,
+                               key=keys[0])
+        self.blocks = ScannedBlocks(
+            lambda i: MambaBlock(cfg, key=keys[2 + i]), cfg.num_layers,
+            remat=cfg.remat)
+        self.norm = RMSNorm(cfg.hidden_size, dtype=dtype)
+        self.config = cfg
+
+    def __call__(self, input_ids, training: bool = False):
+        x = self.embed(input_ids)
+        x = self.blocks(x, training=training)
+        x = self.norm(x)
+        return x @ self.embed.weight.T       # tied embeddings
+
+    def loss(self, input_ids, labels, ignore_index: int = -100,
+             training: bool = True):
+        logits = self(input_ids, training=training)
+        return F.cross_entropy(logits[:, :-1].astype(jnp.float32),
+                               labels[:, 1:], ignore_index=ignore_index)

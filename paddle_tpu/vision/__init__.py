@@ -1,0 +1,8 @@
+"""paddle_tpu.vision — models, datasets, transforms.
+
+Reference: ``python/paddle/vision`` (models: lenet/vgg/resnet/mobilenet,
+datasets: MNIST/CIFAR/..., transforms).
+"""
+
+from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision.datasets import MNIST, RandomImageDataset

@@ -1,0 +1,88 @@
+"""Vision datasets (reference ``python/paddle/vision/datasets/``).
+
+Zero-egress environment: MNIST reads the standard IDX files from a local
+directory if present; ``RandomImageDataset`` provides deterministic
+synthetic data for tests/smoke training (the role of the reference's
+``paddle.dataset.common`` fake data helpers).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.data.dataset import Dataset
+
+__all__ = ["MNIST", "RandomImageDataset"]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+class MNIST(Dataset):
+    """MNIST from local IDX files (``train-images-idx3-ubyte[.gz]`` etc. in
+    ``root``). No download — zero-egress environment."""
+
+    def __init__(self, root: str, mode: str = "train", transform=None,
+                 normalize: bool = True):
+        prefix = "train" if mode == "train" else "t10k"
+        imgs = labels = None
+        for suffix in ("", ".gz"):
+            ip = os.path.join(root, f"{prefix}-images-idx3-ubyte{suffix}")
+            lp = os.path.join(root, f"{prefix}-labels-idx1-ubyte{suffix}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                imgs, labels = _read_idx(ip), _read_idx(lp)
+                break
+        if imgs is None:
+            raise FileNotFoundError(
+                f"MNIST idx files not found under {root!r} (no download in "
+                "this environment; place train-images-idx3-ubyte[.gz] there)")
+        self.images = imgs.astype(np.float32)[:, None]  # [N, 1, 28, 28]
+        if normalize:
+            self.images = self.images / 127.5 - 1.0
+        self.labels = labels.astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class RandomImageDataset(Dataset):
+    """Deterministic synthetic labeled images for tests and smoke runs."""
+
+    def __init__(self, num_samples: int = 256, image_shape=(1, 28, 28),
+                 num_classes: int = 10, seed: int = 0, separable: bool = True):
+        rs = np.random.RandomState(seed)
+        self.labels = rs.randint(0, num_classes, num_samples).astype(np.int64)
+        self.images = rs.randn(num_samples, *image_shape).astype(np.float32)
+        if separable:
+            # plant a class-dependent signal so models can actually learn;
+            # signals depend only on (seed, class) so train/val splits with
+            # different sizes share them
+            rs_sig = np.random.RandomState(seed + 99991)
+            for c in range(num_classes):
+                mask = self.labels == c
+                sig = rs_sig.randn(*image_shape).astype(np.float32)
+                self.images[mask] += 2.0 * sig
+        self.num_classes = num_classes
+
+    def __getitem__(self, idx):
+        return self.images[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,29 @@
+"""LeNet (reference ``python/paddle/vision/models/lenet.py``)."""
+
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.activation import ReLU
+from paddle_tpu.nn.common import Flatten, Linear, Sequential
+from paddle_tpu.nn.conv import Conv2D, MaxPool2D
+
+__all__ = ["LeNet"]
+
+
+class LeNet(Module):
+    def __init__(self, num_classes: int = 10, key=None):
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2),
+        )
+        self.fc = Sequential(
+            Flatten(),
+            Linear(400, 120), ReLU(),
+            Linear(120, 84), ReLU(),
+            Linear(84, num_classes),
+        )
+
+    def __call__(self, x, training: bool = False):
+        return self.fc(self.features(x, training=training))

@@ -1,0 +1,78 @@
+"""MobileNetV2 (reference ``python/paddle/vision/models/mobilenetv2.py``).
+Depthwise convs = grouped conv (groups == channels), which XLA lowers to
+TPU-friendly contractions."""
+
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Dropout, Linear
+from paddle_tpu.nn.conv import AdaptiveAvgPool2D, Conv2D
+from paddle_tpu.nn.norm import BatchNorm2D
+
+__all__ = ["MobileNetV2"]
+
+
+class ConvBNReLU(Module):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
+        pad = (kernel - 1) // 2
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                           groups=groups, bias=False)
+        self.bn = BatchNorm2D(out_c)
+
+    def __call__(self, x, training: bool = False):
+        return F.relu6(self.bn(self.conv(x), training=training))
+
+
+class InvertedResidual(Module):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(in_c, hidden, kernel=1))
+        layers.append(ConvBNReLU(hidden, hidden, stride=stride,
+                                 groups=hidden))
+        self.layers = tuple(layers)
+        self.project = Conv2D(hidden, out_c, 1, bias=False)
+        self.project_bn = BatchNorm2D(out_c)
+
+    def __call__(self, x, training: bool = False):
+        out = x
+        for layer in self.layers:
+            out = layer(out, training=training)
+        out = self.project_bn(self.project(out), training=training)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Module):
+    def __init__(self, num_classes: int = 1000, width_mult: float = 1.0,
+                 dropout: float = 0.2):
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_c = int(32 * width_mult)
+        self.stem = ConvBNReLU(3, in_c, stride=2)
+        blocks = []
+        for t, c, n, s in cfg:
+            out_c = int(c * width_mult)
+            for i in range(n):
+                blocks.append(InvertedResidual(in_c, out_c,
+                                               s if i == 0 else 1, t))
+                in_c = out_c
+        self.blocks = tuple(blocks)
+        last = int(1280 * max(1.0, width_mult))
+        self.head_conv = ConvBNReLU(in_c, last, kernel=1)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.dropout = Dropout(dropout)
+        self.fc = Linear(last, num_classes)
+
+    def __call__(self, x, training: bool = False):
+        x = self.stem(x, training=training)
+        for b in self.blocks:
+            x = b(x, training=training)
+        x = self.head_conv(x, training=training)
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.fc(self.dropout(x, training=training))

@@ -1,0 +1,127 @@
+"""ResNet family (reference ``python/paddle/vision/models/resnet.py``).
+
+Conv+BN+ReLU: XLA fuses BN (inference) into the conv epilogue; training-
+mode batch stats ride the state tape. Data format NCHW for reference API
+parity (XLA relayouts internally for the TPU convolution).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Linear
+from paddle_tpu.nn.conv import AdaptiveAvgPool2D, Conv2D, MaxPool2D
+from paddle_tpu.nn.norm import BatchNorm2D
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101"]
+
+
+class BasicBlock(Module):
+    expansion = 1
+
+    def __init__(self, in_c: int, out_c: int, stride: int = 1,
+                 downsample=None, key=None):
+        k1, k2 = rng.split_key(key)
+        self.conv1 = Conv2D(in_c, out_c, 3, stride=stride, padding=1,
+                            bias=False, key=k1)
+        self.bn1 = BatchNorm2D(out_c)
+        self.conv2 = Conv2D(out_c, out_c, 3, padding=1, bias=False, key=k2)
+        self.bn2 = BatchNorm2D(out_c)
+        self.downsample = downsample
+
+    def __call__(self, x, training: bool = False):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x), training=training))
+        out = self.bn2(self.conv2(out), training=training)
+        if self.downsample is not None:
+            identity = self.downsample(x, training=training)
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Module):
+    expansion = 4
+
+    def __init__(self, in_c: int, out_c: int, stride: int = 1,
+                 downsample=None, key=None):
+        keys = rng.split_key(key, 3)
+        self.conv1 = Conv2D(in_c, out_c, 1, bias=False, key=keys[0])
+        self.bn1 = BatchNorm2D(out_c)
+        self.conv2 = Conv2D(out_c, out_c, 3, stride=stride, padding=1,
+                            bias=False, key=keys[1])
+        self.bn2 = BatchNorm2D(out_c)
+        self.conv3 = Conv2D(out_c, out_c * 4, 1, bias=False, key=keys[2])
+        self.bn3 = BatchNorm2D(out_c * 4)
+        self.downsample = downsample
+
+    def __call__(self, x, training: bool = False):
+        identity = x
+        out = F.relu(self.bn1(self.conv1(x), training=training))
+        out = F.relu(self.bn2(self.conv2(out), training=training))
+        out = self.bn3(self.conv3(out), training=training)
+        if self.downsample is not None:
+            identity = self.downsample(x, training=training)
+        return F.relu(out + identity)
+
+
+class _Downsample(Module):
+    def __init__(self, in_c: int, out_c: int, stride: int, key=None):
+        self.conv = Conv2D(in_c, out_c, 1, stride=stride, bias=False, key=key)
+        self.bn = BatchNorm2D(out_c)
+
+    def __call__(self, x, training: bool = False):
+        return self.bn(self.conv(x), training=training)
+
+
+class ResNet(Module):
+    def __init__(self, block, depths, num_classes: int = 1000,
+                 in_channels: int = 3, key=None):
+        self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                            bias=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, 64, depths[0], 1)
+        self.layer2 = self._make_layer(block, 64 * block.expansion, 128,
+                                       depths[1], 2)
+        self.layer3 = self._make_layer(block, 128 * block.expansion, 256,
+                                       depths[2], 2)
+        self.layer4 = self._make_layer(block, 256 * block.expansion, 512,
+                                       depths[3], 2)
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(512 * block.expansion, num_classes)
+
+    @staticmethod
+    def _make_layer(block, in_c, out_c, depth, stride):
+        layers = []
+        downsample = None
+        if stride != 1 or in_c != out_c * block.expansion:
+            downsample = _Downsample(in_c, out_c * block.expansion, stride)
+        layers.append(block(in_c, out_c, stride, downsample))
+        for _ in range(1, depth):
+            layers.append(block(out_c * block.expansion, out_c))
+        return tuple(layers)
+
+    def __call__(self, x, training: bool = False):
+        x = F.relu(self.bn1(self.conv1(x), training=training))
+        x = self.maxpool(x)
+        for stage in (self.layer1, self.layer2, self.layer3, self.layer4):
+            for blk in stage:
+                x = blk(x, training=training)
+        x = self.avgpool(x)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def resnet18(num_classes: int = 1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes: int = 1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes: int = 1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)

@@ -1,0 +1,49 @@
+"""VGG (reference ``python/paddle/vision/models/vgg.py``)."""
+
+from __future__ import annotations
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.activation import ReLU
+from paddle_tpu.nn.common import Dropout, Flatten, Linear, Sequential
+from paddle_tpu.nn.conv import Conv2D, MaxPool2D
+
+__all__ = ["VGG", "vgg11", "vgg16"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+}
+
+
+class VGG(Module):
+    def __init__(self, cfg: str = "D", num_classes: int = 1000,
+                 dropout: float = 0.5):
+        layers = []
+        in_c = 3
+        for v in _CFGS[cfg]:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(in_c, v, 3, padding=1))
+                layers.append(ReLU())
+                in_c = v
+        self.features = Sequential(*layers)
+        self.classifier = Sequential(
+            Flatten(),
+            Linear(512 * 7 * 7, 4096), ReLU(), Dropout(dropout),
+            Linear(4096, 4096), ReLU(), Dropout(dropout),
+            Linear(4096, num_classes),
+        )
+
+    def __call__(self, x, training: bool = False):
+        return self.classifier(self.features(x, training=training),
+                               training=training)
+
+
+def vgg11(**kw):
+    return VGG("A", **kw)
+
+
+def vgg16(**kw):
+    return VGG("D", **kw)

@@ -1,0 +1,87 @@
+"""Vision Transformer (BASELINE.json config "ViT-L").
+
+Patch embedding as a strided conv feeding scan-stacked transformer
+blocks — the same ScannedBlocks machinery as the LLMs, so ViT trains
+under any fleet strategy (dp/fsdp/tp) unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Dropout, Linear
+from paddle_tpu.nn.conv import Conv2D
+from paddle_tpu.nn.initializer import Normal, TruncatedNormal
+from paddle_tpu.nn.norm import LayerNorm
+from paddle_tpu.nn.scan import ScannedBlocks
+
+__all__ = ["ViT", "vit_b_16", "vit_l_16"]
+
+
+class ViTBlock(Module):
+    def __init__(self, dim: int, heads: int, mlp_dim: int,
+                 dropout: float = 0.0, key=None):
+        keys = rng.split_key(key, 4)
+        self.ln1 = LayerNorm(dim)
+        self.wqkv = Linear(dim, 3 * dim, key=keys[0], pspec=P("fsdp", "tp"))
+        self.wo = Linear(dim, dim, key=keys[1], pspec=P("tp", "fsdp"))
+        self.ln2 = LayerNorm(dim)
+        self.fc1 = Linear(dim, mlp_dim, key=keys[2], pspec=P("fsdp", "tp"))
+        self.fc2 = Linear(mlp_dim, dim, key=keys[3], pspec=P("tp", "fsdp"))
+        self.drop = Dropout(dropout)
+        self.heads = heads
+        self.head_dim = dim // heads
+
+    def __call__(self, x, training: bool = False):
+        B, T, E = x.shape
+        h = self.ln1(x)
+        qkv = self.wqkv(h).reshape(B, T, 3, self.heads, self.head_dim)
+        a = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                           qkv[:, :, 2], causal=False)
+        x = x + self.drop(self.wo(a.reshape(B, T, E)), training=training)
+        h = self.ln2(x)
+        h = self.fc2(F.gelu(self.fc1(h)))
+        return x + self.drop(h, training=training)
+
+
+class ViT(Module):
+    def __init__(self, image_size: int = 224, patch_size: int = 16,
+                 dim: int = 768, depth: int = 12, heads: int = 12,
+                 mlp_dim: int = 3072, num_classes: int = 1000,
+                 dropout: float = 0.0, key=None):
+        n_patches = (image_size // patch_size) ** 2
+        self.patch_embed = Conv2D(3, dim, patch_size, stride=patch_size)
+        self.cls_token = TruncatedNormal(std=0.02)(
+            rng.next_key(), (1, 1, dim))
+        self.pos_embed = TruncatedNormal(std=0.02)(
+            rng.next_key(), (1, n_patches + 1, dim))
+        self.blocks = ScannedBlocks(
+            lambda i: ViTBlock(dim, heads, mlp_dim, dropout), depth)
+        self.ln = LayerNorm(dim)
+        self.head = Linear(dim, num_classes,
+                           weight_init=Normal(0.0, 0.01))
+        self.dropout = Dropout(dropout)
+
+    def __call__(self, x, training: bool = False):
+        B = x.shape[0]
+        p = self.patch_embed(x)                       # [B, dim, H', W']
+        p = p.reshape(B, p.shape[1], -1).transpose(0, 2, 1)
+        cls = jnp.broadcast_to(self.cls_token, (B, 1, p.shape[-1]))
+        x = jnp.concatenate([cls, p], axis=1) + self.pos_embed
+        x = self.dropout(x, training=training)
+        x = self.blocks(x, training=training)
+        return self.head(self.ln(x[:, 0]))
+
+
+def vit_b_16(**kw):
+    return ViT(dim=768, depth=12, heads=12, mlp_dim=3072, **kw)
+
+
+def vit_l_16(**kw):
+    return ViT(dim=1024, depth=24, heads=16, mlp_dim=4096, **kw)

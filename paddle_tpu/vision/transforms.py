@@ -1,0 +1,86 @@
+"""Image transforms (reference ``python/paddle/vision/transforms``) —
+numpy host-side ops composed by ``Compose``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "Resize", "RandomCrop",
+           "RandomHorizontalFlip", "ToCHW", "CenterCrop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return (np.asarray(img, np.float32) - self.mean) / self.std
+
+
+class ToCHW:
+    def __call__(self, img):
+        img = np.asarray(img)
+        return img.transpose(2, 0, 1) if img.ndim == 3 else img
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        # nearest-neighbour host resize (keeps zero deps)
+        c, h, w = img.shape
+        oh, ow = self.size
+        yi = (np.arange(oh) * h // oh).clip(0, h - 1)
+        xi = (np.arange(ow) * w // ow).clip(0, w - 1)
+        return img[:, yi][:, :, xi]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        c, h, w = img.shape
+        th, tw = self.size
+        top, left = (h - th) // 2, (w - tw) // 2
+        return img[:, top:top + th, left:left + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding: int = 0, seed: int = 0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        if self.padding:
+            img = np.pad(img, ((0, 0), (self.padding, self.padding),
+                               (self.padding, self.padding)))
+        c, h, w = img.shape
+        th, tw = self.size
+        top = self.rs.randint(0, h - th + 1)
+        left = self.rs.randint(0, w - tw + 1)
+        return img[:, top:top + th, left:left + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5, seed: int = 0):
+        self.prob = prob
+        self.rs = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        if self.rs.rand() < self.prob:
+            return img[:, :, ::-1].copy()
+        return img

@@ -1,0 +1,174 @@
+"""hapi Model + DataLoader integration: the reference's book-test
+equivalent — train a classifier end to end, evaluate, predict, checkpoint."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import metric, nn
+from paddle_tpu import optimizer as optim
+from paddle_tpu.data import (
+    BatchSampler, DataLoader, DistributedBatchSampler, TensorDataset,
+    random_split,
+)
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.models.mlp import MLP
+from paddle_tpu.vision.datasets import RandomImageDataset
+from paddle_tpu.vision.models import LeNet
+
+
+def test_dataloader_batching_and_workers():
+    ds = TensorDataset(np.arange(10, dtype=np.float32).reshape(10, 1),
+                       np.arange(10))
+    dl = DataLoader(ds, batch_size=3, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (3, 1)
+    assert batches[-1][0].shape == (1, 1)
+    # threaded prefetch gives identical content
+    dl2 = DataLoader(ds, batch_size=3, num_workers=2)
+    for (a, _), (b, _) in zip(batches, dl2):
+        np.testing.assert_allclose(a, b)
+
+
+def test_dataloader_shuffle_reshuffles_per_epoch():
+    ds = TensorDataset(np.arange(32, dtype=np.float32))
+    dl = DataLoader(ds, batch_size=32, shuffle=True)
+    e1 = next(iter(dl))
+    e2 = next(iter(dl))
+    assert not np.allclose(e1, e2)
+    assert sorted(e1.tolist()) == sorted(e2.tolist())
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = TensorDataset(np.arange(16))
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        for batch in s:
+            seen.extend(batch)
+    assert sorted(seen) == list(range(16))
+
+
+def test_random_split_disjoint():
+    ds = TensorDataset(np.arange(20))
+    a, b = random_split(ds, [15, 5])
+    assert len(a) == 15 and len(b) == 5
+    assert set(a.indices).isdisjoint(b.indices)
+
+
+def test_hapi_fit_evaluate_predict(tmp_path):
+    paddle_tpu.seed(0)
+    train = RandomImageDataset(128, (784,), num_classes=4, seed=0)
+    val = RandomImageDataset(64, (784,), num_classes=4, seed=0)
+    model = Model(MLP([784, 64, 4]))
+    model.prepare(optimizer=optim.Adam(1e-2),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=[metric.Accuracy()])
+    loader = DataLoader(train, batch_size=32, shuffle=True)
+    val_loader = DataLoader(val, batch_size=32)
+    history = model.fit(loader, val_loader, epochs=3, verbose=0)
+    assert history[-1]["eval_acc" + "uracy"] > 0.9
+    assert history[-1]["loss"] <= history[0]["loss"]
+    preds = model.predict(val_loader)
+    assert preds.shape == (64, 4)
+    # save / load round trip
+    model.save(str(tmp_path / "mlp"))
+    m2 = Model(MLP([784, 64, 4]))
+    m2.prepare(optimizer=optim.Adam(1e-2), loss=nn.CrossEntropyLoss())
+    m2.load(str(tmp_path / "mlp"))
+    p2 = m2.predict(val_loader)
+    np.testing.assert_allclose(preds, p2, rtol=1e-5, atol=1e-5)
+
+
+def test_hapi_lenet_with_batchnorm_free_path():
+    paddle_tpu.seed(0)
+    ds = RandomImageDataset(64, (1, 28, 28), num_classes=4, seed=1)
+    model = Model(LeNet(num_classes=4))
+    model.prepare(optimizer=optim.Adam(1e-2), loss=nn.CrossEntropyLoss())
+    history = model.fit(DataLoader(ds, batch_size=16), epochs=2, verbose=0)
+    assert history[-1]["loss"] < history[0]["loss"]
+
+
+def test_hapi_resnet_updates_bn_stats():
+    paddle_tpu.seed(0)
+    from paddle_tpu.vision.models import resnet18
+
+    ds = RandomImageDataset(16, (3, 32, 32), num_classes=2, seed=2)
+    net = resnet18(num_classes=2)
+    model = Model(net)
+    model.prepare(optimizer=optim.SGD(1e-2), loss=nn.CrossEntropyLoss())
+    before = np.asarray(net.bn1.running_mean).copy()
+    model.fit(DataLoader(ds, batch_size=8), epochs=1, verbose=0)
+    after = np.asarray(model.network_live.bn1.running_mean)
+    assert not np.allclose(before, after), "BN stats did not update"
+
+
+def test_early_stopping():
+    stopper = EarlyStopping(monitor="loss", patience=1)
+    stopper.on_epoch_end(0, {"loss": 1.0})
+    stopper.on_epoch_end(1, {"loss": 1.5})
+    stopper.on_epoch_end(2, {"loss": 1.6})
+    assert stopper.stopped
+
+
+def test_mamba_tiny_trains():
+    paddle_tpu.seed(0)
+    import jax
+    from paddle_tpu.models import MambaConfig, MambaForCausalLM
+
+    cfg = MambaConfig.tiny()
+    m = MambaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 32))
+                      .astype(np.int32))
+    loss0 = float(m.loss(ids, ids, training=False))
+
+    @jax.jit
+    def step(m):
+        g = jax.grad(lambda mm: mm.loss(ids, ids, training=False))(m)
+        return jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg, m, g)
+
+    for _ in range(10):
+        m = step(m)
+    loss1 = float(m.loss(ids, ids, training=False))
+    assert loss1 < loss0
+
+
+def test_selective_scan_matches_sequential():
+    from paddle_tpu.models.mamba import selective_scan
+    import jax
+
+    rs = np.random.RandomState(0)
+    B, T, Ei, N = 2, 6, 4, 3
+    u = jnp.asarray(rs.randn(B, T, Ei).astype(np.float32))
+    delta = jnp.asarray(np.abs(rs.randn(B, T, Ei)).astype(np.float32))
+    A = -jnp.asarray(np.abs(rs.randn(Ei, N)).astype(np.float32))
+    Bc = jnp.asarray(rs.randn(B, T, N).astype(np.float32))
+    C = jnp.asarray(rs.randn(B, T, N).astype(np.float32))
+    D = jnp.asarray(rs.randn(Ei).astype(np.float32))
+    y = selective_scan(u, delta, A, Bc, C, D)
+
+    # sequential reference
+    h = np.zeros((B, Ei, N), np.float32)
+    ys = []
+    for t in range(T):
+        dA = np.exp(np.asarray(delta[:, t])[..., None] * np.asarray(A))
+        dBu = (np.asarray(delta[:, t]) * np.asarray(u[:, t]))[..., None] \
+            * np.asarray(Bc[:, t])[:, None, :]
+        h = dA * h + dBu
+        ys.append(np.einsum("bin,bn->bi", h, np.asarray(C[:, t])))
+    ref = np.stack(ys, 1) + np.asarray(u) * np.asarray(D)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_vision_model_shapes():
+    paddle_tpu.seed(0)
+    from paddle_tpu.vision.models import MobileNetV2, ViT, vgg11
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 32, 32)
+                    .astype(np.float32))
+    vit = ViT(image_size=32, patch_size=8, dim=32, depth=2, heads=2,
+              mlp_dim=64, num_classes=5)
+    assert vit(x).shape == (2, 5)
