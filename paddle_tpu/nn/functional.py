@@ -20,6 +20,22 @@ from jax import lax
 
 from paddle_tpu.core import rng
 
+_PALLAS_UNSET = object()
+_PALLAS = _PALLAS_UNSET
+
+
+def _pallas():
+    """The paddle_tpu.ops.pallas kernel set, or None when Pallas is
+    unavailable in this jax build (dispatch then stays on the jnp path)."""
+    global _PALLAS
+    if _PALLAS is _PALLAS_UNSET:
+        try:
+            from paddle_tpu.ops import pallas as pk
+            _PALLAS = pk
+        except ImportError:
+            _PALLAS = None
+    return _PALLAS
+
 __all__ = [
     "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
     "leaky_relu", "elu", "softplus", "hardswish", "hardsigmoid", "mish",
@@ -114,9 +130,14 @@ def log_softmax(x, axis: int = -1):
 
 
 def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5, axis=-1):
-    """Reference kernel: ``operators/layer_norm_op.cu`` (Welford rows); on
-    TPU XLA fuses this; a Pallas version exists for the fused+residual form
-    (``paddle_tpu.ops.pallas.layer_norm``)."""
+    """Row layer-norm (reference kernel ``operators/layer_norm_op.cu``,
+    Welford rows). On TPU, supported shapes dispatch to the fused Pallas
+    kernel (``paddle_tpu.ops.pallas.layer_norm``)."""
+    _pk = _pallas()
+    if _pk is not None and axis in (-1, x.ndim - 1):
+        from paddle_tpu.ops.pallas import norm as _pn
+        if _pk._support.auto_dispatch() and _pn.supported(x, weight, bias):
+            return _pk.layer_norm(x, weight, bias, epsilon)
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
     y = (x - mean) * lax.rsqrt(var + epsilon)
@@ -129,7 +150,13 @@ def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5, axis=-1):
 
 def rms_norm(x, weight=None, epsilon: float = 1e-6):
     """RMSNorm (no mean subtraction) — the Llama-family norm. Computed in
-    fp32 and cast back, matching standard practice for bf16 training."""
+    fp32 and cast back, matching standard practice for bf16 training. On
+    TPU, supported shapes dispatch to the fused Pallas kernel."""
+    _pk = _pallas()
+    if _pk is not None:
+        from paddle_tpu.ops.pallas import norm as _pn
+        if _pk._support.auto_dispatch() and _pn.supported(x, weight):
+            return _pk.rms_norm(x, weight, epsilon)
     dtype = x.dtype
     xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
@@ -244,8 +271,22 @@ def clip(x, min=None, max=None):
 def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
                                ignore_index: int = -100, axis: int = -1):
     """Fused softmax+xent — numerically stable log-softmax formulation.
-    The reference fuses this in CUDA; XLA fuses the same graph, and a
-    Pallas kernel covers the [B*T, V] hot case."""
+    The reference fuses this in CUDA
+    (``operators/softmax_with_cross_entropy_op.cu``); on TPU the [N, V]
+    int-label hot case dispatches to the Pallas kernel, which saves only
+    the [N] log-sum-exp for backward instead of the [N, V] probabilities."""
+    _pk = _pallas()
+    if _pk is not None and not soft_label and axis in (-1, logits.ndim - 1):
+        from paddle_tpu.ops.pallas import softmax_xent as _px
+        v = logits.shape[-1]
+        flat = logits.reshape(-1, v)
+        lab = label.reshape(-1)
+        if _pk._support.auto_dispatch() and _px.supported(flat, lab):
+            valid = lab != ignore_index
+            safe = jnp.where(valid, lab, 0)
+            loss = _pk.softmax_cross_entropy(flat, safe)
+            loss = jnp.where(valid, loss, 0.0).astype(logits.dtype)
+            return loss.reshape(label.shape)
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         return -jnp.sum(label * logp, axis=axis)
@@ -350,16 +391,17 @@ def scaled_dot_product_attention(q, k, v, mask=None, *, causal: bool = False,
     if scale is None:
         scale = 1.0 / math.sqrt(D)
 
-    if use_pallas != "never" and dropout_p == 0.0 and mask is None:
-        try:
-            from paddle_tpu.ops.pallas import flash_attention as _fa
-            if _fa.supported(q, k, v, causal=causal):
-                return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
-        except ImportError:
-            pass
+    _pk = _pallas()
+    if (_pk is not None and use_pallas != "never" and dropout_p == 0.0
+            and mask is None):
+        if _pk.flash_attention_supported(q, k, v, causal=causal) and (
+                _pk._support.auto_dispatch() or use_pallas == "always"):
+            return _pk.flash_attention(q, k, v, causal=causal, scale=scale)
         if use_pallas == "always":
-            raise RuntimeError("Pallas flash attention unavailable for these "
-                               "inputs")
+            raise RuntimeError(
+                "use_pallas='always' but the flash kernel does not support "
+                f"q{q.shape} k{k.shape} {q.dtype} (need seq divisible by the "
+                "block size, head_dim in {64,128,256}, f32/bf16)")
 
     if Hkv != Hq:  # GQA: repeat kv heads
         rep = Hq // Hkv
@@ -390,7 +432,14 @@ def rotary_embedding(positions, dim: int, base: float = 10000.0,
 
 
 def apply_rotary(x, cos, sin):
-    """Apply rotary embedding to [B, T, H, D] (cos/sin [B?, T, D/2])."""
+    """Apply rotary embedding to [B, T, H, D] (cos/sin [B?, T, D/2]).
+    On TPU, the [T, D/2]-table case dispatches to the fused Pallas
+    kernel."""
+    _pk = _pallas()
+    if _pk is not None and x.ndim == 4 and cos.ndim == 2:
+        from paddle_tpu.ops.pallas import rope as _pr
+        if _pk._support.auto_dispatch() and _pr.supported(x, cos, sin):
+            return _pk.apply_rotary(x, cos, sin)
     x1, x2 = jnp.split(x, 2, axis=-1)
     if cos.ndim == x.ndim - 2:          # [T, D/2] → broadcast over B, H
         cos = cos[None, :, None, :]

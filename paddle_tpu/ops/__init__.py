@@ -1,0 +1,13 @@
+"""Hand-written TPU kernels (Pallas) for the hot-op set.
+
+The reference implements its hot set as CUDA kernels under
+``paddle/fluid/operators/fused/`` (``multihead_matmul_op.cu``,
+``skip_layernorm_op.cu``), ``operators/math/softmax.cu`` and
+``operators/optimizers/adam_op.cu``. Here the equivalents are Pallas
+kernels tiled for the MXU/VPU; everything else stays jax.numpy and lets
+XLA fuse.
+"""
+
+from paddle_tpu.ops import pallas  # noqa: F401
+
+__all__ = ["pallas"]

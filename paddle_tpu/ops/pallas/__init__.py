@@ -1,0 +1,37 @@
+"""Pallas TPU kernels for the hot-op set (reference: CUDA kernels under
+``paddle/fluid/operators/fused/``, ``operators/math/``,
+``operators/optimizers/``).
+
+- ``flash_attention`` — fused attention, never materializes [T, T]
+  (ref ``fused/multihead_matmul_op.cu``)
+- ``rms_norm`` / ``layer_norm`` — fused row norms with saved statistics
+  (ref ``layer_norm_op.cu``, ``fused/skip_layernorm_op.cu``)
+- ``softmax_cross_entropy`` — fused [N, V] loss, probs never stored
+  (ref ``softmax_with_cross_entropy_op.cu``, ``math/softmax.cu``)
+- ``apply_rotary`` — fused RoPE rotation
+- ``adamw_update`` — fused optimizer update (ref ``optimizers/adam_op.cu``)
+
+All kernels run compiled on TPU and interpreted elsewhere
+(``_support.interpret()``); all are differentiable via ``jax.custom_vjp``.
+"""
+
+from paddle_tpu.ops.pallas import _support
+from paddle_tpu.ops.pallas import flash_attention as _fa
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.norm import layer_norm, rms_norm
+from paddle_tpu.ops.pallas.rope import apply_rotary
+from paddle_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+from paddle_tpu.ops.pallas.adamw import adamw_update
+
+force_interpret = _support.force_interpret
+on_tpu = _support.on_tpu
+
+__all__ = [
+    "flash_attention", "flash_attention_supported", "rms_norm", "layer_norm",
+    "softmax_cross_entropy", "apply_rotary", "adamw_update",
+    "force_interpret", "on_tpu",
+]
+
+
+def flash_attention_supported(q, k, v, *, causal=False) -> bool:
+    return _fa.supported(q, k, v, causal=causal)

@@ -1,0 +1,65 @@
+"""Shared dispatch helpers for the Pallas kernel set.
+
+Kernels compile only for the TPU backend; on CPU they run through the
+Pallas interpreter (bit-accurate, slow) — used by the OpTest-style unit
+tests. The ``interpret()`` switch below decides per-call.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_FORCE_INTERPRET = False
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def single_device() -> bool:
+    """True when no multi-device mesh is active. pallas_call carries no
+    GSPMD partitioning rule, so under a >1-device jit the partitioner
+    would replicate operands (or fail to lower) — auto-dispatch must fall
+    back to the jnp path there. Multi-device flash attention instead goes
+    through the shard_map sequence-parallel path
+    (``paddle_tpu/parallel/ring_attention.py``), where per-device shapes
+    make the kernel safe."""
+    from paddle_tpu.parallel import mesh as M
+
+    mesh = M.current_mesh()
+    return mesh is None or mesh.size <= 1
+
+
+def auto_dispatch() -> bool:
+    """Default ('auto') dispatch gate for the kernel set."""
+    return on_tpu() and single_device()
+
+
+def interpret() -> bool:
+    """Whether pallas_call should run in interpreter mode."""
+    return _FORCE_INTERPRET or not on_tpu()
+
+
+def compiler_params(**kwargs):
+    """TPU compiler params, or None off-TPU/interpret (ignored there)."""
+    if interpret():
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
+class force_interpret:
+    """Context manager: run all paddle_tpu Pallas kernels interpreted."""
+
+    def __enter__(self):
+        global _FORCE_INTERPRET
+        self._prev = _FORCE_INTERPRET
+        _FORCE_INTERPRET = True
+
+    def __exit__(self, *exc):
+        global _FORCE_INTERPRET
+        _FORCE_INTERPRET = self._prev
+        return False

@@ -1,0 +1,102 @@
+"""Fused AdamW parameter update.
+
+Reference CUDA equivalent: ``paddle/fluid/operators/optimizers/
+adam_op.cu`` (one kernel updating param + both moments in place). Here
+one Pallas kernel reads (p, m, v, g) once and writes (p, m, v) —
+4 reads + 3 writes of HBM traffic per element, with
+``input_output_aliases`` donating the buffers. Scalars (lr, betas, eps,
+weight decay, bias corrections) arrive via SMEM so one compiled kernel
+serves every step of a schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import _support
+
+_LANES = 128
+_BLOCK_ROWS = 512
+
+
+def _adamw_kernel(sc_ref, p_ref, m_ref, v_ref, g_ref,
+                  po_ref, mo_ref, vo_ref):
+    lr, b1, b2, eps, wd, c1, c2 = (sc_ref[i] for i in range(7))
+    g = g_ref[...].astype(jnp.float32)
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    p = p_ref[...].astype(jnp.float32)
+    update = (m * c1) / (jnp.sqrt(v * c2) + eps)
+    p = p - lr * (update + wd * p)
+    po_ref[...] = p.astype(po_ref.dtype)
+    mo_ref[...] = m
+    vo_ref[...] = v
+
+
+def adamw_update(p, m, v, g, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
+                 weight_decay=0.01, step):
+    """One fused AdamW step on a single tensor. Returns (p, m, v).
+
+    ``m``/``v`` must be float32; ``step`` is the 1-based step count used
+    for bias correction. Scalars may be traced (schedules jit cleanly).
+    """
+    shape, dtype = p.shape, p.dtype
+    n = p.size
+    cols = _LANES
+    rows = -(-n // cols)
+    pad = rows * cols - n
+
+    def to2d(x, dt):
+        flat = x.reshape(-1).astype(dt)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), dt)])
+        return flat.reshape(rows, cols)
+
+    step_f = jnp.asarray(step, jnp.float32)
+    c1 = 1.0 / (1.0 - jnp.asarray(beta1, jnp.float32) ** step_f)
+    c2 = 1.0 / (1.0 - jnp.asarray(beta2, jnp.float32) ** step_f)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32), c1, c2])
+
+    br = min(_BLOCK_ROWS, rows)
+    nrb = -(-rows // br)
+    # gradients go in as float32: quantizing an fp32 master grad to a bf16
+    # param dtype would discard mantissa the kernel immediately needs
+    p2, m2, v2, g2 = (to2d(p, dtype), to2d(m, jnp.float32),
+                      to2d(v, jnp.float32), to2d(g, jnp.float32))
+    po, mo, vo = pl.pallas_call(
+        _adamw_kernel,
+        grid=(nrb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, cols), dtype),
+            jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+            jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+        ],
+        input_output_aliases={1: 0, 2: 1, 3: 2},
+        interpret=_support.interpret(),
+    )(scalars, p2, m2, v2, g2)
+
+    def un2d(x, dt):
+        flat = x.reshape(-1)
+        if pad:
+            flat = flat[:n]
+        return flat.reshape(shape).astype(dt)
+
+    return un2d(po, dtype), un2d(mo, jnp.float32), un2d(vo, jnp.float32)

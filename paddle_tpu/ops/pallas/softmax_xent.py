@@ -1,0 +1,144 @@
+"""Fused softmax cross-entropy over [N, V] — the LM-head loss.
+
+Reference CUDA equivalents: ``paddle/fluid/operators/
+softmax_with_cross_entropy_op.cu`` and ``operators/math/softmax.cu``.
+The fused formulation never stores the [N, V] probability matrix:
+
+- forward: a Pallas kernel streams vocab blocks through VMEM computing
+  the row log-sum-exp online; the label logit is a cheap gather outside.
+- backward: ``softmax = exp(x - lse)`` is recomputed blockwise in a
+  second kernel (saving only ``lse`` [N] as residual instead of the
+  [N, V] probabilities jax.nn.log_softmax would keep), and the one-hot
+  subtraction is a scatter-add outside.
+
+Alignment: row blocks of 128 × vocab blocks of 256 → requires
+``N % 128 == 0`` and ``V % 256 == 0`` (Llama's 32000 qualifies); callers
+fall back to the jnp path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import _support
+
+_BLOCK_N = 128
+_BLOCK_V = 256
+_NEG_INF = -1e30
+
+
+def supported(logits, labels) -> bool:
+    if logits.ndim != 2 or labels.ndim != 1:
+        return False
+    n, v = logits.shape
+    if labels.shape[0] != n:
+        return False
+    # n must tile by the row block (128, or n itself when n < 128 and a
+    # multiple of 8); v must tile by the vocab block
+    if n % _row_block(n) or n % 8 or v % _BLOCK_V:
+        return False
+    return logits.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def _row_block(n: int) -> int:
+    return min(_BLOCK_N, n)
+
+
+def _lse_kernel(x_ref, lse_ref, m_ref, l_ref, *, nv):
+    iv = pl.program_id(1)
+
+    @pl.when(iv == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(x, axis=1, keepdims=True))
+    l_ref[:, :1] = (l_ref[:, :1] * jnp.exp(m_prev - m_new)
+                    + jnp.sum(jnp.exp(x - m_new), axis=1, keepdims=True))
+    m_ref[:, :1] = m_new
+
+    @pl.when(iv == nv - 1)
+    def _():
+        lse = m_ref[:, :1] + jnp.log(l_ref[:, :1])
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+
+
+def _dx_kernel(x_ref, lse_ref, g_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, :1]
+    g = g_ref[:, :1]
+    dx_ref[...] = (jnp.exp(x - lse) * g).astype(dx_ref.dtype)
+
+
+def _lse(logits):
+    n, v = logits.shape
+    br = _row_block(n)
+    nb, nv = n // br, v // _BLOCK_V
+    lse = pl.pallas_call(
+        functools.partial(_lse_kernel, nv=nv),
+        grid=(nb, nv),
+        in_specs=[pl.BlockSpec((br, _BLOCK_V), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((br, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((br, 128), jnp.float32),
+            pltpu.VMEM((br, 128), jnp.float32),
+        ],
+        compiler_params=_support.compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_support.interpret(),
+    )(logits)
+    return lse[:, 0]
+
+
+@jax.custom_vjp
+def softmax_cross_entropy(logits, labels):
+    """Per-row loss ``lse(logits) - logits[labels]`` for [N, V] logits and
+    int [N] labels. ``supported(logits, labels)`` must hold."""
+    lse = _lse(logits)
+    sel = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return lse - sel.astype(jnp.float32)
+
+
+def _sce_fwd(logits, labels):
+    lse = _lse(logits)
+    sel = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return lse - sel.astype(jnp.float32), (logits, labels, lse)
+
+
+def _sce_bwd(res, g):
+    logits, labels, lse = res
+    n, v = logits.shape
+    br = _row_block(n)
+    nb, nv = n // br, v // _BLOCK_V
+    g = g.astype(jnp.float32)
+    dx = pl.pallas_call(
+        _dx_kernel,
+        grid=(nb, nv),
+        in_specs=[
+            pl.BlockSpec((br, _BLOCK_V), lambda i, j: (i, j)),
+            pl.BlockSpec((br, 128), lambda i, j: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, _BLOCK_V), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(logits.shape, logits.dtype),
+        compiler_params=_support.compiler_params(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_support.interpret(),
+    )(logits, jnp.broadcast_to(lse[:, None], (n, 128)),
+      jnp.broadcast_to(g[:, None], (n, 128)))
+    # one-hot subtraction: dx[i, labels[i]] -= g[i]
+    dx = dx.at[jnp.arange(n), labels].add((-g).astype(dx.dtype))
+    return dx, jnp.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)

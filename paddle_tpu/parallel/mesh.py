@@ -76,6 +76,11 @@ def get_mesh() -> Mesh:
     return _current_mesh
 
 
+def current_mesh() -> Mesh | None:
+    """The ambient mesh, or None if none has been set."""
+    return _current_mesh
+
+
 class MeshContext:
     """``with MeshContext(mesh):`` — sets the ambient mesh (and jax's
     ``set_mesh`` if available) for the block."""
