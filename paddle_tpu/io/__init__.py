@@ -12,6 +12,14 @@ from paddle_tpu.io.checkpoint import (
     state_dict,
     set_state_dict,
 )
+from paddle_tpu.io.export import (
+    Predictor,
+    export_function,
+    load_inference_model,
+    save_inference_model,
+)
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
-           "load_state_dict", "state_dict", "set_state_dict"]
+           "load_state_dict", "state_dict", "set_state_dict",
+           "export_function", "save_inference_model", "load_inference_model",
+           "Predictor"]

@@ -1,0 +1,102 @@
+"""Autoregressive generation loop (greedy / temperature / top-k / top-p).
+
+The reference's decode loop lives in graph ops (``paddle/fluid/operators/
+beam_search_op.cc``, sampling ops) driven per-step from Python. The TPU
+design instead compiles the WHOLE loop: prefill is one jitted forward
+over the prompt, then ``lax.fori_loop`` runs single-token steps against
+a fixed-shape KV cache (``LlamaForCausalLM.init_cache``) — one compiled
+step serves every position, no per-length recompilation.
+
+Works with any model exposing ``init_cache(B, S)`` and
+``forward_with_cache(ids, cache, index)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate", "sample_logits"]
+
+
+def sample_logits(logits, key=None, *, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """Pick next tokens from [B, V] logits. ``temperature == 0`` or
+    ``key is None`` → greedy argmax; otherwise temperature / top-k /
+    nucleus (top-p) sampling."""
+    if key is None or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set of tokens with cumulative prob >= top_p
+        # (always keep the top-1)
+        cutoff_mask = cum - probs < top_p
+        threshold = jnp.min(
+            jnp.where(cutoff_mask, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: int | None = None, pad_token_id: int = 0,
+             key=None, cache_dtype=None):
+    """Decode ``max_new_tokens`` tokens after the prompt.
+
+    Returns [B, T0 + max_new_tokens] int32; positions after an emitted
+    EOS are filled with ``pad_token_id``. Jit-compatible (wrap the call
+    in ``jax.jit`` with ``static_argnums`` for the ints, or close over
+    them) — the loop itself is a ``lax.fori_loop``.
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if max_new_tokens <= 0:
+        return input_ids
+    B, T0 = input_ids.shape
+    S = T0 + int(max_new_tokens)
+    cache = model.init_cache(B, S, dtype=cache_dtype)
+
+    logits, cache = model.forward_with_cache(input_ids, cache, index=0)
+    seq = jnp.concatenate(
+        [input_ids, jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)],
+        axis=1)
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        return sample_logits(logits, None if temperature == 0.0 else key,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+
+    key, sub = jax.random.split(key)
+    next_tok = pick(logits[:, -1], sub)
+    finished = jnp.zeros((B,), bool)
+    if eos_token_id is not None:
+        finished = next_tok == eos_token_id
+    seq = jax.lax.dynamic_update_slice(seq, next_tok[:, None], (0, T0))
+
+    def body(i, state):
+        seq, cache, prev_tok, finished, key = state
+        logits, cache = model.forward_with_cache(
+            prev_tok[:, None], cache, index=T0 + i - 1)
+        key, sub = jax.random.split(key)
+        tok = pick(logits[:, -1], sub)
+        if eos_token_id is not None:
+            tok = jnp.where(finished, pad_token_id, tok)
+            finished = finished | (tok == eos_token_id)
+        seq = jax.lax.dynamic_update_slice(
+            seq, tok[:, None], (0, T0 + i))
+        return seq, cache, tok, finished, key
+
+    if max_new_tokens > 1:
+        seq, cache, next_tok, finished, key = jax.lax.fori_loop(
+            1, max_new_tokens, body,
+            (seq, cache, next_tok, finished, key))
+    return seq

@@ -119,27 +119,54 @@ class LlamaAttention(Module):
         # "none" | "ring" | "ulysses"
         self.seq_mode = "none"
 
-    def __call__(self, x, positions=None, cache=None, training: bool = False):
+    def __call__(self, x, positions=None, cache=None, index=None,
+                 training: bool = False):
+        """Forward. ``cache``/``index`` enable incremental decoding with a
+        *static* KV cache: ``cache = (k_buf, v_buf)`` of fixed shape
+        [B, S, Hkv, D] and ``index`` the write offset of this chunk. The
+        fixed shape means one compiled decode step serves every position
+        (XLA-friendly; the reference's growing-concat Cache in
+        ``python/paddle/nn/layer/transformer.py`` recompiles per length
+        under jit)."""
         B, T, E = x.shape
         q = self.wq(x).reshape(B, T, self.num_heads, self.head_dim)
         k = self.wk(x).reshape(B, T, self.num_kv_heads, self.head_dim)
         v = self.wv(x).reshape(B, T, self.num_kv_heads, self.head_dim)
         if positions is None:
             positions = jnp.arange(T)
-            if cache is not None:
-                positions = positions + cache[0].shape[1]
+            if index is not None:
+                positions = positions + index
         cos, sin = F.rotary_embedding(positions, self.head_dim,
                                       self.rope_base)
         q = F.apply_rotary(q, cos, sin)
         k = F.apply_rotary(k, cos, sin)
-        new_cache = None
         if cache is not None:
-            k = jnp.concatenate([cache[0], k], axis=1)
-            v = jnp.concatenate([cache[1], v], axis=1)
-            new_cache = (k, v)
+            k_buf, v_buf = cache
+            S = k_buf.shape[1]
+            idx = jnp.asarray(0 if index is None else index, jnp.int32)
+            k_buf = jax.lax.dynamic_update_slice(
+                k_buf, k.astype(k_buf.dtype), (0, idx, 0, 0))
+            v_buf = jax.lax.dynamic_update_slice(
+                v_buf, v.astype(v_buf.dtype), (0, idx, 0, 0))
+            if isinstance(index, int) and index == 0:
+                # prefill: no prior context — plain causal attention over
+                # the chunk itself (flash-kernel eligible; the masked path
+                # below would materialize [B, H, T, S] scores)
+                out = F.scaled_dot_product_attention(q, k, v, causal=True)
+            else:
+                # decode: key j visible to query t iff j <= idx + t
+                # (future buffer slots are zeros and masked off)
+                q_pos = idx + jnp.arange(T)
+                key_pos = jnp.arange(S)
+                mask = key_pos[None, :] <= q_pos[:, None]      # [T, S]
+                out = F.scaled_dot_product_attention(
+                    q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
+                    mask=mask[None, None])
+            out = self.wo(out.reshape(B, T, E))
+            return out, (k_buf, v_buf)
         # activations: shard heads over tp inside the einsum via sharded
         # inputs; flash path kicks in on TPU for supported shapes
-        if self.seq_mode != "none" and cache is None:
+        if self.seq_mode != "none":
             from paddle_tpu.parallel.ring_attention import (
                 ring_self_attention, ulysses_self_attention)
             attn_fn = (ring_self_attention if self.seq_mode == "ring"
@@ -147,10 +174,7 @@ class LlamaAttention(Module):
             out = attn_fn(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, causal=True)
-        out = self.wo(out.reshape(B, T, E))
-        if new_cache is not None:
-            return out, new_cache
-        return out
+        return self.wo(out.reshape(B, T, E))
 
 
 class LlamaMLP(Module):
@@ -182,10 +206,15 @@ class LlamaBlock(Module):
                                 dtype=dtype)
         self.mlp = LlamaMLP(cfg, key=k2)
 
-    def __call__(self, x, training: bool = False):
-        x = x + self.attn(self.attn_norm(x), training=training)
+    def __call__(self, x, cache=None, *, index=None, training: bool = False):
+        attn_out = self.attn(self.attn_norm(x), cache=cache, index=index,
+                             training=training)
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = attn_out
+        x = x + attn_out
         x = x + self.mlp(self.mlp_norm(x))
-        return x
+        return x if new_cache is None else (x, new_cache)
 
 
 class LlamaForCausalLM(Module):
@@ -216,6 +245,32 @@ class LlamaForCausalLM(Module):
         if self.lm_head is not None:
             return self.lm_head(x)
         return x @ self.embed.weight.T
+
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Stacked static KV cache for all layers:
+        ([L, B, S, Hkv, D], [L, B, S, Hkv, D]) zeros."""
+        cfg = self.config
+        dtype = jnp.dtype(dtype or cfg.dtype)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads,
+                 head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def forward_with_cache(self, input_ids, cache, index):
+        """Forward a chunk (prefill: the whole prompt at index 0; decode:
+        one token at index t) updating the static KV cache. Returns
+        (logits [B, T, V], new_cache)."""
+        x = self.embed(input_ids)
+        x, cache = self.blocks.scan_with(x, cache, index=index)
+        x = self.norm(x)
+        if self.lm_head is not None:
+            return self.lm_head(x), cache
+        return x @ self.embed.weight.T, cache
+
+    def generate(self, input_ids, max_new_tokens: int, **kwargs):
+        """Autoregressive decode — see ``paddle_tpu.models.generation``."""
+        from paddle_tpu.models.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):

@@ -81,6 +81,20 @@ class ScannedBlocks(Module):
         x, _ = lax.scan(body, x, (self.block, keys))
         return x
 
+    def scan_with(self, x, per_layer, **kwargs):
+        """Scan with a per-layer input/output pytree (leaves carry a
+        leading [n_layers] dim — e.g. stacked KV caches for decoding).
+        Each block must return ``(y, per_layer_out)``. Returns
+        ``(x, stacked_outputs)``."""
+
+        def body(carry, layer_and_pl):
+            layer, pl_in = layer_and_pl
+            y, pl_out = layer(carry, pl_in, **kwargs)
+            return y, pl_out
+
+        x, out = lax.scan(body, x, (self.block, per_layer))
+        return x, out
+
     def layer(self, i: int) -> Module:
         """Materialize block i (host-side inspection/debugging)."""
         return jax.tree_util.tree_map(lambda x: x[i], self.block)

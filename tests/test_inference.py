@@ -1,0 +1,171 @@
+"""Inference layer (L8): export/Predictor + the generate decode loop.
+
+Reference coverage model: C++ predictor tests per model
+(``paddle/fluid/inference/tests/api/``) assert save→load→run parity;
+here export→reload must be bit-identical on CPU, and the static-KV-cache
+decode loop must reproduce full-recompute forward logits exactly.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu import io
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate, sample_logits
+
+
+@pytest.fixture
+def tiny_llama():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=64, num_layers=2,
+                           num_heads=4, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def test_export_reload_bit_identical(tiny_llama, tmp_path):
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int32))
+    path = str(tmp_path / "exported")
+    io.save_inference_model(path, tiny_llama, [ids])
+
+    pred = io.load_inference_model(path)
+    got = pred.run(ids)
+    want = jax.jit(lambda m, x: m(x))(tiny_llama, ids)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert pred.input_specs[0]["shape"] == [2, 16]
+    assert pred.output_specs[0]["shape"] == [2, 16, 128]
+
+
+def test_predictor_validates_inputs(tiny_llama, tmp_path):
+    ids = jnp.zeros((2, 16), jnp.int32)
+    path = str(tmp_path / "exported")
+    io.save_inference_model(path, tiny_llama, [ids])
+    pred = io.Predictor(path)
+    with pytest.raises(ValueError, match="shape"):
+        pred.run(jnp.zeros((2, 8), jnp.int32))
+    with pytest.raises(ValueError, match="expected 1 inputs"):
+        pred.run(ids, ids)
+    with pytest.raises(ValueError, match="dtype"):
+        pred.run(jnp.zeros((2, 16), jnp.float32))
+
+
+def test_export_function_roundtrip(tmp_path):
+    def fn(x, y):
+        return jnp.sin(x) @ y
+
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 8).astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(2).randn(8, 2).astype(np.float32))
+    p = str(tmp_path / "fn.stablehlo")
+    io.export_function(fn, (x, y), p)
+    from jax import export as jax_export
+    with open(p, "rb") as f:
+        rt = jax_export.deserialize(f.read())
+    np.testing.assert_array_equal(np.asarray(rt.call(x, y)),
+                                  np.asarray(fn(x, y)))
+
+
+def test_cache_forward_matches_full_forward(tiny_llama):
+    """Prefill + per-token decode through the static KV cache must equal
+    the full recompute forward at every position."""
+    model = tiny_llama
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, 128, (2, 12)).astype(np.int32))
+    T = ids.shape[1]
+
+    full_logits = model(ids)                       # [B, T, V]
+
+    cache = model.init_cache(2, T)
+    pre = 5
+    logits_pre, cache = model.forward_with_cache(ids[:, :pre], cache, index=0)
+    np.testing.assert_allclose(np.asarray(logits_pre),
+                               np.asarray(full_logits[:, :pre]),
+                               rtol=2e-5, atol=2e-5)
+    for t in range(pre, T):
+        logits_t, cache = model.forward_with_cache(
+            ids[:, t:t + 1], cache, index=t)
+        np.testing.assert_allclose(
+            np.asarray(logits_t[:, 0]), np.asarray(full_logits[:, t]),
+            rtol=2e-5, atol=2e-5,
+            err_msg=f"decode step {t} diverged from full forward")
+
+
+def test_generate_greedy_matches_naive_loop(tiny_llama):
+    """generate() (fori_loop + static cache) vs the obvious slow loop that
+    recomputes the full forward every step."""
+    model = tiny_llama
+    ids = jnp.asarray(
+        np.random.RandomState(4).randint(0, 128, (2, 6)).astype(np.int32))
+    n_new = 8
+
+    out = generate(model, ids, n_new, temperature=0.0)
+
+    naive = ids
+    for _ in range(n_new):
+        logits = model(naive)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        naive = jnp.concatenate([naive, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(naive))
+
+
+def test_generate_zero_tokens_returns_prompt(tiny_llama):
+    ids = jnp.asarray([[5, 67, 123]], jnp.int32)
+    out = generate(tiny_llama, ids, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+
+
+def test_generate_eos_padding(tiny_llama):
+    model = tiny_llama
+    ids = jnp.asarray(
+        np.random.RandomState(5).randint(0, 128, (1, 4)).astype(np.int32))
+    # force every token to be "eos" by picking the greedy first token as eos
+    first = int(jnp.argmax(model(ids)[:, -1], axis=-1)[0])
+    out = generate(model, ids, 5, temperature=0.0, eos_token_id=first,
+                   pad_token_id=99)
+    out = np.asarray(out)
+    assert out[0, 4] == first                  # eos emitted
+    assert (out[0, 5:] == 99).all()            # then padding
+
+
+def test_generate_jits(tiny_llama):
+    model = tiny_llama
+    ids = jnp.asarray(
+        np.random.RandomState(6).randint(0, 128, (2, 6)).astype(np.int32))
+    jitted = jax.jit(lambda m, x: generate(m, x, 4, temperature=0.0))
+    out1 = jitted(model, ids)
+    out2 = generate(model, ids, 4, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_sample_logits_top_k_top_p():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 10.0]])
+    key = jax.random.PRNGKey(0)
+    # top_k=1 → always argmax regardless of key
+    for i in range(5):
+        tok = sample_logits(logits, jax.random.PRNGKey(i), temperature=1.0,
+                            top_k=1)
+        assert int(tok[0]) == 4
+    # top_p tiny → nucleus collapses to argmax
+    for i in range(5):
+        tok = sample_logits(logits, jax.random.PRNGKey(i), temperature=1.0,
+                            top_p=0.1)
+        assert int(tok[0]) == 4
+    # greedy
+    assert int(sample_logits(logits, None)[0]) == 4
+    # plain sampling covers more than one token eventually
+    seen = {int(sample_logits(logits * 0.0, jax.random.PRNGKey(i),
+                              temperature=1.0)[0]) for i in range(32)}
+    assert len(seen) > 1
+
+
+def test_generate_sampling_reproducible(tiny_llama):
+    model = tiny_llama
+    ids = jnp.asarray(
+        np.random.RandomState(8).randint(0, 128, (2, 5)).astype(np.int32))
+    k = jax.random.PRNGKey(42)
+    a = generate(model, ids, 6, temperature=0.8, top_k=10, key=k)
+    b = generate(model, ids, 6, temperature=0.8, top_k=10, key=k)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (2, 11)
