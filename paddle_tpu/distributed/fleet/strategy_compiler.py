@@ -119,6 +119,30 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             "LocalSGD needs per-replica divergent params, which is a "
             "shard_map-based strategy — not yet implemented on TPU")
 
+    pp_cfg = strategy.pipeline
+    use_pp = pp_cfg.enable and pp_cfg.degree > 1
+    if use_pp and pp_cfg.schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pipeline.schedule={pp_cfg.schedule!r}: only 'gpipe' and "
+            "'1f1b' are implemented")
+    use_1f1b = use_pp and pp_cfg.schedule == "1f1b"
+    if use_1f1b:
+        if strategy.amp.enable:
+            raise NotImplementedError(
+                "1f1b + amp autocast: build the model in the target dtype "
+                "instead (the manual pipeline backward does not re-derive "
+                "the cast chain)")
+        if loss_fn is not None:
+            raise ValueError(
+                "1f1b computes the loss per-microbatch on the last stage "
+                "via model.pipeline_parts(); a custom loss_fn cannot be "
+                "honored — encode the loss in pipeline_parts instead")
+        if not hasattr(model, "pipeline_parts"):
+            raise ValueError(
+                f"pipeline.schedule='1f1b' needs "
+                f"{type(model).__name__}.pipeline_parts() (embed/blocks/"
+                "head decomposition); implement it or use schedule='gpipe'")
+
     def _prepare(m):
         m = _apply_recompute_override(m, strategy)
         m = _apply_seq_parallel_override(m, strategy)
@@ -205,10 +229,24 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                 return scaler.scale(loss, state.scaler), (loss, dict(tape))
             return loss, (loss, dict(tape))
 
-        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (_, (loss, tape)), grads = grad_fn(model)
-        grads, all_finite = (scaler.unscale(grads, state.scaler)
-                             if use_scaler else (grads, jnp.asarray(True)))
+        if use_1f1b:
+            # manual 1F1B schedule: loss computed per-microbatch on the
+            # last stage, backward interleaved (pipeline_1f1b.py); no
+            # state tape / loss scaling on this path (validated above).
+            # Deliberately NO rng.stream here: the backward recomputes the
+            # stage forward in a separate trace, so dropout would draw
+            # different masks — without a stream, F.dropout fails fast
+            # instead of silently corrupting gradients.
+            from paddle_tpu.parallel import pipeline_1f1b
+            loss, grads = pipeline_1f1b.loss_and_grads(model, batch, mesh)
+            tape = {}
+            all_finite = jnp.asarray(True)
+        else:
+            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+            (_, (loss, tape)), grads = grad_fn(model)
+            grads, all_finite = (scaler.unscale(grads, state.scaler)
+                                 if use_scaler else
+                                 (grads, jnp.asarray(True)))
 
         if k_steps > 1:
             # gradient merge: accumulate in fp32; apply every k-th step.

@@ -246,6 +246,44 @@ class LlamaForCausalLM(Module):
             return self.lm_head(x)
         return x @ self.embed.weight.T
 
+    def pipeline_parts(self):
+        """Decomposition for schedule-managed pipelines (1F1B,
+        ``paddle_tpu/parallel/pipeline_1f1b.py``): (embed, blocks, head,
+        head_loss_fn, assemble). The head (final norm + lm_head + loss)
+        must be self-contained on the last stage, so tied embeddings are
+        unsupported here."""
+        if self.lm_head is None:
+            raise NotImplementedError(
+                "1f1b pipeline needs an untied lm_head (the head runs on "
+                "the last stage; tied embeddings would couple it to the "
+                "first stage's embedding table)")
+        head = (self.norm, self.lm_head)
+
+        def head_loss_sum(head, h, labels):
+            """SUM of per-token losses for one microbatch (the pipeline
+            divides by the global valid count, so uneven ignore_index
+            distributions across microbatches stay exactly equivalent to
+            the full-batch mean of ``model.loss``)."""
+            norm, lm_head = head
+            logits = lm_head(norm(h)).astype(jnp.float32)
+            return F.cross_entropy(logits[:, :-1], labels[:, 1:],
+                                   reduction="sum")
+
+        def loss_denom(labels):
+            return jnp.maximum(
+                jnp.sum((labels[:, 1:] != -100).astype(jnp.float32)), 1.0)
+
+        model = self
+
+        def assemble(dembed, dblocks_stacked, dhead):
+            g = jax.tree_util.tree_map(jnp.zeros_like, model)
+            return g.replace(
+                embed=dembed, norm=dhead[0], lm_head=dhead[1],
+                blocks=g.blocks.replace(block=dblocks_stacked))
+
+        return (self.embed, self.blocks, head, head_loss_sum, loss_denom,
+                assemble)
+
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Stacked static KV cache for all layers:
         ([L, B, S, Hkv, D], [L, B, S, Hkv, D]) zeros."""

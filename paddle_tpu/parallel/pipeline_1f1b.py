@@ -1,0 +1,187 @@
+"""1F1B pipeline schedule — bounded-activation training.
+
+Reference: the PipelineTrainer's section scheduling
+(``framework/section_worker.cc:44``; program split + send/recv insertion
+``fluid/optimizer.py:3816,4145``). GPipe (``parallel/pipeline.py``) lets
+``jax.grad`` derive the backward schedule, which is elegant but stores
+one stage-input per microbatch — O(M) live activations. 1F1B interleaves
+each microbatch's backward as soon as its forward clears the last stage,
+so a stage only ever holds the in-flight window.
+
+Functional formulation (one ``shard_map`` over ``pp``, one ``lax.scan``
+over ticks; autodiff is NOT used across the schedule — each tick calls
+``jax.vjp`` per stage explicitly):
+
+- tick ``t``, stage ``r`` runs **forward** for microbatch ``f = t - r``
+  and **backward** for ``b = t - 2(S-1) + r`` (the synchronous 1F1B
+  interleave; on the last stage ``f == b``: loss VJP feeds the backward
+  in the same tick).
+- stage inputs are saved in a ring buffer of ``K = min(M, 2S-1)`` slots
+  — the peak-live-activation bound, independent of M (vs GPipe's M).
+  (The batch-sized x_mb feed and the dx_mb cotangent buffer are O(B)
+  per stage — same class as the replicated input itself; the O(M)
+  saving is in per-stage *activation residuals*, which dominate.)
+- backward recomputes the stage forward from the saved input under
+  ``jax.vjp`` (full-remat semantics, same FLOPs as
+  ``remat_policy="nothing_saveable"``).
+- activations hop ``r → r+1`` and cotangents ``r → r-1`` via
+  ``ppermute`` ring shifts (the ``send_v2``/``recv_v2`` pair).
+
+The per-microbatch loss runs on the last stage, which is what makes the
+interleave possible: cotangents exist the moment a microbatch's forward
+finishes. Models opt in via ``pipeline_parts()`` (embed / blocks / head
+decomposition + gradient reassembly).
+
+Limitations (explicit): no dropout inside pipelined blocks (the manual
+backward recompute would need replayed RNG streams), no fp16 dynamic
+loss scaling, no tied embeddings (head must be self-contained on the
+last stage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn.scan import REMAT_POLICIES
+from paddle_tpu.parallel import collective as C
+
+__all__ = ["loss_and_grads", "ring_buffer_slots"]
+
+
+def ring_buffer_slots(num_stages: int, num_microbatches: int) -> int:
+    """Peak stage-input slots a stage holds under this schedule — the
+    1F1B memory bound (compare GPipe's ``num_microbatches``)."""
+    return min(num_microbatches, 2 * num_stages - 1)
+
+
+def loss_and_grads(model, batch, mesh, *, training: bool = True):
+    """Compute (loss, grads) for a pipeline-decomposable model under the
+    1F1B schedule. ``model.blocks`` must already be the pipelined
+    executor (strategy compiler applies the override first)."""
+    (embed, pblocks, head, head_loss_fn, loss_denom,
+     assemble) = model.pipeline_parts()
+    S = pblocks.num_stages
+    M = pblocks.num_microbatches
+    ids, labels = batch["input_ids"], batch["labels"]
+    # head_loss_fn returns per-microbatch SUMS; dividing by the global
+    # valid-token count keeps loss/grads identical to the full-batch mean
+    # even when ignore_index tokens are distributed unevenly across
+    # microbatches
+    inv_denom = 1.0 / loss_denom(labels)
+
+    x, embed_vjp = jax.vjp(lambda e: e(ids), embed)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+    labels_mb = labels.reshape((M, B // M) + labels.shape[1:])
+
+    block = pblocks.block
+    remat = pblocks.remat
+    policy = REMAT_POLICIES[pblocks.remat_policy]
+
+    def stage_fwd(blk, h):
+        def bstep(c, layer):
+            return layer(c, training=training), None
+        if remat:
+            bstep = jax.checkpoint(bstep, policy=policy, prevent_cse=False)
+        h, _ = lax.scan(bstep, h, blk)
+        return h
+
+    N = M + 2 * (S - 1)          # total ticks
+    K = ring_buffer_slots(S, M)  # saved-input ring buffer
+
+    def pp_body(blk, head_p, x_mb, labels_mb, inv_denom):
+        r = lax.axis_index("pp")
+        mb_shape = x_mb.shape[1:]
+        init = (
+            jnp.zeros((K,) + mb_shape, x_mb.dtype),             # h_saved
+            jax.tree_util.tree_map(jnp.zeros_like, blk),        # gblk
+            jax.tree_util.tree_map(jnp.zeros_like, head_p),     # ghead
+            jnp.zeros_like(x_mb),                               # dx_mb
+            jnp.zeros(mb_shape, x_mb.dtype),                    # state_f
+            jnp.zeros(mb_shape, x_mb.dtype),                    # state_b
+            jnp.zeros((), jnp.float32),                         # loss_acc
+        )
+
+        def tick(carry, t):
+            h_saved, gblk, ghead, dx_mb, state_f, state_b, loss_acc = carry
+            f = t - r
+            b = t - 2 * (S - 1) + r
+            do_f = jnp.logical_and(f >= 0, f < M)
+            do_b = jnp.logical_and(b >= 0, b < M)
+            fc = jnp.clip(f, 0, M - 1)
+            bc = jnp.clip(b, 0, M - 1)
+
+            # ---- forward sub-tick: microbatch f ----
+            feed = lax.dynamic_index_in_dim(x_mb, fc, 0, keepdims=False)
+            h_in = jnp.where(r == 0, feed, state_f)
+            y = stage_fwd(blk, h_in)
+            slot_prev = lax.dynamic_index_in_dim(h_saved, fc % K, 0,
+                                                 keepdims=False)
+            h_saved = lax.dynamic_update_index_in_dim(
+                h_saved, jnp.where(do_f, h_in, slot_prev), fc % K, 0)
+
+            # ---- last stage: per-microbatch head loss + its VJP ----
+            lab = lax.dynamic_index_in_dim(labels_mb, fc, 0, keepdims=False)
+
+            def head_branch(y):
+                loss_m, vjp = jax.vjp(
+                    lambda hp, h: head_loss_fn(hp, h, lab), head_p, y)
+                dhead_m, dy = vjp(inv_denom.astype(loss_m.dtype))
+                return loss_m.astype(jnp.float32), dhead_m, dy
+
+            def skip_branch(y):
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree_util.tree_map(jnp.zeros_like, head_p),
+                        jnp.zeros_like(y))
+
+            loss_m, dhead_m, dy_own = lax.cond(
+                jnp.logical_and(r == S - 1, do_f), head_branch, skip_branch,
+                y)
+            ghead = jax.tree_util.tree_map(jnp.add, ghead, dhead_m)
+            loss_acc = loss_acc + loss_m * inv_denom
+
+            # ---- backward sub-tick: microbatch b ----
+            dy = jnp.where(r == S - 1, dy_own, state_b)
+            h_b = lax.dynamic_index_in_dim(h_saved, bc % K, 0,
+                                           keepdims=False)
+            _, svjp = jax.vjp(stage_fwd, blk, h_b)
+            gb, dh_in = svjp(dy.astype(x_mb.dtype))
+            gblk = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)),
+                gblk, gb)
+            dx_prev = lax.dynamic_index_in_dim(dx_mb, bc, 0, keepdims=False)
+            dx_mb = lax.dynamic_update_index_in_dim(
+                dx_mb,
+                jnp.where(jnp.logical_and(r == 0, do_b), dh_in, dx_prev),
+                bc, 0)
+
+            # ---- wire hops: activations →, cotangents ← ----
+            state_f = C.send_next(y, "pp")
+            state_b = C.recv_prev(dh_in, "pp")
+            return (h_saved, gblk, ghead, dx_mb, state_f, state_b,
+                    loss_acc), None
+
+        (h_saved, gblk, ghead, dx_mb, _, _, loss_acc), _ = lax.scan(
+            tick, init, jnp.arange(N))
+        # loss/dhead/dx live on specific stages; psum replicates (others
+        # contribute zeros)
+        loss = lax.psum(loss_acc, "pp")
+        ghead = jax.tree_util.tree_map(lambda g: lax.psum(g, "pp"), ghead)
+        dx_mb = lax.psum(dx_mb, "pp")
+        return loss, gblk, ghead, dx_mb
+
+    loss, gblk, ghead, dx_mb = jax.shard_map(
+        pp_body, mesh=mesh, axis_names={"pp"},
+        in_specs=(P("pp"), P(), P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P()),
+        check_vma=False,
+    )(block, head, x_mb, labels_mb, jnp.asarray(inv_denom, jnp.float32))
+
+    (dembed,) = embed_vjp(dx_mb.reshape(x.shape))
+    grads = assemble(dembed, gblk, ghead)
+    return loss, grads
