@@ -104,9 +104,13 @@ class DistributedBatchSampler(BatchSampler):
             self._epoch += 1
         else:
             order = list(range(self.dataset_len))
-        # pad to be evenly divisible, then take this rank's strided slice
-        pad = self.num_samples * self.num_replicas - len(order)
-        order += order[:pad]
+        # pad to be evenly divisible, then take this rank's strided slice.
+        # Tile (not slice-once): when dataset_len < num_replicas the pad
+        # exceeds len(order) and a single `order[:pad]` would under-pad,
+        # desynchronizing per-rank shard counts across hosts.
+        total = self.num_samples * self.num_replicas
+        while len(order) < total:
+            order += order[: total - len(order)]
         local = order[self.rank::self.num_replicas]
         batch = []
         for idx in local:

@@ -115,9 +115,22 @@ def build_train_step(model, optimizer, loss_fn=None, *,
         from paddle_tpu.parallel.mesh import get_mesh
         mesh = get_mesh()
     if strategy.localsgd.enable:
-        raise NotImplementedError(
-            "LocalSGD needs per-replica divergent params, which is a "
-            "shard_map-based strategy — not yet implemented on TPU")
+        from paddle_tpu.parallel.localsgd import build_localsgd_step
+        return build_localsgd_step(model, optimizer, loss_fn,
+                                   strategy=strategy, mesh=mesh,
+                                   donate=donate)
+
+    far_cfg = strategy.fp16_allreduce
+    use_fp16_ar = far_cfg.enable
+    if use_fp16_ar:
+        deg = strategy.parallel_degrees()
+        bad = [a for a in ("tp", "pp", "sp") if deg.get(a, 1) > 1]
+        if bad or (strategy.sharding.enable and strategy.sharding.stage >= 3):
+            raise ValueError(
+                "fp16_allreduce compresses the data-parallel gradient "
+                f"reduction only; incompatible with {bad or 'zero-3'} "
+                "(those reductions are partitioned by XLA)")
+        wire_dtype = jnp.dtype(far_cfg.dtype)
 
     pp_cfg = strategy.pipeline
     use_pp = pp_cfg.enable and pp_cfg.degree > 1
@@ -211,7 +224,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     def _step_impl(state: TrainState, batch, key):
         model = state.model
 
-        def compute_loss(m):
+        def compute_loss(m, b):
             if amp_enabled:
                 m = amp_mod.cast_model(m, amp_dtype)
             from paddle_tpu.nn.stateful import state_tape
@@ -222,7 +235,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                         custom_white_list=amp_cfg.custom_white_list,
                         custom_black_list=amp_cfg.custom_black_list):
                     with state_tape() as tape:
-                        loss = loss_fn(m, batch, training=True)
+                        loss = loss_fn(m, b, training=True)
             # the tape (BatchNorm running stats etc.) rides has_aux out of
             # the grad trace and is merged into the updated model below
             if use_scaler:
@@ -241,8 +254,37 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             loss, grads = pipeline_1f1b.loss_and_grads(model, batch, mesh)
             tape = {}
             all_finite = jnp.asarray(True)
+        elif use_fp16_ar:
+            # fp16/bf16-compressed gradient all-reduce: compute per-shard
+            # grads inside a shard_map over the data axes and psum them in
+            # the wire dtype (the c_allreduce-on-fp16 of the reference's
+            # fp16_allreduce_optimizer), instead of XLA's implicit fp32
+            # reduction in the backward.
+            from jax import shard_map
+
+            data_specs = jax.tree_util.tree_map(_data_spec, batch)
+
+            def local_grads(m, b):
+                (_, (loss, tape)), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True)(m, b)
+                ndev = jax.lax.psum(1, BATCH_AXES)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (jax.lax.psum(g.astype(wire_dtype), BATCH_AXES)
+                               / ndev).astype(g.dtype), grads)
+                loss = jax.lax.pmean(loss, BATCH_AXES)
+                tape = {k: jax.lax.pmean(v, BATCH_AXES) for k, v in
+                        tape.items()}
+                return grads, loss, tape
+
+            grads, loss, tape = shard_map(
+                local_grads, mesh=mesh, in_specs=(P(), data_specs),
+                out_specs=(P(), P(), P()), check_rep=False)(model, batch)
+            grads, all_finite = (scaler.unscale(grads, state.scaler)
+                                 if use_scaler else
+                                 (grads, jnp.asarray(True)))
         else:
-            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+            grad_fn = jax.value_and_grad(
+                lambda m: compute_loss(m, batch), has_aux=True)
             (_, (loss, tape)), grads = grad_fn(model)
             grads, all_finite = (scaler.unscale(grads, state.scaler)
                                  if use_scaler else

@@ -95,7 +95,13 @@ class Embedding(Module):
             self._pspecs = (("weight", pspec),)
 
     def __call__(self, ids):
-        return F.embedding(ids, self.weight)
+        w = self.weight
+        if self.padding_idx is not None:
+            # Re-zero the padding row functionally each call: the set-to-
+            # constant blocks gradient flow into that row, matching the
+            # reference's zero-gradient padding_idx semantics.
+            w = w.at[self.padding_idx].set(0.0)
+        return F.embedding(ids, w)
 
 
 class Dropout(Module):

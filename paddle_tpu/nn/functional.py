@@ -299,6 +299,18 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
 def cross_entropy(logits, label, soft_label: bool = False,
                   ignore_index: int = -100, reduction: str = "mean",
                   weight=None, axis: int = -1):
+    if weight is not None and soft_label:
+        # Per-class weights fold into the inner sum for soft labels:
+        # loss = -sum_c label_c * w_c * logp_c, normalized by the
+        # per-sample effective weight sum under "mean".
+        logp = jax.nn.log_softmax(logits, axis=axis)
+        loss = -jnp.sum(label * weight * logp, axis=axis)
+        if reduction == "mean":
+            wsum = jnp.sum(label * weight, axis=axis)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wsum), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
     loss = softmax_with_cross_entropy(logits, label, soft_label,
                                       ignore_index, axis)
     if weight is not None and not soft_label:

@@ -90,12 +90,21 @@ def opt_state_specs(opt_state, param_specs, params, mesh: Mesh, stage: int):
     (step counts, scalars) stays replicated.
     """
     params_def = jax.tree_util.tree_structure(params)
+    param_ndims = [getattr(p, "ndim", 0)
+                   for p in jax.tree_util.tree_leaves(params)]
     spec_leaves = jax.tree_util.tree_leaves(
         param_specs, is_leaf=lambda x: isinstance(x, P))
 
     def is_param_like(node):
+        # Structure equality alone misfires for single-leaf models, where a
+        # scalar opt-state leaf (e.g. Adam's count) has the same treedef as
+        # the params; additionally require per-leaf rank match.
         try:
-            return jax.tree_util.tree_structure(node) == params_def
+            if jax.tree_util.tree_structure(node) != params_def:
+                return False
+            ndims = [getattr(l, "ndim", 0)
+                     for l in jax.tree_util.tree_leaves(node)]
+            return ndims == param_ndims
         except Exception:  # pragma: no cover - defensive
             return False
 
@@ -106,6 +115,8 @@ def opt_state_specs(opt_state, param_specs, params, mesh: Mesh, stage: int):
             for leaf, spec in zip(leaves, spec_leaves):
                 if stage >= 1 and hasattr(leaf, "shape"):
                     spec = add_fsdp_axis(spec, leaf.shape, mesh)
+                if len(spec) > getattr(leaf, "ndim", 0):
+                    spec = P()  # rank mismatch: replicate rather than crash
                 out.append(spec)
             return jax.tree_util.tree_unflatten(treedef, out)
         # unmatched leaf: replicate (scalars / counters)

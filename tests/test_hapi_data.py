@@ -172,3 +172,15 @@ def test_vision_model_shapes():
     vit = ViT(image_size=32, patch_size=8, dim=32, depth=2, heads=2,
               mlp_dim=64, num_classes=5)
     assert vit(x).shape == (2, 5)
+
+
+def test_distributed_batch_sampler_tiny_dataset_even_shards():
+    # dataset smaller than the replica count: every rank must still see the
+    # same number of samples (tiled padding), or multi-host training hangs.
+    ds = TensorDataset(np.arange(3))
+    counts = []
+    for rank in range(8):
+        s = DistributedBatchSampler(ds, batch_size=1, num_replicas=8,
+                                    rank=rank)
+        counts.append(sum(len(b) for b in s))
+    assert len(set(counts)) == 1 and counts[0] == 1

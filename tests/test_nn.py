@@ -231,3 +231,39 @@ def test_group_norm_bias_without_weight():
     y = F.group_norm(x, 2, weight=None, bias=b)
     y0 = F.group_norm(x, 2, weight=None, bias=None)
     np.testing.assert_allclose(y, y0 + b.reshape(1, 4, 1, 1), rtol=1e-5)
+
+
+def test_embedding_padding_idx_zero_forward_and_grad():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = jnp.asarray([0, 3, 0, 7])
+    out = emb(ids)
+    np.testing.assert_allclose(np.asarray(out[0]), np.zeros(4), atol=0)
+    np.testing.assert_allclose(np.asarray(out[2]), np.zeros(4), atol=0)
+
+    def loss_fn(m):
+        return jnp.sum(m(ids) ** 2)
+
+    grads = jax.grad(loss_fn)(emb)
+    g = np.asarray(grads.weight)
+    # padding row gradient must stay exactly zero (reference semantics)
+    np.testing.assert_allclose(g[0], np.zeros(4), atol=0)
+    assert np.abs(g[3]).sum() > 0
+
+
+def test_cross_entropy_soft_label_weight():
+    logits = jnp.asarray(np.random.RandomState(0).randn(5, 4).astype(np.float32))
+    hard = np.random.RandomState(1).randint(0, 4, (5,))
+    soft = np.eye(4, dtype=np.float32)[hard]
+    w = jnp.asarray([0.5, 2.0, 1.0, 0.25])
+    # one-hot soft labels with weights must match the hard-label weighted path
+    got = F.cross_entropy(logits, jnp.asarray(soft), soft_label=True,
+                          weight=w, reduction="mean")
+    want = F.cross_entropy(logits, jnp.asarray(hard), soft_label=False,
+                           weight=w, reduction="mean")
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    for red in ("sum", "none"):
+        got = F.cross_entropy(logits, jnp.asarray(soft), soft_label=True,
+                              weight=w, reduction=red)
+        want = F.cross_entropy(logits, jnp.asarray(hard), soft_label=False,
+                               weight=w, reduction=red)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)

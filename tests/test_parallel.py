@@ -143,3 +143,28 @@ def test_all_reduce_prod_signs_and_zeros(devices8):
     np.testing.assert_allclose(f(x), jnp.full(4, 24.0), rtol=1e-5)
     x0 = jnp.asarray([-2.0, 0.0, 5.0, 4.0])
     np.testing.assert_allclose(f(x0), jnp.zeros(4), atol=1e-7)
+
+
+def test_opt_state_specs_single_param_model(devices8):
+    """A one-leaf model: Adam's scalar count has the same treedef as the
+    params, so structure matching alone would misclassify it and assign a
+    rank-2 spec to a rank-0 leaf (advisor finding)."""
+    from paddle_tpu import optimizer as opt
+
+    class OneParam(nn.Module):
+        def __init__(self):
+            self.w = jnp.zeros((8, 8))
+            self._pspecs = (("w", P("fsdp", "tp")),)
+
+        def __call__(self, x):
+            return x @ self.w
+
+    mesh = M.create_mesh({"fsdp": 2, "tp": 2, "dp": 2})
+    model = OneParam()
+    specs = param_specs_for_stage(model, mesh, stage=3)
+    o = opt.Adam(1e-3)
+    state = o.init(model)
+    ospecs = opt_state_specs(state, specs, model, mesh, stage=3)
+    adam_state = ospecs[0]
+    assert adam_state.count == P()
+    assert adam_state.mu.w == P("fsdp", "tp")

@@ -90,7 +90,7 @@ def test_1f1b_uneven_ignore_index_matches_dp(devices8):
     """ignore_index tokens concentrated in some microbatches: the global
     valid-count normalization must keep parity with the DP mean loss."""
     batch = make_batch()
-    labels = np.asarray(batch["labels"])
+    labels = np.array(batch["labels"])  # np.asarray view of a jax array is read-only
     labels[:2, :] = -100          # microbatch 0 (M=4 → mb size 2) all pad
     labels[2, 1:14] = -100        # microbatch 1 nearly all pad
     batch = {"input_ids": batch["input_ids"],
