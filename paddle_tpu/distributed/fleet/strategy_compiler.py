@@ -278,7 +278,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
             grads, loss, tape = shard_map(
                 local_grads, mesh=mesh, in_specs=(P(), data_specs),
-                out_specs=(P(), P(), P()), check_rep=False)(model, batch)
+                out_specs=(P(), P(), P()), check_vma=False)(model, batch)
             grads, all_finite = (scaler.unscale(grads, state.scaler)
                                  if use_scaler else
                                  (grads, jnp.asarray(True)))
