@@ -16,7 +16,18 @@ from paddle_tpu.parallel.env import (
 )
 from paddle_tpu.distributed import fleet
 
-__all__ = ["fleet", "DistributedStrategy", "init_parallel_env",
+
+def __getattr__(name):
+    # lazy: `python -m paddle_tpu.distributed.launch` re-executes the
+    # module; importing it eagerly here would trigger the runpy
+    # double-import warning
+    if name == "launch":
+        from paddle_tpu.distributed import launch
+        return launch
+    raise AttributeError(name)
+
+
+__all__ = ["fleet", "launch", "DistributedStrategy", "init_parallel_env",
            "ParallelEnv", "get_rank", "get_world_size", "all_reduce",
            "all_gather", "reduce_scatter", "broadcast", "reduce",
            "all_to_all", "barrier", "ReduceOp"]

@@ -1,0 +1,149 @@
+"""fleetrun-style multi-process launcher with failure watch.
+
+Reference: ``python/paddle/distributed/fleet/launch.py:319`` (fleetrun
+entry: parses cluster topology, spawns one trainer per device, wires
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / endpoints env) and
+``python/paddle/distributed/utils.py:424,484`` (start_local_trainers /
+watch_local_trainers: poll children, terminate the whole pod when any
+trainer dies).
+
+TPU-native differences: on TPU one *process per host* drives all local
+chips (not one per device, as with GPUs), and rendezvous is JAX's
+coordination service (``jax.distributed.initialize``) instead of a
+hand-rolled TCP store — the launcher only has to pick a coordinator
+address and export the ``PTPU_*`` env contract consumed by
+``paddle_tpu.parallel.env.init_parallel_env``.
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc 2 train.py --lr 0.1
+    # multi-host: run on every node with its own --node_rank
+    python -m paddle_tpu.distributed.launch --nnodes 4 --node_rank 0 \
+        --coordinator host0:1234 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+_POLL_S = 0.2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _start_proc(cmd, env, log_dir, rank):
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        # workerlog.N naming kept from the reference launcher
+        log = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        return subprocess.Popen(cmd, env=env, stdout=log, stderr=log), log
+    return subprocess.Popen(cmd, env=env), None
+
+
+def terminate_procs(procs, timeout: float = 10.0):
+    """SIGTERM the pod, escalate to SIGKILL after ``timeout`` (reference
+    ``distributed/utils.py:324`` terminate_local_procs)."""
+    for p, _ in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + timeout
+    for p, _ in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(_POLL_S)
+        if p.poll() is None:
+            p.kill()
+    for _, log in procs:
+        if log:
+            log.close()
+
+
+def launch(script: str, script_args: list[str] | None = None, *,
+           nproc: int = 1, nnodes: int = 1, node_rank: int = 0,
+           coordinator: str | None = None, log_dir: str | None = None,
+           extra_env: dict[str, str] | None = None) -> int:
+    """Spawn ``nproc`` local worker processes and watch them.
+
+    Returns the exit code: 0 if all workers succeeded; the first failing
+    worker's code otherwise (remaining workers are torn down, the
+    reference's watch_local_trainers contract).
+    """
+    script_args = script_args or []
+    world = nproc * nnodes
+    if coordinator is None:
+        if nnodes > 1:
+            raise ValueError("multi-node launch needs an explicit "
+                             "--coordinator host:port reachable by all nodes")
+        coordinator = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    try:
+        for local_rank in range(nproc):
+            rank = node_rank * nproc + local_rank
+            env = dict(os.environ)
+            env.update(extra_env or {})
+            env.update({
+                "PTPU_COORDINATOR": coordinator,
+                "PTPU_NUM_PROCESSES": str(world),
+                "PTPU_RANK": str(rank),
+                "PTPU_LOCAL_RANK": str(local_rank),
+            })
+            cmd = [sys.executable, "-u", script, *script_args]
+            procs.append(_start_proc(cmd, env, log_dir, rank))
+
+        # watch: any failure tears the pod down (utils.py:484)
+        while True:
+            alive = False
+            for p, _ in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    terminate_procs(procs)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(_POLL_S)
+    except KeyboardInterrupt:  # pragma: no cover - interactive only
+        terminate_procs(procs)
+        raise
+    finally:
+        for _, log in procs:
+            if log and not log.closed:
+                log.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="fleetrun-style launcher for multi-process TPU training")
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="worker processes on this node (TPU: usually 1 "
+                         "per host; CPU tests: any)")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank-0's coordination service")
+    ap.add_argument("--log_dir", default=None,
+                    help="per-rank workerlog.N files instead of stdout")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch(args.script, args.script_args, nproc=args.nproc,
+                  nnodes=args.nnodes, node_rank=args.node_rank,
+                  coordinator=args.coordinator, log_dir=args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
