@@ -28,7 +28,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu import amp as amp_mod
+from paddle_tpu.core import flags as flags_mod
 from paddle_tpu.core import rng
+from paddle_tpu.core.profiler import RecordEvent
 from paddle_tpu.core.module import apply_updates, trainable_mask
 from paddle_tpu.core.strategy import DistributedStrategy
 from paddle_tpu.nn.stateful import map_modules
@@ -179,6 +181,11 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     gm_cfg = strategy.gradient_merge
     k_steps = gm_cfg.k_steps if gm_cfg.enable else 1
 
+    # FLAGS_check_nan_inf is read at compile time: the sweep is part of the
+    # jitted graph (flipping the flag after build_train_step has no effect,
+    # matching the reference where it gates code inside the compiled op)
+    check_nan = bool(flags_mod.flag("check_nan_inf"))
+
     stage = strategy.sharding.stage if strategy.sharding.enable else 0
 
     if loss_fn is None:
@@ -251,7 +258,9 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             # different masks — without a stream, F.dropout fails fast
             # instead of silently corrupting gradients.
             from paddle_tpu.parallel import pipeline_1f1b
-            loss, grads = pipeline_1f1b.loss_and_grads(model, batch, mesh)
+            with RecordEvent("forward_backward"):
+                loss, grads = pipeline_1f1b.loss_and_grads(model, batch,
+                                                           mesh)
             tape = {}
             all_finite = jnp.asarray(True)
         elif use_fp16_ar:
@@ -276,16 +285,18 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                         tape.items()}
                 return grads, loss, tape
 
-            grads, loss, tape = shard_map(
-                local_grads, mesh=mesh, in_specs=(P(), data_specs),
-                out_specs=(P(), P(), P()), check_vma=False)(model, batch)
+            with RecordEvent("forward_backward"):
+                grads, loss, tape = shard_map(
+                    local_grads, mesh=mesh, in_specs=(P(), data_specs),
+                    out_specs=(P(), P(), P()), check_vma=False)(model, batch)
             grads, all_finite = (scaler.unscale(grads, state.scaler)
                                  if use_scaler else
                                  (grads, jnp.asarray(True)))
         else:
             grad_fn = jax.value_and_grad(
                 lambda m: compute_loss(m, batch), has_aux=True)
-            (_, (loss, tape)), grads = grad_fn(model)
+            with RecordEvent("forward_backward"):
+                (_, (loss, tape)), grads = grad_fn(model)
             grads, all_finite = (scaler.unscale(grads, state.scaler)
                                  if use_scaler else
                                  (grads, jnp.asarray(True)))
@@ -308,18 +319,22 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             do_apply = jnp.asarray(True)
             eff = grads
 
-        updates, new_opt = optimizer.update(eff, state.opt_state, model)
-        apply_gate = jnp.logical_and(do_apply, all_finite)
-        updates = jax.tree_util.tree_map(
-            lambda u: jnp.where(apply_gate, u, jnp.zeros_like(u)), updates)
-        # buffers (BN running stats) never take optimizer updates — they
-        # change only through the state tape merge below
-        updates = jax.tree_util.tree_map(
-            lambda u, t: u if t else jnp.zeros_like(u), updates, train_mask)
-        new_opt = jax.tree_util.tree_map(
-            lambda n, o: jnp.where(apply_gate, n, o) if hasattr(n, "shape")
-            else n, new_opt, state.opt_state)
-        new_model = apply_updates(model, updates)
+        with RecordEvent("optimizer_update"):
+            updates, new_opt = optimizer.update(eff, state.opt_state, model)
+            apply_gate = jnp.logical_and(do_apply, all_finite)
+            updates = jax.tree_util.tree_map(
+                lambda u: jnp.where(apply_gate, u, jnp.zeros_like(u)),
+                updates)
+            # buffers (BN running stats) never take optimizer updates —
+            # they change only through the state tape merge below
+            updates = jax.tree_util.tree_map(
+                lambda u, t: u if t else jnp.zeros_like(u), updates,
+                train_mask)
+            new_opt = jax.tree_util.tree_map(
+                lambda n, o: (jnp.where(apply_gate, n, o)
+                              if hasattr(n, "shape") else n),
+                new_opt, state.opt_state)
+            new_model = apply_updates(model, updates)
         if tape:
             from paddle_tpu.nn.stateful import merge_state
             new_model = merge_state(new_model, tape)
@@ -335,6 +350,21 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             "grad_norm": global_norm(grads),
             "all_finite": all_finite,
         }
+        if check_nan:
+            # FLAGS_check_nan_inf sweep (reference checks every op output,
+            # nan_inf_utils_detail.cc:301; one fused per-step sweep here —
+            # the per-op boundary doesn't exist inside a single XLA graph)
+            def _finite(tree):
+                checks = [jnp.all(jnp.isfinite(l))
+                          for l in jax.tree_util.tree_leaves(tree)
+                          if hasattr(l, "dtype")
+                          and jnp.issubdtype(l.dtype, jnp.floating)]
+                return (jnp.all(jnp.stack(checks)) if checks
+                        else jnp.asarray(True))
+
+            metrics["check/loss_finite"] = jnp.all(jnp.isfinite(loss))
+            metrics["check/grads_finite"] = _finite(grads)
+            metrics["check/params_finite"] = _finite(new_model)
         return TrainState(new_model, new_opt, new_scaler, acc,
                           state.step + 1), metrics
 
@@ -407,7 +437,20 @@ class CompiledTrainStep:
                 out_shardings=(state_shardings, None),
                 donate_argnums=(0,) if self._donate else (),
             )
-        return self._jitted(state, batch, key)
+        new_state, metrics = self._jitted(state, batch, key)
+        if "check/grads_finite" in metrics:
+            bad = [name for name in ("loss", "grads", "params")
+                   if not bool(metrics[f"check/{name}_finite"])]
+            if bad:
+                raise FloatingPointError(
+                    f"check_nan_inf: non-finite values in {', '.join(bad)} "
+                    f"at step {int(new_state.step)} "
+                    f"(loss={float(metrics['loss'])})")
+        if flags_mod.flag("benchmark"):
+            # FLAGS_benchmark: synchronize every step so host-side timing
+            # brackets real device work (reference operator.cc:1123)
+            jax.block_until_ready(new_state)
+        return new_state, metrics
 
     def eval_step(self, model, batch, eval_fn):
         """Jitted eval helper (no grad, eval mode). The jit wrapper is
