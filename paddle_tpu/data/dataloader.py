@@ -1,17 +1,24 @@
-"""DataLoader with threaded prefetch.
+"""DataLoader with a real parallel worker pool.
 
 Reference: ``python/paddle/fluid/reader.py:147`` (DataLoader facade),
-multiprocess iter ``fluid/dataloader/dataloader_iter.py:469``. The TPU
-host pipeline differs: workers are *threads* (numpy collation releases
-the GIL for the heavy copies) feeding a bounded queue, and an optional
-device-prefetch stage overlaps ``device_put`` with compute — the role the
-reference's pinned-memory + async memcpy path plays on CUDA.
+multiprocess iter ``fluid/dataloader/dataloader_iter.py:469``
+(_DataLoaderIterMultiProcess: N workers + ordered reassembly by batch
+index). The TPU host pipeline defaults to *thread* workers — numpy
+collation and IO release the GIL, and forking a process that holds a
+libtpu client is unsafe — with ``worker_mode="process"`` available for
+pure-Python CPU-bound datasets. Both modes preserve batch order (the
+reference's _order_ sending) and bound in-flight batches by the prefetch
+depth. An optional device-prefetch stage overlaps ``device_put`` with
+compute — the role the reference's pinned-memory + async memcpy path
+plays on CUDA.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable
 
 import numpy as np
@@ -42,10 +49,13 @@ class DataLoader:
                  drop_last: bool = False, collate_fn: Callable | None = None,
                  num_workers: int = 0, prefetch_factor: int | None = None,
                  batch_sampler: BatchSampler | None = None,
-                 device_put: bool = False):
+                 device_put: bool = False, worker_mode: str = "thread"):
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode={worker_mode!r}")
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate
         self.num_workers = int(num_workers)
+        self.worker_mode = worker_mode
         self.prefetch = (prefetch_factor if prefetch_factor is not None
                          else flag("host_prefetch_buffer"))
         self.device_put = device_put
@@ -79,10 +89,40 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def __iter__(self):
-        if self.num_workers <= 0:
-            yield from self._maybe_device(self._batches())
-            return
+    def _load_batch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def _pool_batches_threads(self):
+        """N thread workers, ordered reassembly: batches are submitted in
+        sampler order and yielded in submission order, with at most
+        ``num_workers + prefetch`` in flight."""
+        window = self.num_workers + max(self.prefetch, 1)
+        with ThreadPoolExecutor(self.num_workers) as ex:
+            pending: deque = deque()
+            for indices in self.batch_sampler:
+                pending.append(ex.submit(self._load_batch, indices))
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+
+    def _pool_batches_procs(self):
+        """N process workers (reference dataloader_iter.py:469). Fork-based
+        so the dataset needn't pickle; only safe when no accelerator
+        client is live in the parent — use for CPU-bound pure-Python
+        datasets."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        with ctx.Pool(self.num_workers) as pool:
+            # imap preserves order and streams results as they finish
+            yield from pool.imap(self._load_batch,
+                                 iter(self.batch_sampler),
+                                 chunksize=1)
+
+    def _single_producer(self):
+        """One background producer feeding a bounded queue (used for
+        IterableDataset, whose iteration order is inherently serial)."""
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         err: list[BaseException] = []
 
@@ -97,15 +137,23 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        def drain():
-            while True:
-                item = q.get()
-                if item is _STOP:
-                    if err:
-                        raise err[0]
-                    return
-                yield item
-        yield from self._maybe_device(drain())
+        while True:
+            item = q.get()
+            if item is _STOP:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._maybe_device(self._batches())
+        elif self._iterable:
+            yield from self._maybe_device(self._single_producer())
+        elif self.worker_mode == "process":
+            yield from self._maybe_device(self._pool_batches_procs())
+        else:
+            yield from self._maybe_device(self._pool_batches_threads())
 
     def _maybe_device(self, it: Iterable):
         if not self.device_put:
