@@ -18,8 +18,9 @@ from paddle_tpu.io.export import (
     load_inference_model,
     save_inference_model,
 )
+from paddle_tpu.io.auto_checkpoint import TrainEpochRange, train_epoch_range
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
            "load_state_dict", "state_dict", "set_state_dict",
            "export_function", "save_inference_model", "load_inference_model",
-           "Predictor"]
+           "Predictor", "TrainEpochRange", "train_epoch_range"]

@@ -1,0 +1,96 @@
+"""Auto-checkpoint: elastic epoch-range training with resume-from-latest.
+
+Reference: ``python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71``
+(``train_epoch_range`` generator: wraps the user's epoch loop, persists
+program+scope to HDFS keyed by job id at a save interval, and on restart
+fast-forwards past epochs that already completed; ``:265`` TrainEpochRange,
+``:598`` _run_save_0). The launcher-restart path is the reference's
+elastic story — the proc watcher (our ``distributed/launch.py``) restarts
+the pod, and auto-checkpoint makes the restart resume instead of redo.
+
+TPU design: the epoch state is an explicit pytree (TrainState), so
+"persist the scope" becomes an orbax sharded async save keyed by epoch
+number; restore is resharding-aware (orbax lays shards back onto the
+current mesh), so a resume can even change topology — something the
+reference's per-rank scope dumps cannot do.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator
+
+from paddle_tpu.io import checkpoint as ckpt
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]
+
+
+class TrainEpochRange:
+    """Iterate epochs with automatic save + resume.
+
+    Usage::
+
+        r = TrainEpochRange(10, "ckpts/job1", state=state)
+        state = r.state                      # restored if resuming
+        for epoch in r:
+            for batch in loader:
+                state, metrics = step(state, batch)
+            r.state = state                  # what the epoch-end save writes
+
+    On a fresh run this yields 0..9; after a crash it restores the latest
+    saved state and yields only the remaining epochs.
+    """
+
+    def __init__(self, max_epoch_num: int, directory: str, *, state: Any,
+                 save_interval: int = 1, save_interval_s: float | None = None,
+                 max_to_keep: int = 5):
+        self.max_epoch_num = int(max_epoch_num)
+        self.directory = directory
+        self.save_interval = max(int(save_interval), 1)
+        self.save_interval_s = save_interval_s
+        self.max_to_keep = max_to_keep
+        self._last_save_t = time.monotonic()
+
+        latest = ckpt.latest_step(directory)
+        if latest is None:
+            self.start_epoch = 0
+            self.state = state
+        else:
+            # resume: epoch `latest` completed; restore its state
+            self.start_epoch = latest + 1
+            self.state = ckpt.load_checkpoint(state, directory, step=latest)
+
+    @property
+    def resumed(self) -> bool:
+        return self.start_epoch > 0
+
+    def _should_save(self, epoch: int) -> bool:
+        if (epoch + 1) % self.save_interval == 0:
+            return True
+        if (self.save_interval_s is not None
+                and time.monotonic() - self._last_save_t
+                >= self.save_interval_s):
+            return True
+        return epoch + 1 == self.max_epoch_num  # always persist the last
+
+    def save(self, epoch: int) -> None:
+        ckpt.save_checkpoint(self.state, self.directory, step=epoch,
+                             max_to_keep=self.max_to_keep)
+        self._last_save_t = time.monotonic()
+
+    def flush(self) -> None:
+        """Block until pending async saves are durable (call before a
+        planned shutdown; crashes lose at most the in-flight save)."""
+        ckpt.wait_until_finished(self.directory)
+
+    def __iter__(self) -> Iterator[int]:
+        for epoch in range(self.start_epoch, self.max_epoch_num):
+            yield epoch
+            if self._should_save(epoch):
+                self.save(epoch)
+
+
+def train_epoch_range(max_epoch_num: int, directory: str, *, state: Any,
+                      **kw) -> TrainEpochRange:
+    """Functional alias matching the reference's entry point name."""
+    return TrainEpochRange(max_epoch_num, directory, state=state, **kw)
