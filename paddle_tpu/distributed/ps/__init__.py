@@ -1,0 +1,29 @@
+"""Parameter-server stack for sparse/recommender workloads.
+
+Reference architecture (SURVEY.md §2.3.5): sparse tables on server
+processes (``operators/distributed/large_scale_kv.h``,
+``paddle/fluid/distributed/table/table.h``), a ``listen_and_serv`` RPC
+loop (``operators/distributed_ops/listen_and_serv_op.cc``), and worker-
+side ``Communicator`` variants — sync / async / geo-SGD
+(``operators/distributed/communicator.cc``).
+
+TPU-native layering:
+
+- tables are host-RAM C++ (``paddle_tpu.native.NativeSparseTable``) —
+  HBM holds only the rows a batch touches;
+- the dense math stays in the jitted TPU step: the model consumes
+  *gathered rows* as an input and the step returns the gradient w.r.t.
+  those rows (see ``SparseEmbeddingHelper``);
+- the service is a length-prefixed binary TCP protocol (stdlib only —
+  the gRPC/BRPC role over DCN), with an in-process fast path when
+  server and worker share a host.
+"""
+
+from paddle_tpu.distributed.ps.client import PSClient, InProcClient
+from paddle_tpu.distributed.ps.communicator import Communicator
+from paddle_tpu.distributed.ps.server import ParameterServer
+from paddle_tpu.distributed.ps.sparse_embedding import SparseEmbeddingHelper
+from paddle_tpu.native import NativeSparseTable
+
+__all__ = ["ParameterServer", "PSClient", "InProcClient", "Communicator",
+           "SparseEmbeddingHelper", "NativeSparseTable"]

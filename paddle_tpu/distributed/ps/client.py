@@ -1,0 +1,191 @@
+"""Worker-side PS clients.
+
+Reference: ``operators/distributed/rpc_client.h`` (transport-agnostic
+client interface with gRPC/BRPC implementations) and
+``parameter_prefetch.cc`` (split ids → server shards → gather rows).
+Two implementations share one interface: ``PSClient`` over TCP, and
+``InProcClient`` calling tables directly (the heter-worker same-process
+fast path). Multi-server sharding: ids are routed to servers by
+``hash(id) % n_servers``, the reference's id-sharding scheme.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from paddle_tpu.distributed.ps.server import OPS, recv_frame, send_frame
+from paddle_tpu.native import NativeSparseTable
+
+__all__ = ["PSClient", "InProcClient"]
+
+
+class InProcClient:
+    """Direct table access for single-process (tests, single-host)."""
+
+    def __init__(self):
+        self._tables: dict[str, NativeSparseTable] = {}
+
+    def create_table(self, name: str, dim: int, *, optimizer="sgd",
+                     lr=0.01, init_scale=0.01, seed=0) -> None:
+        self._tables.setdefault(name, NativeSparseTable(
+            dim, optimizer=optimizer, lr=lr, init_scale=init_scale,
+            seed=seed))
+
+    def pull(self, name, ids):
+        return self._tables[name].pull(ids)
+
+    def push_grad(self, name, ids, grads):
+        self._tables[name].push_grad(ids, grads)
+
+    def push_delta(self, name, ids, deltas):
+        self._tables[name].push_delta(ids, deltas)
+
+    def size(self, name) -> int:
+        return len(self._tables[name])
+
+    def keys(self, name):
+        return self._tables[name].keys()
+
+    def save(self, name, path):
+        self._tables[name].save(path)
+
+    def load(self, name, path):
+        self._tables[name].load(path)
+
+    def barrier(self, world: int = 1):
+        pass
+
+    def close(self):
+        pass
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)))
+        self.lock = threading.Lock()
+
+    def request(self, op: str, header: dict, payload: bytes = b""):
+        with self.lock:
+            send_frame(self.sock, OPS[op], header, payload)
+            code, rheader, rpayload = recv_frame(self.sock)
+        if code != 0:
+            raise RuntimeError(f"PS {op} failed: {rheader.get('error')}")
+        return rheader, rpayload
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """TCP client; ids shard across servers by hash (parameter_prefetch)."""
+
+    def __init__(self, endpoints: list[str] | str):
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        self._conns = [_Conn(e) for e in endpoints]
+        self.n = len(self._conns)
+
+    def _route(self, ids: np.ndarray) -> np.ndarray:
+        # must match across workers; splitmix-free: cheap modulo of the id
+        return (ids % self.n).astype(np.int64)
+
+    def create_table(self, name: str, dim: int, *, optimizer="sgd",
+                     lr=0.01, init_scale=0.01, seed=0) -> None:
+        header = {"name": name, "dim": int(dim), "optimizer": optimizer,
+                  "lr": float(lr), "init_scale": float(init_scale),
+                  "seed": int(seed)}
+        for c in self._conns:
+            c.request("create", header)
+
+    def pull(self, name: str, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if self.n == 1:
+            h, payload = self._conns[0].request(
+                "pull", {"name": name, "nbytes": ids.nbytes}, ids.tobytes())
+            return np.frombuffer(payload, np.float32).reshape(h["shape"])
+        route = self._route(ids)
+        out = None
+        for s in range(self.n):
+            mask = route == s
+            if not mask.any():
+                continue
+            h, payload = self._conns[s].request(
+                "pull", {"name": name, "nbytes": ids[mask].nbytes},
+                ids[mask].tobytes())
+            rows = np.frombuffer(payload, np.float32).reshape(h["shape"])
+            if out is None:
+                out = np.empty((ids.shape[0], rows.shape[1]), np.float32)
+            out[mask] = rows
+        return out
+
+    def _push(self, op: str, name: str, ids, values) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            ids.shape[0], -1)
+        route = self._route(ids) if self.n > 1 else None
+        for s in range(self.n):
+            if route is None:
+                sel_ids, sel_vals = ids, values
+            else:
+                mask = route == s
+                if not mask.any():
+                    continue
+                sel_ids, sel_vals = ids[mask], values[mask]
+            payload = sel_ids.tobytes() + sel_vals.tobytes()
+            self._conns[s].request(
+                op, {"name": name, "n": int(sel_ids.shape[0]),
+                     "nbytes": len(payload)}, payload)
+            if route is None:
+                break
+
+    def push_grad(self, name, ids, grads):
+        self._push("push_grad", name, ids, grads)
+
+    def push_delta(self, name, ids, deltas):
+        self._push("push_delta", name, ids, deltas)
+
+    def size(self, name) -> int:
+        return sum(c.request("size", {"name": name})[0]["size"]
+                   for c in self._conns)
+
+    def keys(self, name) -> np.ndarray:
+        out = []
+        for c in self._conns:
+            _, payload = c.request("keys", {"name": name})
+            out.append(np.frombuffer(payload, np.int64))
+        return np.sort(np.concatenate(out)) if out else np.empty(0, np.int64)
+
+    def save(self, name, path):
+        for i, c in enumerate(self._conns):
+            c.request("save", {"name": name,
+                               "path": f"{path}.shard{i}" if self.n > 1
+                               else path})
+
+    def load(self, name, path):
+        for i, c in enumerate(self._conns):
+            c.request("load", {"name": name,
+                               "path": f"{path}.shard{i}" if self.n > 1
+                               else path})
+
+    def barrier(self, world: int):
+        """Block until ``world`` workers reach this point (role-maker
+        barrier, served by server 0)."""
+        self._conns[0].request("barrier", {"world": int(world)})
+
+    def stop_servers(self):
+        for c in self._conns:
+            try:
+                c.request("stop", {})
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()

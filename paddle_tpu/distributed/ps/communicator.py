@@ -1,0 +1,132 @@
+"""Worker-side communicator: sync / async / geo-SGD update flows.
+
+Reference: ``operators/distributed/communicator.cc`` —
+
+- ``Communicator`` (sync): send gradients every step, blocking; server
+  applies and workers pull fresh params.
+- ``AsyncCommunicator``: gradients enter a queue; background send threads
+  drain and merge them; workers train on whatever the server currently
+  has (Hogwild-style staleness).
+- ``GeoCommunicator`` (geo-SGD): each worker trains a *local* replica and
+  periodically ships parameter deltas (param - snapshot) to the server,
+  which accumulates them; the worker then refreshes its replica from the
+  server.
+
+TPU-native detail: geo's local replica is another ``NativeSparseTable``
+with the same (dim, optimizer, seed) — the deterministic per-id init
+means worker replicas and server agree on never-synced rows for free.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from paddle_tpu.native import NativeSparseTable
+
+__all__ = ["Communicator"]
+
+_STOP = object()
+
+
+class Communicator:
+    def __init__(self, client, mode: str = "sync", *, geo_k: int = 10,
+                 async_queue_size: int = 64):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"mode {mode!r}")
+        self.client = client
+        self.mode = mode
+        self.geo_k = int(geo_k)
+        self._specs: dict[str, dict] = {}
+        self._local: dict[str, NativeSparseTable] = {}
+        self._snapshot: dict[str, dict[int, np.ndarray]] = {}
+        self._touched: dict[str, set] = {}
+        self._push_count = 0
+        self._q: queue.Queue | None = None
+        self._sender: threading.Thread | None = None
+        if mode == "async":
+            self._q = queue.Queue(maxsize=async_queue_size)
+            self._sender = threading.Thread(target=self._drain, daemon=True)
+            self._sender.start()
+
+    # ------------------------------------------------------------------
+    def create_table(self, name: str, dim: int, *, optimizer="sgd", lr=0.01,
+                     init_scale=0.01, seed=0) -> None:
+        spec = dict(dim=dim, optimizer=optimizer, lr=lr,
+                    init_scale=init_scale, seed=seed)
+        self._specs[name] = spec
+        self.client.create_table(name, **spec)
+        if self.mode == "geo":
+            self._local[name] = NativeSparseTable(**spec)
+            self._snapshot[name] = {}
+            self._touched[name] = set()
+
+    # ------------------------------------------------------------------
+    def pull(self, name: str, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if self.mode != "geo":
+            return self.client.pull(name, ids)
+        rows = self._local[name].pull(ids)
+        snap = self._snapshot[name]
+        for i, id_ in enumerate(ids.tolist()):
+            # snapshot the pre-update value the first time a row is seen in
+            # this sync window (the GeoCommunicator "old value" record)
+            if id_ not in snap:
+                snap[id_] = rows[i].copy()
+        return rows
+
+    def push_grad(self, name: str, ids, grads) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self.mode == "sync":
+            self.client.push_grad(name, ids, grads)
+        elif self.mode == "async":
+            self._q.put((name, ids.copy(), grads.copy()))
+        else:  # geo: local step; deltas ship on the sync interval
+            self._local[name].push_grad(ids, grads)
+            self._touched[name].update(ids.tolist())
+            self._push_count += 1
+            if self._push_count % self.geo_k == 0:
+                self.sync_geo()
+
+    # ------------------------------------------------------------------
+    def sync_geo(self) -> None:
+        """Ship (local - snapshot) deltas, then refresh local = server."""
+        for name, touched in self._touched.items():
+            if not touched:
+                continue
+            ids = np.fromiter(touched, np.int64)
+            local_rows = self._local[name].pull(ids)
+            snap = self._snapshot[name]
+            base = np.stack([snap[i] for i in ids.tolist()])
+            self.client.push_delta(name, ids, local_rows - base)
+            fresh = self.client.pull(name, ids)
+            self._local[name].assign(ids, fresh)
+            for i, id_ in enumerate(ids.tolist()):
+                snap[id_] = fresh[i].copy()
+            touched.clear()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            name, ids, grads = item
+            self.client.push_grad(name, ids, grads)
+            self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until queued work is visible server-side (async: drain
+        the queue; geo: force a sync)."""
+        if self.mode == "async":
+            self._q.join()
+        elif self.mode == "geo":
+            self.sync_geo()
+
+    def stop(self) -> None:
+        if self._sender is not None:
+            self._q.put(_STOP)
+            self._sender.join(timeout=10)
+            self._sender = None

@@ -1,0 +1,187 @@
+"""Parameter server: table host + TCP service loop.
+
+Reference: ``operators/distributed_ops/listen_and_serv_op.cc`` (blocking
+server loop dispatching RPC requests to handlers) with gRPC/BRPC
+transports (``operators/distributed/grpc/``). Here the transport is a
+length-prefixed binary protocol over stdlib TCP — one request frame:
+
+    [4B op][4B json_len][json header][raw ids int64][raw values f32]
+
+and one response frame: ``[4B status][4B json_len][json][raw payload]``.
+Numpy buffers cross the wire raw (no pickling — the protocol is safe to
+expose beyond localhost, unlike pickle-RPC).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.native import NativeSparseTable
+
+__all__ = ["ParameterServer", "OPS"]
+
+OPS = {"create": 1, "pull": 2, "push_grad": 3, "push_delta": 4, "size": 5,
+       "save": 6, "load": 7, "keys": 8, "stop": 9, "barrier": 10}
+_OP_NAMES = {v: k for k, v in OPS.items()}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
+               payload: bytes = b"") -> None:
+    hj = json.dumps(header).encode()
+    sock.sendall(struct.pack("<ii", code, len(hj)) + hj + payload)
+
+
+def recv_frame(sock: socket.socket):
+    code, hlen = struct.unpack("<ii", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen)) if hlen else {}
+    payload = _recv_exact(sock, header.get("nbytes", 0))
+    return code, header, payload
+
+
+class _TableRegistry:
+    """Named tables + a generation barrier (the role-maker barrier role)."""
+
+    def __init__(self):
+        self._tables: dict[str, NativeSparseTable] = {}
+        self._lock = threading.Lock()
+        self._barrier_cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+
+    def create(self, name: str, **kw) -> None:
+        with self._lock:
+            if name not in self._tables:
+                self._tables[name] = NativeSparseTable(**kw)
+
+    def get(self, name: str) -> NativeSparseTable:
+        with self._lock:
+            if name not in self._tables:
+                raise KeyError(f"no table {name!r}")
+            return self._tables[name]
+
+    def barrier(self, world: int) -> None:
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= world:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+            else:
+                self._barrier_cv.wait_for(
+                    lambda: self._barrier_gen != gen, timeout=120)
+
+
+class ParameterServer:
+    """Hosts sparse tables and serves the PS protocol.
+
+    ``start()`` runs the service loop in background threads (one per
+    connection, matching the reference's RPC server thread pool);
+    ``InProcClient`` can bypass TCP entirely for same-process workers.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.registry = _TableRegistry()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, header, payload = recv_frame(self.request)
+                        if not outer._dispatch(self.request, op, header,
+                                               payload):
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "ParameterServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request dispatch --------------------------------------------------
+    def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
+        name = _OP_NAMES.get(op)
+        try:
+            if name == "stop":
+                send_frame(sock, 0, {})
+                threading.Thread(target=self.stop, daemon=True).start()
+                return False
+            if name == "create":
+                self.registry.create(header["name"], dim=header["dim"],
+                                     optimizer=header["optimizer"],
+                                     lr=header["lr"],
+                                     init_scale=header["init_scale"],
+                                     seed=header["seed"])
+                send_frame(sock, 0, {})
+                return True
+            if name == "barrier":
+                self.registry.barrier(int(header["world"]))
+                send_frame(sock, 0, {})
+                return True
+
+            table = self.registry.get(header["name"])
+            if name == "pull":
+                ids = np.frombuffer(payload, np.int64)
+                rows = table.pull(ids)
+                send_frame(sock, 0, {"nbytes": rows.nbytes,
+                                     "shape": list(rows.shape)},
+                           rows.tobytes())
+            elif name in ("push_grad", "push_delta"):
+                n = header["n"]
+                ids = np.frombuffer(payload[:8 * n], np.int64)
+                vals = np.frombuffer(payload[8 * n:], np.float32)
+                getattr(table, name)(ids, vals.reshape(n, table.dim))
+                send_frame(sock, 0, {})
+            elif name == "size":
+                send_frame(sock, 0, {"size": len(table)})
+            elif name == "keys":
+                k = table.keys()
+                send_frame(sock, 0, {"nbytes": k.nbytes}, k.tobytes())
+            elif name == "save":
+                table.save(header["path"])
+                send_frame(sock, 0, {})
+            elif name == "load":
+                table.load(header["path"])
+                send_frame(sock, 0, {})
+            else:
+                send_frame(sock, 1, {"error": f"bad op {op}"})
+            return True
+        except Exception as e:  # report, keep serving
+            send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})
+            return True

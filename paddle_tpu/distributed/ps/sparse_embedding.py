@@ -1,0 +1,48 @@
+"""Bridge between host-side sparse tables and the jitted TPU step.
+
+Reference: ``distributed_lookup_table_op.cc`` + ``parameter_prefetch.cc``
+(the lookup_table op, in PS mode, prefetches rows from servers before the
+dense part of the graph runs, and the grad op sends sparse grads back).
+
+TPU-native pattern: inside ``jax.jit`` there is no RPC, so the lookup is
+*hoisted out of the graph*: the helper pulls the batch's rows into a
+dense ``[n, dim]`` array that enters the jitted step as a plain input,
+and the step returns ``d loss / d rows``, which the helper pushes back.
+Duplicate ids inside a batch are deduplicated before the pull (one row
+per unique id + inverse indices), so the jit sees a gather it can fuse,
+and the pushed gradient is the correctly-summed per-id gradient.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SparseEmbeddingHelper"]
+
+
+class SparseEmbeddingHelper:
+    def __init__(self, communicator, name: str, dim: int, *,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 init_scale: float = 0.01, seed: int = 0):
+        self.comm = communicator
+        self.name = name
+        self.dim = int(dim)
+        self.comm.create_table(name, dim, optimizer=optimizer, lr=lr,
+                               init_scale=init_scale, seed=seed)
+
+    def lookup(self, ids):
+        """ids [any shape] → (unique_rows [u, dim] jnp, inverse [n]).
+
+        The model reconstructs per-position embeddings with
+        ``unique_rows[inverse].reshape(*ids.shape, dim)`` inside jit; the
+        gradient w.r.t. ``unique_rows`` is then already duplicate-summed.
+        """
+        import jax.numpy as jnp
+
+        ids = np.ascontiguousarray(ids, np.int64)
+        uniq, inverse = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = self.comm.pull(self.name, uniq)
+        return jnp.asarray(rows), jnp.asarray(inverse), uniq
+
+    def apply_grads(self, uniq_ids, grad_rows) -> None:
+        self.comm.push_grad(self.name, uniq_ids, np.asarray(grad_rows))

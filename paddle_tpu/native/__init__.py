@@ -1,0 +1,143 @@
+"""paddle_tpu.native — C++ host runtime (sparse tables, packed data feed).
+
+The TPU compute path is JAX/XLA/Pallas; the *host* runtime around it is
+native C++, like the reference's: sparse parameter tables
+(reference ``operators/distributed/large_scale_kv.h:1``,
+``paddle/fluid/distributed/table/common_sparse_table.cc``) and the packed
+data feed (``framework/data_feed.h:678`` MultiSlotInMemoryDataFeed).
+Compiled on first use (see ``build.py``) and bound via ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from paddle_tpu.native.build import build_library
+
+__all__ = ["NativeSparseTable", "lib", "OPTIMIZERS"]
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+_lib = None
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(build_library())
+        _declare(_lib)
+    return _lib
+
+
+def _declare(L: ctypes.CDLL) -> None:
+    i64, f32, vp, cp = (ctypes.c_int64, ctypes.c_float, ctypes.c_void_p,
+                        ctypes.c_char_p)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    L.pt_sparse_table_create.restype = vp
+    L.pt_sparse_table_create.argtypes = [i64, ctypes.c_int, f32, f32,
+                                         ctypes.c_uint64, ctypes.c_int]
+    L.pt_sparse_table_free.argtypes = [vp]
+    L.pt_sparse_table_size.restype = i64
+    L.pt_sparse_table_size.argtypes = [vp]
+    L.pt_sparse_table_pull.argtypes = [vp, i64p, i64, f32p]
+    L.pt_sparse_table_push_grad.argtypes = [vp, i64p, i64, f32p]
+    L.pt_sparse_table_push_delta.argtypes = [vp, i64p, i64, f32p]
+    L.pt_sparse_table_assign.argtypes = [vp, i64p, i64, f32p]
+    L.pt_sparse_table_keys.restype = i64
+    L.pt_sparse_table_keys.argtypes = [vp, i64p, i64]
+    L.pt_sparse_table_save.restype = ctypes.c_int
+    L.pt_sparse_table_save.argtypes = [vp, cp]
+    L.pt_sparse_table_load.restype = ctypes.c_int
+    L.pt_sparse_table_load.argtypes = [vp, cp]
+    L.pt_sparse_table_set_lr.argtypes = [vp, f32]
+
+
+def _ids_ptr(ids: np.ndarray):
+    return ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeSparseTable:
+    """ctypes handle over the C++ sharded sparse table."""
+
+    def __init__(self, dim: int, *, optimizer: str = "sgd", lr: float = 0.01,
+                 init_scale: float = 0.01, seed: int = 0, shards: int = 16):
+        if optimizer not in OPTIMIZERS:
+            raise ValueError(f"optimizer {optimizer!r}: "
+                             f"choose from {sorted(OPTIMIZERS)}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self._h = lib().pt_sparse_table_create(
+            self.dim, OPTIMIZERS[optimizer], float(lr), float(init_scale),
+            int(seed), int(shards))
+        if not self._h:
+            raise RuntimeError("sparse table creation failed")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.pt_sparse_table_free(h)
+
+    def __len__(self) -> int:
+        return int(lib().pt_sparse_table_size(self._h))
+
+    def _check_ids(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, dtype=np.int64).reshape(-1)
+        return ids
+
+    def pull(self, ids) -> np.ndarray:
+        """Rows for ``ids`` (missing rows materialize deterministically)."""
+        ids = self._check_ids(ids)
+        out = np.empty((ids.shape[0], self.dim), np.float32)
+        lib().pt_sparse_table_pull(self._h, _ids_ptr(ids), ids.shape[0],
+                                   _f32_ptr(out))
+        return out
+
+    def push_grad(self, ids, grads) -> None:
+        """Apply one server-side optimizer step from (possibly duplicate-
+        id) row gradients."""
+        ids = self._check_ids(ids)
+        grads = np.ascontiguousarray(grads, dtype=np.float32).reshape(
+            ids.shape[0], self.dim)
+        lib().pt_sparse_table_push_grad(self._h, _ids_ptr(ids),
+                                        ids.shape[0], _f32_ptr(grads))
+
+    def push_delta(self, ids, deltas) -> None:
+        """geo-SGD: add raw parameter deltas (no optimizer slots)."""
+        ids = self._check_ids(ids)
+        deltas = np.ascontiguousarray(deltas, dtype=np.float32).reshape(
+            ids.shape[0], self.dim)
+        lib().pt_sparse_table_push_delta(self._h, _ids_ptr(ids),
+                                         ids.shape[0], _f32_ptr(deltas))
+
+    def assign(self, ids, values) -> None:
+        ids = self._check_ids(ids)
+        values = np.ascontiguousarray(values, dtype=np.float32).reshape(
+            ids.shape[0], self.dim)
+        lib().pt_sparse_table_assign(self._h, _ids_ptr(ids), ids.shape[0],
+                                     _f32_ptr(values))
+
+    def keys(self) -> np.ndarray:
+        cap = len(self) + 64
+        out = np.empty(cap, np.int64)
+        n = lib().pt_sparse_table_keys(self._h, _ids_ptr(out), cap)
+        return np.sort(out[:n])
+
+    def set_lr(self, lr: float) -> None:
+        lib().pt_sparse_table_set_lr(self._h, float(lr))
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if lib().pt_sparse_table_save(self._h, path.encode()) != 0:
+            raise IOError(f"sparse table save failed: {path}")
+
+    def load(self, path: str) -> None:
+        if lib().pt_sparse_table_load(self._h, path.encode()) != 0:
+            raise IOError(f"sparse table load failed: {path}")

@@ -1,0 +1,53 @@
+"""Build the native host-runtime library (g++ → .so, loaded via ctypes).
+
+The reference's native layer is CMake-built C++ linked into the pybind
+module (``paddle/fluid/pybind/pybind.cc:353``); here the host runtime is a
+small self-contained C++17 library compiled on first import and cached by
+source hash. ctypes replaces pybind (not available in this image); the
+arrays crossing the boundary are plain contiguous buffers so there is no
+marshalling cost either way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_SOURCES = ["sparse_table.cc", "data_feed.cc"]
+_lock = threading.Lock()
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES:
+        path = os.path.join(_SRC_DIR, name)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_library() -> str:
+    """Compile (if stale) and return the path to the shared library."""
+    with _lock:
+        tag = _source_hash()
+        so_path = os.path.join(_BUILD_DIR, f"libptnative-{tag}.so")
+        if os.path.exists(so_path):
+            return so_path
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES
+                if os.path.exists(os.path.join(_SRC_DIR, s))]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               "-o", so_path + ".tmp", *srcs]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed:\n{e.stderr}") from None
+        os.replace(so_path + ".tmp", so_path)
+        return so_path

@@ -1,0 +1,301 @@
+// Host-side sparse parameter table for the parameter-server stack.
+//
+// TPU-native counterpart of the reference's large-scale KV
+// (reference /root/reference/paddle/fluid/operators/distributed/large_scale_kv.h:1
+// SparseVariable: sharded unordered_map of id -> {param + optimizer slots},
+// and paddle/fluid/distributed/table/common_sparse_table.cc): embeddings too
+// large for HBM live in host RAM; workers pull rows for the ids in a batch,
+// run the dense math on the TPU, and push gradients back; the optimizer
+// update happens server-side (per-row SGD/AdaGrad/Adam), which is what
+// makes async/geo modes possible.
+//
+// Design deltas from the reference, on purpose:
+//  - init-on-first-touch is a *deterministic* per-id hash RNG (splitmix64
+//    of table seed + id), so any worker/any host materializes identical
+//    rows without coordination — the reference re-seeds a global generator
+//    and must broadcast initialized rows instead.
+//  - the value layout is [param(dim) | slot0(dim) | slot1(dim) | t] in one
+//    contiguous allocation per row (cache-friendly pull).
+//  - C ABI + ctypes instead of pybind (not available in this image).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Optimizer : int { kSGD = 0, kAdaGrad = 1, kAdam = 2 };
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// uniform in [-scale, scale), deterministic in (seed, id, j)
+inline float init_value(uint64_t seed, int64_t id, int64_t j, float scale) {
+  uint64_t h = splitmix64(seed ^ splitmix64(static_cast<uint64_t>(id) +
+                                            0x51ed270b * (uint64_t)(j + 1)));
+  double u = (h >> 11) * (1.0 / 9007199254740992.0);  // [0, 1)
+  return static_cast<float>((2.0 * u - 1.0) * scale);
+}
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;
+};
+
+struct SparseTable {
+  int64_t dim;
+  int optimizer;
+  float lr;
+  float init_scale;
+  uint64_t seed;
+  int n_shards;
+  // adam hyperparams (fixed defaults; row-local step t lives in the row)
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+  std::vector<Shard> shards;
+
+  SparseTable(int64_t d, int opt, float lr_, float scale, uint64_t seed_,
+              int ns)
+      : dim(d), optimizer(opt), lr(lr_), init_scale(scale), seed(seed_),
+        n_shards(ns), shards(ns) {}
+
+  size_t value_size() const {
+    switch (optimizer) {
+      case kSGD: return dim;
+      case kAdaGrad: return 2 * dim;
+      case kAdam: return 3 * dim + 1;  // param, m, v, t
+    }
+    return dim;
+  }
+
+  Shard& shard_of(int64_t id) {
+    return shards[splitmix64(static_cast<uint64_t>(id)) % n_shards];
+  }
+
+  std::vector<float>& row(int64_t id, bool* created = nullptr) {
+    // caller must hold the shard lock
+    Shard& s = shard_of(id);
+    auto it = s.rows.find(id);
+    if (it == s.rows.end()) {
+      std::vector<float> v(value_size(), 0.0f);
+      for (int64_t j = 0; j < dim; ++j)
+        v[j] = init_value(seed, id, j, init_scale);
+      it = s.rows.emplace(id, std::move(v)).first;
+      if (created) *created = true;
+    }
+    return it->second;
+  }
+
+  void pull(const int64_t* ids, int64_t n, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard_of(ids[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      const std::vector<float>& v = row(ids[i]);
+      std::memcpy(out + i * dim, v.data(), dim * sizeof(float));
+    }
+  }
+
+  void apply_update(std::vector<float>& v, const float* g) {
+    float* p = v.data();
+    switch (optimizer) {
+      case kSGD:
+        for (int64_t j = 0; j < dim; ++j) p[j] -= lr * g[j];
+        break;
+      case kAdaGrad: {
+        float* G = p + dim;
+        for (int64_t j = 0; j < dim; ++j) {
+          G[j] += g[j] * g[j];
+          p[j] -= lr * g[j] / (std::sqrt(G[j]) + 1e-6f);
+        }
+        break;
+      }
+      case kAdam: {
+        float* m = p + dim;
+        float* vv = p + 2 * dim;
+        float& t = p[3 * dim];
+        t += 1.0f;
+        float bc1 = 1.0f - std::pow(beta1, t);
+        float bc2 = 1.0f - std::pow(beta2, t);
+        for (int64_t j = 0; j < dim; ++j) {
+          m[j] = beta1 * m[j] + (1 - beta1) * g[j];
+          vv[j] = beta2 * vv[j] + (1 - beta2) * g[j] * g[j];
+          p[j] -= lr * (m[j] / bc1) / (std::sqrt(vv[j] / bc2) + eps);
+        }
+        break;
+      }
+    }
+  }
+
+  void push_grad(const int64_t* ids, int64_t n, const float* grads) {
+    // merge duplicate ids first (the reference merges SelectedRows grads
+    // before the update) so each row takes one optimizer step per push
+    std::unordered_map<int64_t, std::vector<float>> merged;
+    merged.reserve(n);
+    for (int64_t i = 0; i < n; ++i) {
+      auto& acc = merged[ids[i]];
+      if (acc.empty()) acc.assign(dim, 0.0f);
+      const float* g = grads + i * dim;
+      for (int64_t j = 0; j < dim; ++j) acc[j] += g[j];
+    }
+    for (auto& kv : merged) {
+      Shard& s = shard_of(kv.first);
+      std::lock_guard<std::mutex> g(s.mu);
+      apply_update(row(kv.first), kv.second.data());
+    }
+  }
+
+  void push_delta(const int64_t* ids, int64_t n, const float* deltas) {
+    // geo-SGD: add raw parameter deltas (no optimizer state touched)
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard_of(ids[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      std::vector<float>& v = row(ids[i]);
+      const float* d = deltas + i * dim;
+      for (int64_t j = 0; j < dim; ++j) v[j] += d[j];
+    }
+  }
+
+  void assign(const int64_t* ids, int64_t n, const float* vals) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard_of(ids[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      std::vector<float>& v = row(ids[i]);
+      std::memcpy(v.data(), vals + i * dim, dim * sizeof(float));
+    }
+  }
+
+  int64_t size() {
+    int64_t total = 0;
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      total += static_cast<int64_t>(s.rows.size());
+    }
+    return total;
+  }
+
+  int64_t keys(int64_t* out, int64_t cap) {
+    int64_t k = 0;
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (auto& kv : s.rows) {
+        if (k >= cap) return k;
+        out[k++] = kv.first;
+      }
+    }
+    return k;
+  }
+
+  bool save(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    const uint64_t magic = 0x50545350u;  // "PTSP"
+    int64_t count = size();
+    size_t vs = value_size();
+    std::fwrite(&magic, sizeof(magic), 1, f);
+    std::fwrite(&dim, sizeof(dim), 1, f);
+    std::fwrite(&optimizer, sizeof(optimizer), 1, f);
+    std::fwrite(&count, sizeof(count), 1, f);
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (auto& kv : s.rows) {
+        std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+        std::fwrite(kv.second.data(), sizeof(float), vs, f);
+      }
+    }
+    std::fclose(f);
+    return true;
+  }
+
+  bool load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    uint64_t magic = 0;
+    int64_t d = 0, count = 0;
+    int opt = 0;
+    bool ok = std::fread(&magic, sizeof(magic), 1, f) == 1 &&
+              std::fread(&d, sizeof(d), 1, f) == 1 &&
+              std::fread(&opt, sizeof(opt), 1, f) == 1 &&
+              std::fread(&count, sizeof(count), 1, f) == 1;
+    if (!ok || magic != 0x50545350u || d != dim || opt != optimizer) {
+      std::fclose(f);
+      return false;
+    }
+    size_t vs = value_size();
+    std::vector<float> buf(vs);
+    for (int64_t i = 0; i < count; ++i) {
+      int64_t id;
+      if (std::fread(&id, sizeof(id), 1, f) != 1 ||
+          std::fread(buf.data(), sizeof(float), vs, f) != vs) {
+        std::fclose(f);
+        return false;
+      }
+      Shard& s = shard_of(id);
+      std::lock_guard<std::mutex> g(s.mu);
+      s.rows[id] = buf;
+    }
+    std::fclose(f);
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_sparse_table_create(int64_t dim, int optimizer, float lr,
+                             float init_scale, uint64_t seed, int shards) {
+  if (dim <= 0 || shards <= 0) return nullptr;
+  return new SparseTable(dim, optimizer, lr, init_scale, seed, shards);
+}
+
+void pt_sparse_table_free(void* t) { delete static_cast<SparseTable*>(t); }
+
+int64_t pt_sparse_table_size(void* t) {
+  return static_cast<SparseTable*>(t)->size();
+}
+
+void pt_sparse_table_pull(void* t, const int64_t* ids, int64_t n,
+                          float* out) {
+  static_cast<SparseTable*>(t)->pull(ids, n, out);
+}
+
+void pt_sparse_table_push_grad(void* t, const int64_t* ids, int64_t n,
+                               const float* grads) {
+  static_cast<SparseTable*>(t)->push_grad(ids, n, grads);
+}
+
+void pt_sparse_table_push_delta(void* t, const int64_t* ids, int64_t n,
+                                const float* deltas) {
+  static_cast<SparseTable*>(t)->push_delta(ids, n, deltas);
+}
+
+void pt_sparse_table_assign(void* t, const int64_t* ids, int64_t n,
+                            const float* vals) {
+  static_cast<SparseTable*>(t)->assign(ids, n, vals);
+}
+
+int64_t pt_sparse_table_keys(void* t, int64_t* out, int64_t cap) {
+  return static_cast<SparseTable*>(t)->keys(out, cap);
+}
+
+int pt_sparse_table_save(void* t, const char* path) {
+  return static_cast<SparseTable*>(t)->save(path) ? 0 : -1;
+}
+
+int pt_sparse_table_load(void* t, const char* path) {
+  return static_cast<SparseTable*>(t)->load(path) ? 0 : -1;
+}
+
+void pt_sparse_table_set_lr(void* t, float lr) {
+  static_cast<SparseTable*>(t)->lr = lr;
+}
+
+}  // extern "C"
