@@ -1,0 +1,116 @@
+"""Python surface of the native multi-slot data feed.
+
+See ``csrc/data_feed.cc`` for the format/architecture notes (reference
+``framework/data_feed.h:678`` MultiSlotInMemoryDataFeed). Batches come
+out as numpy views ready for ``jax.device_put``: sparse slots as
+``(values[int64], offsets[int64, bs+1])`` CSR pairs (the lod of the
+reference's LoDTensor), dense float slots as ``[bs, dim]`` when every
+record agrees on ``dim``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from paddle_tpu.native import lib
+
+__all__ = ["NativeDataFeed"]
+
+_TYPES = {"int64": 0, "float": 1}
+
+
+def _declare(L):
+    if getattr(L, "_feed_declared", False):
+        return L
+    i64, i32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int)
+    vp = ctypes.c_void_p
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    L.pt_feed_create.restype = vp
+    L.pt_feed_create.argtypes = [i32p, ctypes.c_int]
+    L.pt_feed_free.argtypes = [vp]
+    L.pt_feed_load_file.restype = i64
+    L.pt_feed_load_file.argtypes = [vp, ctypes.c_char_p]
+    L.pt_feed_num_records.restype = i64
+    L.pt_feed_num_records.argtypes = [vp]
+    L.pt_feed_shuffle.argtypes = [vp, ctypes.c_uint64]
+    L.pt_feed_batch_count.restype = i64
+    L.pt_feed_batch_count.argtypes = [vp, ctypes.c_int, i64, i64]
+    L.pt_feed_fill_batch.restype = i64
+    L.pt_feed_fill_batch.argtypes = [vp, ctypes.c_int, i64, i64, vp, i64p]
+    L._feed_declared = True
+    return L
+
+
+class NativeDataFeed:
+    """In-memory multi-slot feed: load text files, global shuffle, iterate
+    packed batches.
+
+    ``slots`` is an ordered ``{name: "int64" | "float"}`` mapping matching
+    the file's slot order.
+    """
+
+    def __init__(self, slots: dict[str, str]):
+        self.slot_names = list(slots)
+        self.slot_types = [slots[n] for n in self.slot_names]
+        for t in self.slot_types:
+            if t not in _TYPES:
+                raise ValueError(f"slot type {t!r}")
+        self._L = _declare(lib())
+        arr = (ctypes.c_int * len(self.slot_types))(
+            *[_TYPES[t] for t in self.slot_types])
+        self._h = self._L.pt_feed_create(arr, len(self.slot_types))
+        if not self._h:
+            raise RuntimeError("feed creation failed")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._L.pt_feed_free(h)
+
+    def load_file(self, path: str) -> int:
+        n = self._L.pt_feed_load_file(self._h, str(path).encode())
+        if n < 0:
+            raise ValueError(f"parse error in {path} at line {-n}")
+        return int(n)
+
+    def __len__(self) -> int:
+        return int(self._L.pt_feed_num_records(self._h))
+
+    def global_shuffle(self, seed: int = 0) -> None:
+        """Shuffle record order (Dataset::GlobalShuffle analogue — one
+        host's share; cross-host the sampler shards by rank first)."""
+        self._L.pt_feed_shuffle(self._h, int(seed))
+
+    def _slot_batch(self, si: int, start: int, bs: int):
+        total = self._L.pt_feed_batch_count(self._h, si, start, bs)
+        is_int = self.slot_types[si] == "int64"
+        values = np.empty(total, np.int64 if is_int else np.float32)
+        offsets = np.empty(bs + 1, np.int64)
+        n = self._L.pt_feed_fill_batch(
+            self._h, si, start, bs, values.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return values, offsets[:n + 1], int(n)
+
+    def batches(self, batch_size: int, *, drop_last: bool = False,
+                dense: bool = True):
+        """Yield ``{slot: (values, offsets)}`` CSR batches; fixed-width
+        float slots become ``[bs, dim]`` arrays when ``dense``."""
+        n = len(self)
+        start = 0
+        while start < n:
+            bs = min(batch_size, n - start)
+            if bs < batch_size and drop_last:
+                return
+            out = {}
+            for si, name in enumerate(self.slot_names):
+                values, offsets, filled = self._slot_batch(si, start, bs)
+                widths = np.diff(offsets)
+                if (dense and self.slot_types[si] == "float"
+                        and widths.size and (widths == widths[0]).all()):
+                    out[name] = values.reshape(filled, widths[0])
+                else:
+                    out[name] = (values, offsets)
+            yield out
+            start += bs
