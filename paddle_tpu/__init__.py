@@ -65,6 +65,7 @@ from paddle_tpu import amp  # noqa: E402
 from paddle_tpu import metric  # noqa: E402
 from paddle_tpu import io  # noqa: E402
 from paddle_tpu.core import profiler  # noqa: E402
+from paddle_tpu import quant  # noqa: E402
 
 __all__ = [
     "__version__",

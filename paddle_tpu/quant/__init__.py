@@ -1,0 +1,27 @@
+"""paddle_tpu.quant — QAT + PTQ (the slim/quantization equivalent).
+
+Reference: ``python/paddle/fluid/contrib/slim/quantization/`` —
+QuantizationTransformPass (QAT fake-quant insertion),
+PostTrainingQuantization (calibration), QuantizationFreezePass (int8
+freeze). See ``qat.py`` / ``ptq.py`` for the TPU-native mapping (module
+surgery instead of program rewriting; real int8 MXU matmuls after
+freeze).
+"""
+
+from paddle_tpu.quant import functional
+from paddle_tpu.quant.functional import (
+    fake_channel_wise_quant_abs_max, fake_quant, fake_quant_abs_max,
+    moving_average_abs_max_scale, quant_max,
+)
+from paddle_tpu.quant.qat import (
+    QuantConfig, QuantedConv2D, QuantedLinear, quantize_model,
+)
+from paddle_tpu.quant.ptq import (
+    Int8Linear, calibrate, convert_to_int8, int8_state_dict,
+)
+
+__all__ = ["functional", "fake_quant", "fake_quant_abs_max",
+           "fake_channel_wise_quant_abs_max", "moving_average_abs_max_scale",
+           "quant_max", "QuantConfig", "QuantedLinear", "QuantedConv2D",
+           "quantize_model", "calibrate", "convert_to_int8", "Int8Linear",
+           "int8_state_dict"]

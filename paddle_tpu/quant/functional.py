@@ -1,0 +1,78 @@
+"""Fake-quantization primitives with straight-through gradients.
+
+Reference: ``paddle/fluid/operators/fake_quantize_op.cc`` /
+``fake_quantize_op.cu`` (fake_quantize_abs_max,
+fake_channel_wise_quantize_abs_max, fake_quantize_moving_average_abs_max —
+the op set the slim QAT passes insert,
+``fluid/contrib/slim/quantization/quantization_pass.py``).
+
+TPU notes: the quant-dequant round trips stay in fp32/bf16 (XLA fuses
+them into the surrounding ops), and gradients use the straight-through
+estimator via ``jax.custom_vjp`` — pass-through inside the clip range,
+zero outside, matching the reference's FakeQuantGradFunctor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quant_max", "fake_quant", "fake_quant_abs_max",
+           "fake_channel_wise_quant_abs_max",
+           "moving_average_abs_max_scale"]
+
+
+def quant_max(bits: int = 8) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant_ste(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    s = jnp.maximum(scale, 1e-8)
+    # STE: identity inside [-scale, scale], zero outside (clipped region)
+    mask = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * mask, None, None
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Quantize-dequantize against a given scale (broadcastable)."""
+    return _fake_quant_ste(x, scale, quant_max(bits))
+
+
+def fake_quant_abs_max(x, bits: int = 8):
+    """Dynamic per-tensor abs-max fake quant (fake_quantize_abs_max).
+    Returns (quantized, scale); scale carries no gradient."""
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    return fake_quant(x, scale, bits), scale
+
+
+def fake_channel_wise_quant_abs_max(w, bits: int = 8, axis: int = 0):
+    """Per-output-channel abs-max fake quant
+    (fake_channel_wise_quantize_abs_max; the reference quantizes conv
+    weights along the output-channel axis)."""
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(w), axis=red,
+                                          keepdims=True))
+    return fake_quant(w, scale, bits), jnp.squeeze(scale)
+
+
+def moving_average_abs_max_scale(x, running_scale, momentum: float = 0.9):
+    """EMA of the activation abs-max
+    (fake_quantize_moving_average_abs_max's state update); returns the new
+    running scale (stop-grad)."""
+    now = jnp.max(jnp.abs(jax.lax.stop_gradient(x)))
+    return momentum * running_scale + (1.0 - momentum) * now

@@ -1,0 +1,126 @@
+"""Quantization-aware training: swap layers for fake-quanted wrappers.
+
+Reference: ``fluid/contrib/slim/quantization/quantization_pass.py``
+(QuantizationTransformPass: rewrites the program, inserting fake_quant on
+the inputs/weights of quantizable ops; weight per-channel, activations
+moving-average per-tensor). Here the "pass" is a ``map_modules`` sweep
+swapping ``nn.Linear``/``nn.Conv2D`` for quantized wrappers — module
+surgery instead of graph surgery, same semantics.
+
+Activation scales are running state (like BN statistics): tracked on the
+state tape during training-mode forwards and merged back by the trainer,
+so QAT composes with the existing ``build_train_step`` unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.stateful import map_modules, new_uid, record_state
+from paddle_tpu.quant import functional as QF
+
+__all__ = ["QuantConfig", "QuantedLinear", "QuantedConv2D",
+           "quantize_model"]
+
+
+@dataclass
+class QuantConfig:
+    """Mirrors the knobs of the reference's transform pass."""
+    weight_bits: int = 8
+    activation_bits: int = 8
+    weight_per_channel: bool = True
+    moving_rate: float = 0.9         # activation scale EMA momentum
+    skip_patterns: tuple = ()        # attribute-name substrings to skip
+
+
+class _QuantedBase(Module):
+    _nontrainable = ("act_scale",)
+
+    def _init_quant(self, cfg: QuantConfig):
+        self._uid = new_uid()
+        self.act_scale = jnp.zeros((), jnp.float32)
+        self.weight_bits = cfg.weight_bits
+        self.activation_bits = cfg.activation_bits
+        self.weight_per_channel = cfg.weight_per_channel
+        self.moving_rate = cfg.moving_rate
+
+    def _quant_act(self, x, training: bool):
+        if training:
+            new_scale = QF.moving_average_abs_max_scale(
+                x, jnp.where(self.act_scale > 0, self.act_scale,
+                             jnp.max(jnp.abs(jax.lax.stop_gradient(x)))),
+                self.moving_rate)
+            record_state(self._uid, act_scale=new_scale)
+            return QF.fake_quant(x, new_scale, self.activation_bits)
+        scale = jnp.where(self.act_scale > 0, self.act_scale,
+                          jnp.max(jnp.abs(x)))
+        return QF.fake_quant(x, scale, self.activation_bits)
+
+    def _quant_weight(self, w, channel_axis: int):
+        if self.weight_per_channel:
+            wq, _ = QF.fake_channel_wise_quant_abs_max(
+                w, self.weight_bits, axis=channel_axis)
+        else:
+            wq, _ = QF.fake_quant_abs_max(w, self.weight_bits)
+        return wq
+
+
+class QuantedLinear(_QuantedBase):
+    """Linear with fake-quanted input + weight (weight [in, out]:
+    per-channel scale along the output axis)."""
+
+    def __init__(self, inner: nn.Linear, cfg: QuantConfig):
+        self.weight = inner.weight
+        self.bias = inner.bias
+        self._init_quant(cfg)
+
+    def __call__(self, x, training: bool = False):
+        xq = self._quant_act(x, training)
+        wq = self._quant_weight(self.weight, channel_axis=1)
+        y = xq @ wq
+        return y + self.bias if self.bias is not None else y
+
+
+class QuantedConv2D(_QuantedBase):
+    """Conv2D with fake-quanted input + weight (weight OIHW: per-channel
+    scale along O)."""
+
+    def __init__(self, inner: nn.Conv2D, cfg: QuantConfig):
+        self.weight = inner.weight
+        self.bias = inner.bias
+        self.stride = inner.stride
+        self.padding = inner.padding
+        self.dilation = inner.dilation
+        self.groups = inner.groups
+        self.data_format = inner.data_format
+        self.in_channels = inner.in_channels
+        self.out_channels = inner.out_channels
+        self._init_quant(cfg)
+
+    def __call__(self, x, training: bool = False):
+        from paddle_tpu.nn import functional as F
+
+        xq = self._quant_act(x, training)
+        wq = self._quant_weight(self.weight, channel_axis=0)
+        return F.conv2d(xq, wq, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+def quantize_model(model, config: QuantConfig | None = None):
+    """The QuantizationTransformPass: return a copy of ``model`` with
+    quantizable layers wrapped."""
+    cfg = config or QuantConfig()
+
+    def fn(m):
+        if isinstance(m, nn.Linear):
+            return QuantedLinear(m, cfg)
+        if isinstance(m, nn.Conv2D):
+            return QuantedConv2D(m, cfg)
+        return m
+
+    return map_modules(fn, model)

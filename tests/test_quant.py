@@ -1,0 +1,129 @@
+"""Quantization tests: fake-quant math + STE grads, QAT training,
+PTQ calibration and int8 freeze.
+
+Reference analogues: fake_quantize op tests
+(``tests/unittests/test_fake_quantize_op.py``) and the slim QAT/PTQ pass
+tests (``fluid/contrib/slim/tests``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn, quant
+from paddle_tpu.quant import QuantConfig
+
+
+def test_fake_quant_grid_and_error():
+    x = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+    q, scale = quant.fake_quant_abs_max(x, bits=8)
+    qmax = quant.quant_max(8)
+    # values land on the quant grid
+    grid = np.round(np.asarray(q) / float(scale) * qmax)
+    np.testing.assert_allclose(np.asarray(q), grid * float(scale) / qmax,
+                               atol=1e-6)
+    # error bounded by half a step
+    assert float(jnp.max(jnp.abs(q - x))) <= float(scale) / qmax / 2 + 1e-6
+
+
+def test_fake_quant_ste_gradient():
+    scale = jnp.asarray(1.0)
+    g = jax.grad(lambda x: jnp.sum(quant.fake_quant(x, scale)))(
+        jnp.asarray([0.3, -0.7, 1.5, -2.0]))
+    np.testing.assert_array_equal(np.asarray(g), [1.0, 1.0, 0.0, 0.0])
+
+
+def test_channel_wise_beats_per_tensor_on_skewed_weights():
+    rs = np.random.RandomState(1)
+    w = rs.randn(16, 8).astype(np.float32)
+    w[:, 0] *= 100.0  # one loud channel ruins a per-tensor scale
+    w = jnp.asarray(w)
+    q_pc, _ = quant.fake_channel_wise_quant_abs_max(w, axis=1)
+    q_pt, _ = quant.fake_quant_abs_max(w)
+    err_pc = float(jnp.mean((q_pc - w)[:, 1:] ** 2))
+    err_pt = float(jnp.mean((q_pt - w)[:, 1:] ** 2))
+    assert err_pc < err_pt / 10
+
+
+def test_quantize_model_swaps_layers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    qm = quant.quantize_model(m)
+    assert isinstance(qm.layers[0], quant.QuantedLinear)
+    assert isinstance(qm.layers[1], nn.ReLU)
+    assert isinstance(qm.layers[2], quant.QuantedLinear)
+    # weights carried over
+    np.testing.assert_array_equal(np.asarray(qm.layers[0].weight),
+                                  np.asarray(m.layers[0].weight))
+
+
+def test_qat_trains_and_tracks_act_scale():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.parallel import mesh as M
+
+    paddle_tpu.seed(0)
+    model = quant.quantize_model(
+        nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 1)))
+    mesh = M.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(32, 6).astype(np.float32) * 3.0)
+    y = jnp.asarray((x[:, :1] > 0).astype(np.float32))
+
+    def loss_fn(m, batch, training=True):
+        return jnp.mean((m(batch["x"], training=training) - batch["y"]) ** 2)
+
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.Adam(1e-2), loss_fn=loss_fn, mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch({"x": x, "y": y})
+        losses = []
+        for i in range(25):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # activation scale buffer was tracked through the state tape and is in
+    # the ballpark of the input abs-max
+    s = float(state.model.layers[0].act_scale)
+    assert 1.0 < s < 30.0, s
+
+
+def test_ptq_calibrate_and_int8_convert():
+    paddle_tpu.seed(3)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    rs = np.random.RandomState(0)
+    batches = [jnp.asarray(rs.randn(16, 8).astype(np.float32))
+               for _ in range(8)]
+
+    qmodel = quant.calibrate(model, batches)
+    s = float(qmodel.layers[0].act_scale)
+    ref_max = max(float(jnp.max(jnp.abs(b))) for b in batches)
+    assert 0.2 * ref_max < s <= ref_max * 1.01, (s, ref_max)
+
+    int8_model = quant.convert_to_int8(qmodel)
+    assert isinstance(int8_model.layers[0], quant.Int8Linear)
+    assert int8_model.layers[0].weight_q.dtype == jnp.int8
+
+    x = batches[0]
+    y_ref = model(x)
+    y_q = jax.jit(lambda m, v: m(v))(int8_model, x)
+    # int8 path tracks the float model within quantization noise
+    rel = float(jnp.linalg.norm(y_q - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 0.1, rel
+
+    sd = quant.int8_state_dict(int8_model)
+    assert any(v.dtype == np.int8 for v in sd.values())
+
+
+def test_int8_dot_general_runs_int32_accum():
+    """The frozen path must issue an integer dot (MXU int8), not a float
+    simulation."""
+    lin = nn.Linear(16, 8)
+    q = quant.convert_to_int8(quant.calibrate(
+        lin, [jnp.ones((4, 16))], forward=lambda m, b: m(b, training=True)
+        if hasattr(m, "act_scale") else m(b)))
+    hlo = jax.jit(lambda m, x: m(x)).lower(
+        q, jnp.ones((4, 16))).as_text()
+    assert "i8" in hlo and "i32" in hlo, hlo[:500]
