@@ -1,0 +1,16 @@
+"""paddle_tpu.text — NLP datasets + vocab utilities.
+
+Reference: ``python/paddle/text/`` (datasets: imdb, imikolov,
+uci_housing, wmt14/16, movielens, conll05). Downloads are replaced by
+local ``data_file`` paths (zero-egress) and a synthetic
+``RandomTextDataset`` for smoke runs.
+"""
+
+from paddle_tpu.text.datasets import (
+    Conll05st, Imdb, Imikolov, MovieLens, RandomTextDataset, UCIHousing,
+    WMT14,
+)
+from paddle_tpu.text.vocab import Vocab, simple_tokenize
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "MovieLens",
+           "Conll05st", "RandomTextDataset", "Vocab", "simple_tokenize"]

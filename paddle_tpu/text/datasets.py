@@ -1,0 +1,355 @@
+"""Text/NLP datasets (the ``paddle.text.datasets`` surface).
+
+Reference: ``python/paddle/text/datasets/`` — imdb, imikolov,
+uci_housing, wmt14/wmt16, movielens, conll05. Same formats and field
+semantics; the downloaders are gone (zero-egress environment — every
+dataset takes a local ``data_file``), and ``RandomTextDataset`` covers
+smoke-training the way ``vision.RandomImageDataset`` does for images.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import tarfile
+from collections import Counter
+
+import numpy as np
+
+from paddle_tpu.data.dataset import Dataset
+from paddle_tpu.text.vocab import Vocab, simple_tokenize
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "MovieLens",
+           "Conll05st", "RandomTextDataset"]
+
+
+def _require_file(path, name):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{name} needs a local data_file (no download in this "
+            f"zero-egress environment); got {path!r}")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference ``text/datasets/imdb.py``): aclImdb tar
+    with ``{train,test}/{pos,neg}/*.txt`` docs; word dict built from the
+    train split with a frequency ``cutoff``; samples are (ids, label)."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 cutoff: int = 150):
+        _require_file(data_file, "Imdb")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode {mode!r}")
+        self.mode = mode
+        docs_by_split: dict[str, list[tuple[list[str], int]]] = {
+            "train": [], "test": []}
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", errors="ignore")
+                label = 0 if m.group(2) == "pos" else 1  # reference: pos=0
+                docs_by_split[m.group(1)].append(
+                    (simple_tokenize(text), label))
+        # dict always from train (reference builds word_idx on train files)
+        self.word_idx = Vocab.build(
+            (toks for toks, _ in docs_by_split["train"]), cutoff=cutoff,
+            unk_token="<unk>")
+        self.docs = [np.array(self.word_idx.encode(toks), np.int64)
+                     for toks, _ in docs_by_split[mode]]
+        self.labels = np.array([lab for _, lab in docs_by_split[mode]],
+                               np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language modeling (reference ``imikolov.py``): NGRAM mode
+    yields window_size-grams, SEQ mode yields (src, trg) shifted
+    sequences with <s>/<e> markers."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 data_type: str = "NGRAM", window_size: int = -1,
+                 min_word_freq: int = 50):
+        _require_file(data_file, "Imikolov")
+        if data_type == "NGRAM" and window_size < 2:
+            raise ValueError("NGRAM mode needs window_size >= 2")
+        name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        lines_by_file: dict[str, list[list[str]]] = {}
+        if tarfile.is_tarfile(data_file):
+            with tarfile.open(data_file) as tf:
+                for member in tf.getmembers():
+                    base = os.path.basename(member.name)
+                    if base in ("ptb.train.txt", "ptb.valid.txt"):
+                        raw = tf.extractfile(member).read().decode()
+                        lines_by_file[base] = [ln.split() for ln in
+                                               raw.splitlines() if ln.strip()]
+        else:
+            with open(data_file) as f:
+                lines_by_file[name] = [ln.split() for ln in f
+                                       if ln.strip()]
+        train_lines = lines_by_file.get("ptb.train.txt",
+                                        lines_by_file.get(name, []))
+        self.word_idx = Vocab.build(train_lines, min_freq=min_word_freq,
+                                    unk_token="<unk>", bos_token="<s>",
+                                    eos_token="<e>")
+        self.data = []
+        for toks in lines_by_file.get(name, []):
+            ids = self.word_idx.encode(toks, add_bos=True, add_eos=True)
+            if data_type == "NGRAM":
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(np.array(ids[i - window_size:i],
+                                              np.int64))
+            elif data_type == "SEQ":
+                self.data.append((np.array(ids[:-1], np.int64),
+                                  np.array(ids[1:], np.int64)))
+            else:
+                raise ValueError(f"data_type {data_type!r}")
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference ``uci_housing.py``): 14
+    whitespace-separated columns; features normalized by
+    (x - mean) / (max - min); 80/20 train/test split."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file: str, mode: str = "train"):
+        _require_file(data_file, "UCIHousing")
+        data = np.fromfile(data_file, sep=" ")
+        data = data.reshape(data.shape[0] // self.FEATURE_NUM,
+                            self.FEATURE_NUM)
+        maxi, mini = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(self.FEATURE_NUM - 1):
+            rng = maxi[i] - mini[i]
+            data[:, i] = (data[:, i] - avgs[i]) / (rng if rng else 1.0)
+        offset = int(data.shape[0] * 0.8)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(np.float32),
+                row[-1:].astype(np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """EN↔FR translation (reference ``wmt14.py``): parallel ``.src`` /
+    ``.trg`` token files + ``.dict`` vocabularies inside a tar; samples
+    are (src_ids, trg_ids_with_bos, trg_ids_with_eos)."""
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 dict_size: int = 30000):
+        _require_file(data_file, "WMT14")
+        src_lines, trg_lines = [], []
+        src_dict = trg_dict = None
+        want = {"train": "train", "test": "test", "gen": "gen"}[mode]
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                read = lambda: tf.extractfile(member).read().decode()
+                if base == f"{want}.src":
+                    src_lines = [ln.split() for ln in read().splitlines()]
+                elif base == f"{want}.trg":
+                    trg_lines = [ln.split() for ln in read().splitlines()]
+                elif base == "src.dict":
+                    src_dict = read().split()[:dict_size]
+                elif base == "trg.dict":
+                    trg_dict = read().split()[:dict_size]
+        if src_dict is None or trg_dict is None:
+            # dicts built from the data when the tar ships none
+            src_dict = sorted({t for ln in src_lines for t in ln})
+            trg_dict = sorted({t for ln in trg_lines for t in ln})
+        self.src_vocab = Vocab(src_dict, unk_token=self.UNK,
+                               bos_token=self.BOS, eos_token=self.EOS)
+        self.trg_vocab = Vocab(trg_dict, unk_token=self.UNK,
+                               bos_token=self.BOS, eos_token=self.EOS)
+        self.data = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = np.array(self.src_vocab.encode(s), np.int64)
+            tid = self.trg_vocab.encode(t)
+            bos = self.trg_vocab.stoi[self.BOS]
+            eos = self.trg_vocab.stoi[self.EOS]
+            self.data.append((sid, np.array([bos] + tid, np.int64),
+                              np.array(tid + [eos], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieLens(Dataset):
+    """MovieLens-1M ratings (reference ``movielens.py``): ``::``-separated
+    movies/users/ratings files in a directory or tar; samples are
+    (user_id, gender, age, job, movie_id, category_ids, title_ids,
+    rating)."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0):
+        _require_file(data_file, "MovieLens")
+        raw = {}
+        names = ("movies.dat", "users.dat", "ratings.dat")
+        if os.path.isdir(data_file):
+            for n in names:
+                with open(os.path.join(data_file, n), encoding="latin1") as f:
+                    raw[n] = f.read()
+        else:
+            with tarfile.open(data_file) as tf:
+                for member in tf.getmembers():
+                    base = os.path.basename(member.name)
+                    if base in names:
+                        raw[base] = tf.extractfile(member).read().decode(
+                            "latin1")
+
+        cat_vocab: dict[str, int] = {}
+        title_vocab: dict[str, int] = {}
+        self.movies = {}
+        for line in raw["movies.dat"].splitlines():
+            if not line.strip():
+                continue
+            mid, title, cats = line.strip().split("::")
+            cat_ids = [cat_vocab.setdefault(c, len(cat_vocab))
+                       for c in cats.split("|")]
+            tit_ids = [title_vocab.setdefault(w, len(title_vocab))
+                       for w in simple_tokenize(title)]
+            self.movies[int(mid)] = (np.array(cat_ids, np.int64),
+                                     np.array(tit_ids, np.int64))
+        self.users = {}
+        for line in raw["users.dat"].splitlines():
+            if not line.strip():
+                continue
+            uid, gender, age, job, _ = line.strip().split("::")
+            self.users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                    int(job))
+        ratings = []
+        for line in raw["ratings.dat"].splitlines():
+            if not line.strip():
+                continue
+            uid, mid, rating, _ = line.strip().split("::")
+            ratings.append((int(uid), int(mid), float(rating)))
+        rs = np.random.RandomState(rand_seed)
+        test_mask = rs.rand(len(ratings)) < test_ratio
+        self.data = [r for r, t in zip(ratings, test_mask)
+                     if (mode == "test") == bool(t)]
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.data[idx]
+        gender, age, job = self.users[uid]
+        cats, title = self.movies[mid]
+        return (np.int64(uid), np.int64(gender), np.int64(age),
+                np.int64(job), np.int64(mid), cats, title,
+                np.float32(rating))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference ``conll05.py``): parallel words/props
+    files; each sample is (word_ids, predicate_id, label_ids) — the
+    sequence-labeling fields the reference emits, minus the 5 context
+    windows (derivable from word_ids; the reference precomputes them for
+    its fixed LSTM-SRL demo)."""
+
+    def __init__(self, words_file: str, props_file: str,
+                 word_vocab: Vocab | None = None,
+                 label_vocab: Vocab | None = None):
+        _require_file(words_file, "Conll05st")
+        _require_file(props_file, "Conll05st")
+        sentences = self._read_blocks(words_file)
+        props = self._read_blocks(props_file)
+        samples = []
+        for sent, prop in zip(sentences, props):
+            words = [cols[0] for cols in sent]
+            preds = [cols[0] for cols in prop]
+            n_frames = len(prop[0]) - 1
+            for f in range(n_frames):
+                tags = self._spans_to_iob([cols[1 + f] for cols in prop])
+                pred_idx = next(i for i, p in enumerate(preds)
+                                if p != "-" and tags[i].endswith("-V"))
+                samples.append((words, pred_idx, tags))
+        self.word_vocab = word_vocab or Vocab.build(
+            (w for w, _, _ in samples), unk_token="<unk>")
+        self.label_vocab = label_vocab or Vocab.build(
+            (t for _, _, t in samples), unk_token=None)
+        self.data = [
+            (np.array(self.word_vocab.encode(w), np.int64),
+             np.int64(p),
+             np.array([self.label_vocab[t] for t in tags], np.int64))
+            for w, p, tags in samples]
+
+    @staticmethod
+    def _read_blocks(path):
+        blocks, cur = [], []
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    cur.append(line.split())
+                elif cur:
+                    blocks.append(cur)
+                    cur = []
+        if cur:
+            blocks.append(cur)
+        return blocks
+
+    @staticmethod
+    def _spans_to_iob(col):
+        """CoNLL prop spans '(A0*' '*' '*)' → IOB-ish tags."""
+        tags, current = [], None
+        for tok in col:
+            m = re.match(r"\(([^*()]+)", tok)
+            if m:
+                current = m.group(1)
+                tags.append(f"B-{current}")
+            elif current is not None:
+                tags.append(f"I-{current}")
+            else:
+                tags.append("O")
+            if ")" in tok:
+                current = None
+        return tags
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class RandomTextDataset(Dataset):
+    """Deterministic synthetic token sequences for tests/smoke LM
+    training (the text counterpart of vision.RandomImageDataset)."""
+
+    def __init__(self, num_samples: int = 256, seq_len: int = 64,
+                 vocab_size: int = 1000, seed: int = 0):
+        rs = np.random.RandomState(seed)
+        self.ids = rs.randint(0, vocab_size, (num_samples, seq_len)).astype(
+            np.int64)
+        self.vocab_size = vocab_size
+
+    def __getitem__(self, idx):
+        return self.ids[idx]
+
+    def __len__(self):
+        return len(self.ids)

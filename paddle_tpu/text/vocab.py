@@ -1,0 +1,84 @@
+"""Vocabulary + tokenization utilities.
+
+Reference: the word-dict builders embedded in each text dataset
+(``python/paddle/text/datasets/imdb.py`` word_idx built from frequency
+with a cutoff, ``imikolov.py`` build_dict with min_word_freq) — factored
+here into one reusable ``Vocab`` so every dataset shares the same
+encode/decode behavior.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Iterable, Sequence
+
+__all__ = ["Vocab", "simple_tokenize"]
+
+_WORD_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def simple_tokenize(text: str) -> list[str]:
+    """Lowercase word tokenizer (the imdb ``tokenize`` analogue)."""
+    return _WORD_RE.findall(text.lower())
+
+
+class Vocab:
+    def __init__(self, tokens: Sequence[str], *, unk_token: str | None = "<unk>",
+                 pad_token: str | None = None, bos_token: str | None = None,
+                 eos_token: str | None = None):
+        specials = [t for t in (pad_token, unk_token, bos_token, eos_token)
+                    if t is not None]
+        self.itos: list[str] = list(dict.fromkeys(specials + list(tokens)))
+        self.stoi: dict[str, int] = {t: i for i, t in enumerate(self.itos)}
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+
+    @classmethod
+    def build(cls, corpus: Iterable[Sequence[str]], *, min_freq: int = 1,
+              max_size: int | None = None, cutoff: int | None = None,
+              **special_kw) -> "Vocab":
+        """Frequency-sorted vocab. ``cutoff`` keeps tokens with freq >
+        cutoff (imdb semantics); ``min_freq`` keeps freq >= min_freq
+        (imikolov semantics)."""
+        counter: Counter = Counter()
+        for toks in corpus:
+            counter.update(toks)
+        if cutoff is not None:
+            items = [(t, c) for t, c in counter.items() if c > cutoff]
+        else:
+            items = [(t, c) for t, c in counter.items() if c >= min_freq]
+        # deterministic: by (-freq, token), the reference's sort order
+        items.sort(key=lambda kv: (-kv[1], kv[0]))
+        if max_size is not None:
+            items = items[:max_size]
+        return cls([t for t, _ in items], **special_kw)
+
+    def __len__(self) -> int:
+        return len(self.itos)
+
+    def __contains__(self, token: str) -> bool:
+        return token in self.stoi
+
+    def __getitem__(self, token: str) -> int:
+        idx = self.stoi.get(token)
+        if idx is None:
+            if self.unk_token is None:
+                raise KeyError(token)
+            return self.stoi[self.unk_token]
+        return idx
+
+    def encode(self, tokens: Sequence[str], *, add_bos: bool = False,
+               add_eos: bool = False) -> list[int]:
+        out = []
+        if add_bos:
+            out.append(self.stoi[self.bos_token])
+        out.extend(self[t] for t in tokens)
+        if add_eos:
+            out.append(self.stoi[self.eos_token])
+        return out
+
+    def decode(self, ids: Sequence[int]) -> list[str]:
+        return [self.itos[i] for i in ids]

@@ -1,0 +1,169 @@
+"""Text dataset tests against miniature fixtures in the real on-disk
+formats (aclImdb tar, PTB lines, UCI whitespace table, WMT parallel
+files, MovieLens ::-separated, CoNLL prop spans)."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (
+    Conll05st, Imdb, Imikolov, MovieLens, RandomTextDataset, UCIHousing,
+    Vocab, WMT14, simple_tokenize,
+)
+
+
+# ---------------------------------------------------------------------------
+# vocab
+# ---------------------------------------------------------------------------
+
+def test_vocab_build_and_roundtrip():
+    corpus = [["the", "cat", "sat"], ["the", "dog"], ["the", "cat"]]
+    v = Vocab.build(corpus, min_freq=2, unk_token="<unk>")
+    assert v["the"] != v["cat"]
+    assert "dog" not in v                       # freq 1 < min_freq
+    assert v["dog"] == v["<unk>"]
+    ids = v.encode(["the", "cat", "zzz"])
+    assert v.decode(ids)[:2] == ["the", "cat"]
+
+
+def test_vocab_cutoff_and_determinism():
+    corpus = [["a"] * 5 + ["b"] * 3 + ["c"]]
+    v = Vocab.build(corpus, cutoff=2, unk_token="<unk>")
+    assert "a" in v and "b" in v and "c" not in v
+    v2 = Vocab.build(corpus, cutoff=2, unk_token="<unk>")
+    assert v.itos == v2.itos
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _add_text(tf, name, text):
+    data = text.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def imdb_tar(tmp_path):
+    path = tmp_path / "aclImdb.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0.txt": "a great movie great fun",
+            "aclImdb/train/pos/1.txt": "great acting and great story",
+            "aclImdb/train/neg/0.txt": "terrible movie boring plot",
+            "aclImdb/train/neg/1.txt": "boring and terrible",
+            "aclImdb/test/pos/0.txt": "great story",
+            "aclImdb/test/neg/0.txt": "boring movie",
+        }
+        for name, text in docs.items():
+            _add_text(tf, name, text)
+    return str(path)
+
+
+def test_imdb(imdb_tar):
+    train = Imdb(imdb_tar, mode="train", cutoff=1)
+    assert len(train) == 4
+    ids, label = train[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    # pos docs labeled 0 (reference convention), neg 1
+    labels = sorted(int(train[i][1]) for i in range(4))
+    assert labels == [0, 0, 1, 1]
+    test = Imdb(imdb_tar, mode="test", cutoff=1)
+    assert len(test) == 2
+    # dict built on train in both modes: same vocab size
+    assert len(test.word_idx) == len(train.word_idx)
+
+
+def test_imikolov(tmp_path):
+    f = tmp_path / "ptb.train.txt"
+    f.write_text("the cat sat on the mat\nthe dog sat\n")
+    ds = Imikolov(str(f), data_type="NGRAM", window_size=3, min_word_freq=1)
+    first = ds[0]
+    assert first.shape == (3,)
+    assert ds.word_idx.decode([int(first[0])]) == ["<s>"]
+    seq = Imikolov(str(f), data_type="SEQ", window_size=-1, min_word_freq=1)
+    src, trg = seq[0]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+    assert len(seq) == 2
+
+
+def test_uci_housing(tmp_path):
+    rs = np.random.RandomState(0)
+    table = rs.rand(50, 14) * 10
+    f = tmp_path / "housing.data"
+    f.write_text("\n".join(" ".join(f"{v:.4f}" for v in row)
+                           for row in table))
+    train = UCIHousing(str(f), mode="train")
+    test = UCIHousing(str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # normalized features are centered-ish
+    allx = np.stack([train[i][0] for i in range(len(train))])
+    assert np.abs(allx.mean(axis=0)).max() < 0.6
+
+
+def test_wmt14(tmp_path):
+    path = tmp_path / "wmt14.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_text(tf, "wmt14/train/train.src", "le chat\nle chien\n")
+        _add_text(tf, "wmt14/train/train.trg", "the cat\nthe dog\n")
+        _add_text(tf, "wmt14/src.dict", "le\nchat\nchien\n")
+        _add_text(tf, "wmt14/trg.dict", "the\ncat\ndog\n")
+    ds = WMT14(str(path), mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert ds.src_vocab.decode(src.tolist()) == ["le", "chat"]
+    assert ds.trg_vocab.decode([int(trg_in[0])]) == ["<s>"]
+    assert ds.trg_vocab.decode([int(trg_out[-1])]) == ["<e>"]
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+
+def test_movielens(tmp_path):
+    d = tmp_path / "ml"
+    d.mkdir()
+    (d / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Comedy\n"
+        "2::Heat (1995)::Action|Crime\n")
+    (d / "users.dat").write_text(
+        "1::M::25::4::90210\n2::F::35::7::10001\n")
+    (d / "ratings.dat").write_text(
+        "1::1::5::964982703\n1::2::3::964982931\n"
+        "2::1::4::964982224\n2::2::2::964981247\n")
+    ds = MovieLens(str(d), mode="train", test_ratio=0.25, rand_seed=0)
+    ds_test = MovieLens(str(d), mode="test", test_ratio=0.25, rand_seed=0)
+    assert len(ds) + len(ds_test) == 4
+    uid, gender, age, job, mid, cats, title, rating = ds[0]
+    assert gender in (0, 1) and 1 <= rating <= 5
+    assert cats.dtype == np.int64 and title.dtype == np.int64
+
+
+def test_conll05(tmp_path):
+    words = tmp_path / "words.txt"
+    props = tmp_path / "props.txt"
+    words.write_text("The\ncat\nsat\n\nDogs\nbark\n\n")
+    props.write_text(
+        "-\t(A0*\n-\t*)\nsat\t(V*)\n\n-\t(A0*)\nbark\t(V*)\n\n")
+    ds = Conll05st(str(words), str(props))
+    assert len(ds) == 2
+    word_ids, pred_idx, label_ids = ds[0]
+    assert word_ids.shape == (3,) and label_ids.shape == (3,)
+    assert int(pred_idx) == 2
+    tags = [ds.label_vocab.itos[i] for i in label_ids]
+    assert tags == ["B-A0", "I-A0", "B-V"]
+
+
+def test_random_text_dataset_with_loader():
+    from paddle_tpu.data import DataLoader
+
+    ds = RandomTextDataset(num_samples=32, seq_len=16, vocab_size=50)
+    dl = DataLoader(ds, batch_size=8, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0].shape == (8, 16)
+    assert (batches[0] < 50).all()
