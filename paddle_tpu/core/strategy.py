@@ -23,7 +23,7 @@ from typing import Any
 __all__ = ["DistributedStrategy", "ShardingConfig", "PipelineConfig",
            "AMPConfig", "RecomputeConfig", "GradientMergeConfig",
            "LocalSGDConfig", "Fp16AllreduceConfig", "TensorParallelConfig",
-           "SequenceParallelConfig"]
+           "SequenceParallelConfig", "ExpertParallelConfig"]
 
 
 @dataclass
@@ -116,6 +116,16 @@ class TensorParallelConfig:
 
 
 @dataclass
+class ExpertParallelConfig:
+    """MoE expert parallelism over the ``ep`` mesh axis (new capability —
+    absent in the reference snapshot, SURVEY.md §2.3.8): stacked expert
+    weights sharded ``P("ep", ...)``; the token all_to_all is derived by
+    the XLA partitioner from sharding constraints (see ``nn/moe.py``)."""
+    enable: bool = False
+    degree: int = 1
+
+
+@dataclass
 class SequenceParallelConfig:
     """Long-context strategies over the ``sp`` mesh axis: ring attention
     (shard_map + ppermute) or Ulysses (all_to_all). New capability, see
@@ -140,6 +150,7 @@ class DistributedStrategy:
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = field(default_factory=SequenceParallelConfig)
+    expert_parallel: ExpertParallelConfig = field(default_factory=ExpertParallelConfig)
     dp_degree: int = 0               # 0 = infer from devices / other degrees
 
     # The reference's fuse_grad_size_in_MB / hierarchical-allreduce knobs
@@ -155,6 +166,7 @@ class DistributedStrategy:
             "tp": self.tensor_parallel.degree if self.tensor_parallel.enable else 1,
             "pp": self.pipeline.degree if self.pipeline.enable else 1,
             "sp": self.sequence_parallel.degree if self.sequence_parallel.enable else 1,
+            "ep": self.expert_parallel.degree if self.expert_parallel.enable else 1,
         }
 
     def total_parallel_size(self) -> int:
@@ -183,7 +195,7 @@ class DistributedStrategy:
             if dataclasses.is_dataclass(f.type) or f.name in (
                 "amp", "recompute", "gradient_merge", "localsgd", "sharding",
                 "pipeline", "tensor_parallel", "sequence_parallel",
-                "fp16_allreduce",
+                "fp16_allreduce", "expert_parallel",
             ):
                 sub = {
                     "amp": AMPConfig, "recompute": RecomputeConfig,
@@ -193,6 +205,7 @@ class DistributedStrategy:
                     "tensor_parallel": TensorParallelConfig,
                     "sequence_parallel": SequenceParallelConfig,
                     "fp16_allreduce": Fp16AllreduceConfig,
+                    "expert_parallel": ExpertParallelConfig,
                 }[f.name]
                 sub_kwargs = dict(v)
                 for sf in dataclasses.fields(sub):

@@ -10,3 +10,4 @@ from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 from paddle_tpu.models.mamba import MambaConfig, MambaForCausalLM
 from paddle_tpu.models.mlp import MLP, MNISTClassifier
+from paddle_tpu.models.moe import MoEConfig, MoEForCausalLM

@@ -1,0 +1,132 @@
+"""Mixtral-style MoE decoder: Llama attention + top-k routed expert MLPs.
+
+New capability beyond the reference snapshot (no MoE upstream —
+SURVEY.md §2.3.8); included because expert parallelism is a first-class
+mesh axis of this framework (``ep``; see ``nn/moe.py`` for the
+dispatch/all_to_all design and ``core/strategy.py`` ExpertParallelConfig).
+
+Layers are a python loop rather than scan-stacked: each block's aux
+(load-balancing) loss joins the training loss, and the small layer count
+of MoE configs (compute lives in width, not depth) keeps compile time
+fine without scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Embedding, Linear
+from paddle_tpu.nn.initializer import Normal
+from paddle_tpu.nn.moe import MoEMLP
+from paddle_tpu.nn.norm import RMSNorm
+from paddle_tpu.models.llama import LlamaAttention
+
+__all__ = ["MoEConfig", "MoEForCausalLM"]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 8
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    max_seq_len: int = 4096
+    rope_base: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    init_std: float = 0.02
+    # MoE knobs (Mixtral 8x7B defaults)
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    # kept for LlamaAttention compatibility
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    max_seq_len=64, dtype="float32", num_experts=4,
+                    top_k=2)
+        base.update(kw)
+        return cls(**base)
+
+    def num_params(self) -> int:
+        E, H, I_ = self.num_experts, self.hidden_size, self.intermediate_size
+        per_layer = (4 * H * H * self.num_kv_heads // self.num_heads
+                     + 2 * H * H + E * 3 * H * I_ + H * E + 2 * H)
+        return (self.vocab_size * H * 2 + self.num_layers * per_layer + H)
+
+
+class MoEBlock(Module):
+    def __init__(self, cfg: MoEConfig, key=None):
+        k1, k2 = rng.split_key(key)
+        dtype = jnp.dtype(cfg.dtype)
+        self.attn_norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps,
+                                 dtype=dtype)
+        self.attn = LlamaAttention(cfg, key=k1)
+        self.mlp_norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps,
+                                dtype=dtype)
+        self.moe = MoEMLP(cfg.hidden_size, cfg.intermediate_size,
+                          cfg.num_experts, top_k=cfg.top_k,
+                          capacity_factor=cfg.capacity_factor,
+                          init_std=cfg.init_std,
+                          num_layers=cfg.num_layers, dtype=dtype, key=k2)
+
+    def __call__(self, x, training: bool = False):
+        x = x + self.attn(self.attn_norm(x), training=training)
+        mlp_out, aux = self.moe(self.mlp_norm(x))
+        return x + mlp_out, aux
+
+
+class MoEForCausalLM(Module):
+    """Decoder-only MoE causal LM; ``loss`` folds the load-balancing aux
+    term in with ``aux_loss_weight``."""
+
+    def __init__(self, cfg: MoEConfig, key=None):
+        keys = rng.split_key(key, 2 + cfg.num_layers)
+        dtype = jnp.dtype(cfg.dtype)
+        self.embed = Embedding(cfg.vocab_size, cfg.hidden_size,
+                               weight_init=Normal(0.0, cfg.init_std),
+                               dtype=dtype, key=keys[0],
+                               pspec=P("tp", "fsdp"))
+        self.blocks = tuple(
+            MoEBlock(cfg, key=keys[2 + i]) for i in range(cfg.num_layers))
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps,
+                            dtype=dtype)
+        self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias=False,
+                              weight_init=Normal(0.0, cfg.init_std),
+                              dtype=dtype, key=keys[1],
+                              pspec=P("fsdp", "tp"))
+        self.config = cfg
+
+    def forward_with_aux(self, input_ids, training: bool = False):
+        x = self.embed(input_ids)
+        aux_total = jnp.zeros((), jnp.float32)
+        for block in self.blocks:
+            x, aux = block(x, training=training)
+            aux_total = aux_total + aux
+        logits = self.lm_head(self.norm(x))
+        return logits, aux_total / max(len(self.blocks), 1)
+
+    def __call__(self, input_ids, training: bool = False):
+        return self.forward_with_aux(input_ids, training)[0]
+
+    def loss(self, input_ids, labels, ignore_index: int = -100,
+             training: bool = True):
+        logits, aux = self.forward_with_aux(input_ids, training=training)
+        ce = F.cross_entropy(
+            logits[:, :-1].astype(jnp.float32), labels[:, 1:],
+            ignore_index=ignore_index, reduction="mean")
+        return ce + self.config.aux_loss_weight * aux

@@ -1,0 +1,163 @@
+"""Mixture-of-experts with expert parallelism over the ``ep`` mesh axis.
+
+New capability beyond the reference snapshot (SURVEY.md §2.3.8 lists
+MoE/expert parallelism as absent upstream), built on the same mesh
+substrate as the other strategies.
+
+TPU-native design — GShard-style dense dispatch, not gather/scatter:
+token→expert routing is expressed as two einsums against a one-hot
+dispatch tensor, so every shape is static (XLA requirement) and the
+dispatch/combine contractions lower onto the MXU. Experts are stacked
+weights with a leading expert axis sharded ``P("ep", ...)``; a sharding
+constraint on the ``[E, C, H]`` expert buffers makes XLA insert the
+token all_to_all over ``ep`` — the hand-written NCCL AllToAll of
+GPU MoE frameworks, derived by the partitioner instead.
+
+Load-balancing auxiliary loss follows Switch/GShard:
+``aux = E * sum_e(frac_tokens_e * mean_gate_e)``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.initializer import Normal
+
+__all__ = ["MoEMLP", "top_k_routing"]
+
+
+def _constrain(x, spec: P):
+    """Apply a sharding constraint against the ambient mesh, if one is
+    set and carries the named axes (no-op otherwise — single-chip runs
+    and unit tests don't build a mesh)."""
+    from jax.sharding import NamedSharding
+    from paddle_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    if any(ax not in mesh.shape for axes in spec if axes
+           for ax in (axes if isinstance(axes, tuple) else (axes,))):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def top_k_routing(logits, k: int, capacity: int):
+    """Route tokens to top-k experts under a per-expert capacity.
+
+    Args:
+      logits: [N, E] router scores.
+      k: experts per token.
+      capacity: max tokens an expert accepts (overflow tokens drop —
+        Switch-transformer semantics; the residual path carries them).
+
+    Returns:
+      dispatch: [N, E, C] one-hot dispatch tensor.
+      combine:  [N, E, C] gate-weighted combine tensor.
+      aux_loss: scalar load-balancing loss.
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gates = jnp.zeros_like(probs)
+    masked = probs
+    dispatch = jnp.zeros((n, e, capacity), probs.dtype)
+    combine = jnp.zeros((n, e, capacity), probs.dtype)
+    # claimed[e] tracking via cumulative one-hot counts across the k picks
+    prior = jnp.zeros((n, e), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                     # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # [N, E]
+        # position of each token within its chosen expert's buffer:
+        # tokens earlier in the batch claim earlier slots (cumsum), plus
+        # slots already used by previous routing rounds
+        pos = (jnp.cumsum(onehot, axis=0) - 1) + prior.sum(0)  # [N, E]
+        prior = prior + onehot
+        pos_t = jnp.sum(pos * onehot, axis=-1)                # [N]
+        keep = pos_t < capacity
+        gate = jnp.sum(probs * onehot, axis=-1) * keep        # [N]
+        oh_pos = jax.nn.one_hot(pos_t, capacity,
+                                dtype=probs.dtype)            # [N, C]
+        d = (onehot.astype(probs.dtype)[:, :, None]
+             * oh_pos[:, None, :] * keep[:, None, None])
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        gates = gates + probs * onehot
+        masked = masked * (1 - onehot)
+
+    # Switch aux loss: fraction of tokens per expert × mean router prob
+    frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(Module):
+    """Top-k routed SwiGLU expert MLPs (drop-in for a dense LlamaMLP).
+
+    ``__call__`` returns ``(out, aux_loss)`` — the caller folds the aux
+    loss (scaled by ``aux_weight``) into the training loss.
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, *, top_k: int = 2,
+                 capacity_factor: float = 1.25, init_std: float = 0.02,
+                 num_layers: int = 1, dtype=jnp.float32, key=None):
+        keys = rng.split_key(key, 4)
+        E, H, I_ = num_experts, hidden_size, intermediate_size
+        init = Normal(0.0, init_std)
+        down_init = Normal(0.0, init_std / math.sqrt(2 * num_layers))
+        # router replicated (tiny); experts stacked on a leading ep axis
+        self.router = init(keys[0], (H, E), jnp.float32)
+        self.w_gate = init(keys[1], (E, H, I_), dtype)
+        self.w_up = init(keys[2], (E, H, I_), dtype)
+        self.w_down = down_init(keys[3], (E, I_, H), dtype)
+        self._pspecs = (
+            ("router", P()),
+            ("w_gate", P("ep", "fsdp", "tp")),
+            ("w_up", P("ep", "fsdp", "tp")),
+            ("w_down", P("ep", "tp", "fsdp")),
+        )
+        self.num_experts = E
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+
+    def capacity(self, n_tokens: int) -> int:
+        c = int(math.ceil(n_tokens * self.top_k * self.capacity_factor
+                          / self.num_experts))
+        return max(c, self.top_k)
+
+    def __call__(self, x):
+        b, t, h = x.shape
+        n = b * t
+        tokens = x.reshape(n, h)
+        cap = self.capacity(n)
+
+        # router in fp32 for stable softmax (standard MoE practice)
+        logits = tokens.astype(jnp.float32) @ self.router
+        dispatch, combine, aux = top_k_routing(logits, self.top_k, cap)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+
+        # dispatch: [N,H] x [N,E,C] -> [E,C,H]; the sharding constraint
+        # makes the XLA partitioner materialize the ep all_to_all here
+        expert_in = jnp.einsum("nh,nec->ech", tokens, dispatch)
+        expert_in = _constrain(expert_in, P("ep", None, None))
+
+        gate = jnp.einsum("ech,ehi->eci", expert_in, self.w_gate)
+        up = jnp.einsum("ech,ehi->eci", expert_in, self.w_up)
+        act = F.swiglu(up, gate)
+        expert_out = jnp.einsum("eci,eih->ech", act, self.w_down)
+        expert_out = _constrain(expert_out, P("ep", None, None))
+
+        # combine (the return all_to_all): [E,C,H] x [N,E,C] -> [N,H]
+        out = jnp.einsum("ech,nec->nh", expert_out, combine)
+        return out.reshape(b, t, h), aux.astype(jnp.float32)

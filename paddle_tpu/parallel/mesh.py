@@ -7,9 +7,11 @@ every collective op): one named mesh, axes = parallelism dimensions.
 
 Axis order encodes ICI locality — the *last* (fastest-varying) axis maps to
 physically adjacent chips, so the bandwidth-hungriest parallelism goes
-last: ``("pp", "dp", "fsdp", "sp", "tp")``. Pipeline crosses the slowest
-links (it only sends activations), tensor parallelism rides the fastest.
-See "How to Scale Your Model" for the mental model.
+last: ``("pp", "dp", "fsdp", "ep", "sp", "tp")``. Pipeline crosses the
+slowest links (it only sends activations), tensor parallelism rides the
+fastest; the expert all_to_all sits between the fsdp gather traffic and
+the sp/tp ring traffic. See "How to Scale Your Model" for the mental
+model.
 """
 
 from __future__ import annotations
@@ -23,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.strategy import DistributedStrategy
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 # data batch is sharded over every data-ish axis (dp + fsdp); fsdp sharding
 # of the batch is what turns parameter sharding into ZeRO-3 semantics
