@@ -1,0 +1,123 @@
+"""Runtime stat registry + host monitors.
+
+Reference: ``paddle/fluid/platform/monitor.h:77,130`` — a global
+``StatRegistry`` of named int64 stats updated through ``STAT_ADD`` macros
+scattered in hot paths (GPU memory stats etc.), exported to Python for
+observability; plus the scope-buffered monitor
+(``framework/details/scope_buffered_monitor.cc``) tracking per-step
+resource deltas.
+
+TPU mapping: device memory is XLA's (``jax.local_devices()[0]
+.memory_stats()`` is the authoritative source, surfaced here); the
+registry tracks host-side counters — steps, tokens, data-pipeline stalls,
+checkpoint writes — and the ``StepTimer`` derives steps/sec and
+tokens/sec the way the reference's benchmark monitors do.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+__all__ = ["StatRegistry", "stats", "stat_add", "stat_set", "get_stat",
+           "export_stats", "reset_stats", "StepTimer", "device_memory_stats",
+           "host_rss_bytes"]
+
+
+class StatRegistry:
+    """Thread-safe named counters (int or float)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: dict[str, float] = {}
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str, default: float = 0) -> float:
+        with self._lock:
+            return self._stats.get(name, default)
+
+    def export(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self, prefix: str | None = None) -> None:
+        with self._lock:
+            if prefix is None:
+                self._stats.clear()
+            else:
+                for k in [k for k in self._stats if k.startswith(prefix)]:
+                    del self._stats[k]
+
+
+stats = StatRegistry()          # the global registry (monitor.h pattern)
+
+
+def stat_add(name: str, value: float = 1) -> None:
+    """STAT_ADD macro analogue."""
+    stats.add(name, value)
+
+
+def stat_set(name: str, value: float) -> None:
+    stats.set(name, value)
+
+
+def get_stat(name: str, default: float = 0) -> float:
+    return stats.get(name, default)
+
+
+def export_stats() -> dict[str, float]:
+    return stats.export()
+
+
+def reset_stats(prefix: str | None = None) -> None:
+    stats.reset(prefix)
+
+
+class StepTimer:
+    """Rolling step timing: records steps/sec (and tokens/sec when a
+    per-step token count is given) into the registry."""
+
+    def __init__(self, name: str = "train", window: int = 20):
+        self.name = name
+        self.window = window
+        self._times: list[float] = []
+
+    def tick(self, tokens: int | None = None) -> None:
+        now = time.perf_counter()
+        self._times.append(now)
+        if len(self._times) > self.window + 1:
+            self._times.pop(0)
+        stat_add(f"{self.name}/steps", 1)
+        if tokens:
+            stat_add(f"{self.name}/tokens", tokens)
+        if len(self._times) >= 2:
+            dt = self._times[-1] - self._times[0]
+            sps = (len(self._times) - 1) / dt if dt > 0 else 0.0
+            stat_set(f"{self.name}/steps_per_sec", sps)
+            if tokens:
+                stat_set(f"{self.name}/tokens_per_sec", sps * tokens)
+
+
+def device_memory_stats(device=None) -> dict[str, Any]:
+    """XLA's per-device memory stats (bytes_in_use, peak_bytes_in_use, …)
+    — the STAT_GPU_MEM role, owned by the runtime not the framework."""
+    import jax
+
+    dev = device or jax.local_devices()[0]
+    return dict(dev.memory_stats() or {})
+
+
+def host_rss_bytes() -> int:
+    """Resident set size of this process (host-side memory monitor)."""
+    import resource
+
+    # ru_maxrss is KiB on Linux
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024

@@ -450,6 +450,8 @@ class CompiledTrainStep:
             # FLAGS_benchmark: synchronize every step so host-side timing
             # brackets real device work (reference operator.cc:1123)
             jax.block_until_ready(new_state)
+        from paddle_tpu.core import monitor
+        monitor.stat_add("fleet/steps", 1)
         return new_state, metrics
 
     def eval_step(self, model, batch, eval_fn):

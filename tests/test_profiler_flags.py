@@ -124,3 +124,49 @@ def test_named_scopes_in_train_step_hlo():
             state, batch, jax.random.PRNGKey(0)).as_text(debug_info=True)
     assert "forward_backward" in lowered
     assert "optimizer_update" in lowered
+
+
+# ---------------------------------------------------------------------------
+# stat registry / monitors (reference platform/monitor.h StatRegistry)
+# ---------------------------------------------------------------------------
+
+def test_stat_registry_counters():
+    from paddle_tpu.core import monitor
+
+    monitor.reset_stats("t/")
+    monitor.stat_add("t/x", 3)
+    monitor.stat_add("t/x", 2)
+    monitor.stat_set("t/y", 7.5)
+    assert monitor.get_stat("t/x") == 5
+    exported = monitor.export_stats()
+    assert exported["t/y"] == 7.5
+    monitor.reset_stats("t/")
+    assert monitor.get_stat("t/x") == 0
+
+
+def test_train_step_increments_fleet_steps():
+    from paddle_tpu.core import monitor
+
+    monitor.reset_stats("fleet/")
+    step, state, batch = _mlp_step()
+    for i in range(3):
+        state, _ = step(state, batch, jax.random.PRNGKey(i))
+    assert monitor.get_stat("fleet/steps") == 3
+
+
+def test_step_timer_and_host_monitors():
+    import time as _time
+
+    from paddle_tpu.core import monitor
+
+    monitor.reset_stats("bench/")
+    t = monitor.StepTimer("bench", window=4)
+    for _ in range(5):
+        t.tick(tokens=128)
+        _time.sleep(0.01)
+    assert monitor.get_stat("bench/steps") == 5
+    assert monitor.get_stat("bench/steps_per_sec") > 0
+    assert monitor.get_stat("bench/tokens_per_sec") > 0
+    assert monitor.host_rss_bytes() > 10 * 1024 * 1024
+    mem = monitor.device_memory_stats()
+    assert isinstance(mem, dict)
