@@ -68,6 +68,18 @@ class LocalSGDConfig:
     begin_step: int = 1
 
 
+# DGC (deep gradient compression, reference
+# ``framework/details/sparse_all_reduce_op_handle.cc`` +
+# ``fluid/optimizer.py:1183``) is a DELIBERATE SKIP on TPU: it exists to
+# cut gradient bytes on slow PCIe/ethernet links by top-k sparsifying
+# before NCCL; TPU gradient reductions ride ICI (orders of magnitude more
+# bandwidth per FLOP), XLA's all-reduce combiner already overlaps them
+# with compute, and a top-k scatter breaks the static-shape/dense-compute
+# model the MXU wants. The comm-reduction ladder here is: bf16-compressed
+# all-reduce (Fp16AllreduceConfig, 2x), gradient merge (fewer syncs), and
+# LocalSGD (k-fold fewer syncs) — same goal, TPU-shaped mechanisms.
+
+
 @dataclass
 class Fp16AllreduceConfig:
     """Compressed gradient all-reduce (reference:

@@ -19,10 +19,17 @@ ppermute transposes into the reverse pipeline automatically (the
 transpose of a ring shift is the opposite shift) — this replaces the
 reference's entire backward-section machinery.
 
-GPipe bubble: S-1 of M+S-1 ticks per direction. 1F1B (reference's
-schedule) shrinks activation memory, not the bubble; with remat enabled
-per-layer the memory profile is already flat, so GPipe is the right
-first schedule on TPU.
+GPipe bubble: S-1 of M+S-1 ticks per direction. In this lockstep-SPMD
+formulation every rank executes every tick (idle ranks compute masked
+garbage) — that's the bubble made explicit, not an extra cost: SPMD
+ranks can't early-exit a shared program. Two real costs of this schedule
+vs ``schedule="1f1b"`` (``pipeline_1f1b.py``): (1) the final
+``C.broadcast`` ships the full [B, T, E] activations to every pp rank so
+the head/loss can run replicated — one ICI hop of activation traffic per
+step; (2) all M microbatch activations stay live through the backward.
+Pick GPipe for simplicity/composability (tp/sp/amp/scaler all compose),
+1F1B when activation memory or the head broadcast dominates — that
+schedule keeps the loss on the last stage and interleaves backward.
 """
 
 from __future__ import annotations
