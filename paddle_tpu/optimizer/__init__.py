@@ -6,7 +6,8 @@ Mirrors ``paddle.optimizer`` (reference ``python/paddle/optimizer/``).
 from paddle_tpu.optimizer import lr
 from paddle_tpu.optimizer import transform
 from paddle_tpu.optimizer.optimizers import (
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Dpsgd,
+    ExponentialMovingAverage, Ftrl, Lamb, LarsMomentum, Momentum,
     Optimizer, RMSProp,
 )
 from paddle_tpu.optimizer.transform import (

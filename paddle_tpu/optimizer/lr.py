@@ -182,3 +182,77 @@ def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
         CosineAnnealingDecay(peak_lr, max(total_steps - warmup_steps, 1),
                              end_lr),
         warmup_steps, start_lr=0.0, end_lr=peak_lr)
+
+
+class ReduceOnPlateau:
+    """Metric-driven LR reduction (reference ``optimizer/lr.py``
+    ReduceOnPlateau): shrink lr by ``factor`` after ``patience`` epochs
+    without improvement.
+
+    TPU caveat (by design): jit-compiled train steps bake the traced
+    schedule, so this scheduler is *host-driven* — call ``step(metric)``
+    between epochs and rebuild/refresh the compiled step when ``step``
+    returns True (lr changed). The hapi Model and eager loops can use it
+    directly.
+    """
+
+    def __init__(self, learning_rate: float, mode: str = "min",
+                 factor: float = 0.1, patience: int = 10,
+                 threshold: float = 1e-4, threshold_mode: str = "rel",
+                 cooldown: int = 0, min_lr: float = 0.0):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode {mode!r}")
+        if factor >= 1.0:
+            raise ValueError("factor must be < 1.0")
+        self.lr = float(learning_rate)
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._bad_epochs = 0
+        self._cooldown_left = 0
+
+    def _improved(self, metric: float) -> bool:
+        if self._best is None:
+            return True
+        if self.threshold_mode == "rel":
+            delta = self.threshold * abs(self._best)
+        else:
+            delta = self.threshold
+        if self.mode == "min":
+            return metric < self._best - delta
+        return metric > self._best + delta
+
+    def step(self, metric: float) -> bool:
+        """Record an epoch metric; returns True when the lr was reduced."""
+        metric = float(metric)
+        if self._improved(metric):
+            self._best = metric
+            self._bad_epochs = 0
+            if self._cooldown_left > 0:
+                self._cooldown_left -= 1
+            return False
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return False
+        self._bad_epochs += 1
+        if self._bad_epochs > self.patience:
+            new_lr = max(self.lr * self.factor, self.min_lr)
+            changed = new_lr < self.lr - 1e-12
+            self.lr = new_lr
+            self._bad_epochs = 0
+            self._cooldown_left = self.cooldown
+            return changed
+        return False
+
+    def __call__(self, step):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.lr, jnp.float32)
+
+    def get_lr(self, step=None):
+        return self.lr

@@ -23,7 +23,8 @@ from paddle_tpu.core.module import apply_updates
 from paddle_tpu.optimizer import transform as T
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum",
+           "Ftrl", "Dpsgd", "ExponentialMovingAverage"]
 
 
 def _as_schedule(lr) -> Callable:
@@ -34,6 +35,8 @@ def _as_schedule(lr) -> Callable:
 
 class Optimizer:
     """Wraps a transformation chain; subclasses define ``_build``."""
+
+    _applies_own_lr = False   # FTRL-style rules embed lr in the update
 
     def __init__(self, learning_rate=0.001, *, grad_clip=None,
                  weight_decay: float = 0.0, multi_precision: bool = True,
@@ -47,8 +50,9 @@ class Optimizer:
             transforms.append(grad_clip if isinstance(
                 grad_clip, T.GradientTransformation) else grad_clip.transform())
         transforms.extend(self._build(**kwargs))
-        transforms.append(
-            T.scale_by_schedule(_as_schedule(learning_rate)))
+        if not self._applies_own_lr:
+            transforms.append(
+                T.scale_by_schedule(_as_schedule(learning_rate)))
         self._tx = T.chain(*transforms)
 
     def _build(self, **kwargs):  # pragma: no cover - abstract
@@ -204,3 +208,90 @@ class LarsMomentum(Optimizer):
         out.append(T.scale_by_lars_trust(self._coeff))
         out.append(T.trace(self._momentum))
         return out
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference ``fluid/optimizer.py`` FtrlOptimizer +
+    ``operators/optimizers/ftrl_op.h``): the closed-form proximal update
+    embeds the learning rate, so no trailing lr scale is chained."""
+
+    _applies_own_lr = True
+
+    def __init__(self, learning_rate=0.001, l1: float = 0.0,
+                 l2: float = 0.0, lr_power: float = -0.5, **kwargs):
+        self._l1, self._l2, self._lrp = l1, l2, lr_power
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        return [T.scale_by_ftrl(_as_schedule(self.learning_rate),
+                                self._l1, self._l2, self._lrp)]
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference ``fluid/optimizer.py``
+    DpsgdOptimizer + ``operators/optimizers/dpsgd_op.h``): global-norm
+    clip then Gaussian noise scaled by (clip, sigma, batch_size)."""
+
+    def __init__(self, learning_rate=0.001, clip: float = 10.0,
+                 batch_size: int = 16, sigma: float = 1.0, seed: int = 0,
+                 **kwargs):
+        self._dp = (clip, batch_size, sigma, seed)
+        super().__init__(learning_rate, **kwargs)
+
+    def _build(self):
+        clip, bs, sigma, seed = self._dp
+        return [T.scale_by_dpsgd(clip, bs, sigma, seed)]
+
+
+class ExponentialMovingAverage:
+    """EMA of model parameters for evaluation (reference
+    ``fluid/optimizer.py:3441`` ExponentialMovingAverage: shadow vars
+    updated each step with a thresholded decay; apply()/restore() swap
+    the shadow values in for eval).
+
+    Functional form: the EMA is explicit state; ``apply`` returns an
+    EMA-weighted copy of the model instead of mutating scopes::
+
+        ema = ExponentialMovingAverage(0.999)
+        ema_state = ema.init(model)
+        ...
+        ema_state = ema.update(ema_state, state.model)   # each step
+        eval_model = ema.apply(ema_state, state.model)
+    """
+
+    def __init__(self, decay: float = 0.999,
+                 thres_steps: bool = True):
+        self.decay = float(decay)
+        self.thres_steps = thres_steps
+
+    def init(self, model):
+        import jax
+
+        shadow = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32) if hasattr(p, "dtype")
+            else p, model)
+        return {"shadow": shadow, "count": jnp.zeros((), jnp.int32)}
+
+    def update(self, state, model):
+        import jax
+
+        count = state["count"] + 1
+        if self.thres_steps:
+            # reference thresholds decay = min(decay, (1+t)/(10+t))
+            d = jnp.minimum(self.decay,
+                            (1.0 + count) / (10.0 + count))
+        else:
+            d = jnp.asarray(self.decay)
+        shadow = jax.tree_util.tree_map(
+            lambda s, p: d * s + (1.0 - d) * p.astype(jnp.float32)
+            if hasattr(p, "dtype") else s,
+            state["shadow"], model)
+        return {"shadow": shadow, "count": count}
+
+    def apply(self, state, model):
+        """Model with EMA parameter values (dtype preserved)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda p, s: s.astype(p.dtype) if hasattr(p, "dtype") else p,
+            model, state["shadow"])

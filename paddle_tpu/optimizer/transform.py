@@ -375,3 +375,85 @@ def apply_if_finite(inner: GradientTransformation) -> GradientTransformation:
         return upd, ApplyIfFiniteState(new_inner, count)
 
     return GradientTransformation(init, update)
+
+
+class FtrlState(NamedTuple):
+    sq_accum: Any
+    linear: Any
+
+
+def scale_by_ftrl(lr_schedule: Callable, l1: float = 0.0, l2: float = 0.0,
+                  lr_power: float = -0.5) -> GradientTransformation:
+    """FTRL-proximal (reference ``operators/optimizers/ftrl_op.h``): the
+    update is the closed-form proximal step, so the learning rate lives
+    INSIDE the rule — pair with ``_applies_own_lr`` (no trailing
+    scale_by_schedule)."""
+
+    def init(params):
+        z = _map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        n = _map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return FtrlState(n, z), ScheduleState(jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params=None):
+        ftrl, sched = state
+        lr = lr_schedule(sched.count)
+
+        def one(g, n, z, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            new_n = n + g * g
+            if lr_power == -0.5:
+                sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+            else:
+                sigma = (new_n ** (-lr_power) - n ** (-lr_power)) / lr
+            new_z = z + g - sigma * p32
+            if lr_power == -0.5:
+                denom = jnp.sqrt(new_n) / lr + 2.0 * l2
+            else:
+                denom = new_n ** (-lr_power) / lr + 2.0 * l2
+            x = l1 * jnp.sign(new_z) - new_z
+            new_p = jnp.where(jnp.abs(new_z) > l1, x / denom, 0.0)
+            return (new_p - p32).astype(p.dtype), new_n, new_z
+
+        import jax
+
+        flat = _map(lambda g, n, z, p: one(g, n, z, p), grads,
+                    ftrl.sq_accum, ftrl.linear, params)
+        upd, new_n, new_z = jax.tree_util.tree_transpose(
+            jax.tree_util.tree_structure(grads),
+            jax.tree_util.tree_structure((0, 0, 0)), flat)
+        return upd, (FtrlState(new_n, new_z),
+                     ScheduleState(sched.count + 1))
+
+    return GradientTransformation(init, update)
+
+
+class DpsgdState(NamedTuple):
+    key: Any
+
+
+def scale_by_dpsgd(clip: float = 10.0, batch_size: int = 16,
+                   sigma: float = 1.0, seed: int = 0) -> GradientTransformation:
+    """Differentially-private SGD (reference
+    ``operators/optimizers/dpsgd_op.h``): per-update global-norm clip to
+    ``clip`` then Gaussian noise ``N(0, (clip*sigma)^2)/batch_size``."""
+    import jax
+
+    def init(params):
+        return DpsgdState(jax.random.PRNGKey(seed))
+
+    def update(grads, state, params=None):
+        gn = global_norm(grads)
+        scale_f = jnp.minimum(1.0, clip / (gn + 1e-12))
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        keys = jax.random.split(state.key, len(leaves) + 1)
+        out = []
+        for leaf, k in zip(leaves, keys[1:]):
+            noise = jax.random.normal(k, leaf.shape, jnp.float32)
+            out.append(((leaf.astype(jnp.float32) * scale_f
+                         + clip * sigma * noise / batch_size)
+                        ).astype(leaf.dtype))
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                DpsgdState(keys[0]))
+
+    return GradientTransformation(init, update)

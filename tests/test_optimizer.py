@@ -205,3 +205,103 @@ def test_adam_l2_decay_enters_moments():
     updates, _ = o.update({"w": jnp.asarray([0.0])}, state, p)
     # decayed grad = 0.2 -> normalized by sqrt(v̂)=0.2 -> update ≈ -lr
     np.testing.assert_allclose(updates["w"], [-0.01], rtol=1e-4)
+
+
+def test_ftrl_matches_numpy_reference():
+    """FTRL-proximal vs a direct numpy transcription of ftrl_op.h."""
+    from paddle_tpu import optimizer as optim
+
+    lr, l1, l2 = 0.05, 0.01, 0.1
+    opt = optim.Ftrl(lr, l1=l1, l2=l2)
+    p = jnp.asarray(np.array([0.5, -0.3, 0.1], np.float32))
+    state = opt.init(p)
+    rs = np.random.RandomState(0)
+
+    p_np = np.array(p, np.float64)
+    n_np = np.zeros(3)
+    z_np = np.zeros(3)
+    for _ in range(5):
+        g = rs.randn(3).astype(np.float32)
+        updates, state = opt.update(jnp.asarray(g), state, p)
+        p = p + updates
+
+        g64 = g.astype(np.float64)
+        new_n = n_np + g64 * g64
+        sigma = (np.sqrt(new_n) - np.sqrt(n_np)) / lr
+        z_np = z_np + g64 - sigma * p_np
+        n_np = new_n
+        denom = np.sqrt(n_np) / lr + 2 * l2
+        p_np = np.where(np.abs(z_np) > l1,
+                        (l1 * np.sign(z_np) - z_np) / denom, 0.0)
+    np.testing.assert_allclose(np.asarray(p), p_np, rtol=1e-4, atol=1e-6)
+
+
+def test_dpsgd_clips_and_noises():
+    from paddle_tpu import optimizer as optim
+
+    opt = optim.Dpsgd(0.1, clip=1.0, batch_size=4, sigma=0.5, seed=1)
+    p = jnp.zeros(1000)
+    state = opt.init(p)
+    g = jnp.full(1000, 100.0)  # huge grad: must be clipped to norm 1
+    updates, state = opt.update(g, state, p)
+    u = np.asarray(updates) / -0.1  # undo lr scale
+    # clipped grad norm ~1 plus noise of std clip*sigma/bs = 0.125
+    assert np.linalg.norm(u) < 1.0 + 0.125 * np.sqrt(1000) * 3
+    # noise present: updates not all equal
+    assert np.std(u) > 0.01
+    # deterministic across same seed
+    opt2 = optim.Dpsgd(0.1, clip=1.0, batch_size=4, sigma=0.5, seed=1)
+    u2, _ = opt2.update(g, opt2.init(p), p)
+    np.testing.assert_array_equal(np.asarray(updates), np.asarray(u2))
+
+
+def test_ema_tracks_and_applies():
+    from paddle_tpu import nn
+    from paddle_tpu import optimizer as optim
+
+    paddle_tpu.seed(0)
+    model = nn.Linear(4, 2)
+    ema = optim.ExponentialMovingAverage(0.9)
+    st = ema.init(model)
+    m2 = model.replace(weight=model.weight + 1.0)
+    for _ in range(50):
+        st = ema.update(st, m2)
+    applied = ema.apply(st, m2)
+    # after many updates the EMA converges to the new weights
+    np.testing.assert_allclose(np.asarray(applied.weight),
+                               np.asarray(m2.weight), atol=0.05)
+    assert applied.weight.dtype == m2.weight.dtype
+
+
+def test_reduce_on_plateau_logic():
+    from paddle_tpu.optimizer.lr import ReduceOnPlateau
+
+    s = ReduceOnPlateau(1.0, mode="min", factor=0.5, patience=2,
+                        threshold=0.0)
+    assert not s.step(10.0)
+    assert not s.step(9.0)            # improving
+    assert not s.step(9.5)            # bad 1
+    assert not s.step(9.5)            # bad 2
+    assert s.step(9.5)                # bad 3 > patience → reduce
+    assert s.get_lr() == 0.5
+    # min_lr floor
+    s2 = ReduceOnPlateau(1e-4, factor=0.1, patience=0, min_lr=5e-5,
+                         threshold=0.0)
+    s2.step(1.0)
+    assert s2.step(2.0)
+    assert s2.get_lr() == 5e-5
+    assert not s2.step(3.0)           # already at floor: no change
+
+
+def test_ftrl_dpsgd_train_quadratic():
+    """Both optimizers reduce a simple quadratic."""
+    from paddle_tpu import optimizer as optim
+
+    for opt in (optim.Ftrl(0.5), optim.Dpsgd(0.05, clip=5.0, sigma=0.1)):
+        p = jnp.asarray(np.array([2.0, -3.0], np.float32))
+        state = opt.init(p)
+        for _ in range(60):
+            g = 2 * p
+            updates, state = opt.update(g, state, p)
+            p = p + updates
+        assert float(jnp.sum(p ** 2)) < 1.0, type(opt).__name__
