@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "sample_logits"]
+__all__ = ["generate", "sample_logits", "beam_search"]
 
 
 def sample_logits(logits, key=None, *, temperature: float = 1.0,
@@ -100,3 +100,82 @@ def generate(model, input_ids, max_new_tokens: int, *,
             1, max_new_tokens, body,
             (seq, cache, next_tok, finished, key))
     return seq
+
+
+def beam_search(model, input_ids, max_new_tokens: int, *,
+                num_beams: int = 4, eos_token_id: int | None = None,
+                pad_token_id: int = 0, length_penalty: float = 1.0,
+                cache_dtype=None):
+    """Beam-search decoding, fully compiled (reference:
+    ``operators/beam_search_op.cc`` + ``beam_search_decode_op.cc`` and the
+    BeamSearchDecoder of ``python/paddle/nn/layer/transformer.py`` —
+    per-step graph ops driven from Python; here ONE ``lax.fori_loop``
+    carries [B, beam] hypothesis state and the KV cache is gathered along
+    its batch axis on every beam reorder).
+
+    Returns [B, T0 + max_new_tokens] int32 — the best beam per batch item
+    under ``score / gen_len**length_penalty``.
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, T0 = input_ids.shape
+    K = int(num_beams)
+    S = T0 + int(max_new_tokens)
+    NEG = jnp.asarray(-1e9, jnp.float32)
+
+    flat_ids = jnp.repeat(input_ids, K, axis=0)           # [B*K, T0]
+    cache = model.init_cache(B * K, S, dtype=cache_dtype)
+    logits, cache = model.forward_with_cache(flat_ids, cache, index=0)
+    V = logits.shape[-1]
+
+    # step 0: all beams hold the same prompt — select K distinct first
+    # tokens from beam 0's distribution
+    logp0 = jax.nn.log_softmax(
+        logits.reshape(B, K, -1, V)[:, 0, -1].astype(jnp.float32))
+    scores, tok = jax.lax.top_k(logp0, K)                 # [B, K]
+
+    seq = jnp.concatenate(
+        [input_ids, jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)],
+        axis=1)
+    seq = jnp.broadcast_to(seq[:, None], (B, K, S)).copy()
+    seq = seq.at[:, :, T0].set(tok)
+    finished = (tok == eos_token_id) if eos_token_id is not None else (
+        jnp.zeros((B, K), bool))
+    gen_lens = jnp.ones((B, K), jnp.float32)
+
+    # token distribution for finished beams: pad with no score change
+    pad_only = jnp.full((V,), NEG).at[pad_token_id].set(0.0)
+
+    def body(i, state):
+        seq, cache, scores, prev_tok, finished, gen_lens = state
+        logits, cache = model.forward_with_cache(
+            prev_tok.reshape(B * K, 1), cache, index=T0 + i - 1)
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32)).reshape(B, K, V)
+        logp = jnp.where(finished[:, :, None], pad_only[None, None], logp)
+        total = scores[:, :, None] + logp                 # [B, K, V]
+        new_scores, idx = jax.lax.top_k(total.reshape(B, K * V), K)
+        from_beam = idx // V                              # [B, K]
+        tok = (idx % V).astype(jnp.int32)
+
+        # reorder hypothesis state by source beam
+        seq = jnp.take_along_axis(seq, from_beam[:, :, None], axis=1)
+        finished = jnp.take_along_axis(finished, from_beam, axis=1)
+        gen_lens = jnp.take_along_axis(gen_lens, from_beam, axis=1)
+        gather = (jnp.arange(B)[:, None] * K + from_beam).reshape(-1)
+        cache = jax.tree_util.tree_map(lambda c: c[:, gather], cache)
+
+        seq = jax.lax.dynamic_update_slice(
+            seq, tok[:, :, None], (0, 0, T0 + i))
+        gen_lens = gen_lens + (~finished).astype(jnp.float32)
+        if eos_token_id is not None:
+            finished = finished | (tok == eos_token_id)
+        return seq, cache, new_scores, tok, finished, gen_lens
+
+    if max_new_tokens > 1:
+        seq, cache, scores, tok, finished, gen_lens = jax.lax.fori_loop(
+            1, max_new_tokens, body,
+            (seq, cache, scores, tok, finished, gen_lens))
+
+    final = scores / jnp.power(jnp.maximum(gen_lens, 1.0), length_penalty)
+    best = jnp.argmax(final, axis=1)
+    return seq[jnp.arange(B), best]
