@@ -9,26 +9,39 @@ from paddle_tpu.nn import functional
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer
 from paddle_tpu.nn.activation import (
-    ELU, GELU, Hardsigmoid, Hardswish, LeakyReLU, LogSoftmax, Mish, ReLU,
-    ReLU6, Sigmoid, SiLU, Softmax, Softplus, Swish, Tanh,
+    ELU, GELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+    LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6, SELU, Sigmoid,
+    SiLU, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+    ThresholdedReLU,
 )
 from paddle_tpu.nn.attention import Cache, MultiHeadAttention
 from paddle_tpu.nn.common import (
-    Dropout, Embedding, Flatten, Identity, LayerList, Linear, Sequential,
-    call_layer,
+    AlphaDropout, Bilinear, BilinearTensorProduct, CosineSimilarity,
+    Dropout, Dropout2D, Dropout3D, Embedding, Flatten, Identity, LayerList,
+    Linear, Pad1D, Pad2D, Pad3D, PairwiseDistance, PixelShuffle, Sequential,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, call_layer,
 )
 from paddle_tpu.nn.conv import (
-    AdaptiveAvgPool2D, AvgPool2D, Conv1D, Conv2D, Conv2DTranspose, MaxPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+    Conv3D, Conv3DTranspose, MaxPool1D, MaxPool2D, MaxPool3D,
+    Pool2D, RowConv,
 )
 from paddle_tpu.nn.loss import (
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
-    NLLLoss, SmoothL1Loss,
+    BCELoss, BCEWithLogitsLoss, CTCLoss, CrossEntropyLoss, HSigmoidLoss,
+    KLDivLoss, L1Loss, MSELoss, MarginRankingLoss, NLLLoss, SmoothL1Loss,
 )
 from paddle_tpu.nn.norm import (
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
-    InstanceNorm2D, LayerNorm, RMSNorm, SyncBatchNorm,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+    LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm,
 )
-from paddle_tpu.nn.rnn import GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNNCell
+from paddle_tpu.nn.rnn import (
+    GRU, BiRNN, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
+    SimpleRNNCell,
+)
+from paddle_tpu.nn.moe import MoEMLP
 from paddle_tpu.nn.stateful import map_modules, merge_state, state_tape
 from paddle_tpu.nn.transformer import (
     Transformer, TransformerDecoder, TransformerDecoderLayer,

@@ -10,7 +10,9 @@ from paddle_tpu.nn import functional as F
 
 __all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh",
            "LeakyReLU", "ELU", "Softmax", "LogSoftmax", "Softplus",
-           "Hardswish", "Hardsigmoid", "Mish"]
+           "Hardswish", "Hardsigmoid", "Mish", "Hardshrink", "Hardtanh",
+           "LogSigmoid", "Maxout", "PReLU", "SELU", "Softshrink",
+           "Softsign", "Tanhshrink", "ThresholdedReLU"]
 
 
 class ReLU(Module):
@@ -126,3 +128,89 @@ class Mish(Module):
 
     def __call__(self, x):
         return F.mish(x)
+
+
+class Hardshrink(Module):
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = float(threshold)
+
+    def __call__(self, x):
+        return F.hardshrink(x, self.threshold)
+
+
+class Hardtanh(Module):
+    def __init__(self, min: float = -1.0, max: float = 1.0):
+        self.min, self.max = float(min), float(max)
+
+    def __call__(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class LogSigmoid(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.log_sigmoid(x)
+
+
+class Maxout(Module):
+    def __init__(self, groups: int, axis: int = 1):
+        self.groups, self.axis = int(groups), int(axis)
+
+    def __call__(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Module):
+    """Learnable leaky slope (reference PReLU layer: one weight per
+    channel, or a single shared scalar)."""
+
+    def __init__(self, num_parameters: int = 1, init: float = 0.25):
+        import jax.numpy as jnp
+
+        self.weight = jnp.full((num_parameters,), float(init))
+
+    def __call__(self, x):
+        return F.prelu(x, self.weight)
+
+
+class SELU(Module):
+    def __init__(self, scale: float = 1.0507009873554805,
+                 alpha: float = 1.6732632423543772):
+        self.scale, self.alpha = float(scale), float(alpha)
+
+    def __call__(self, x):
+        return F.selu(x, self.scale, self.alpha)
+
+
+class Softshrink(Module):
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = float(threshold)
+
+    def __call__(self, x):
+        return F.softshrink(x, self.threshold)
+
+
+class Softsign(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.softsign(x)
+
+
+class Tanhshrink(Module):
+    def __init__(self):
+        pass
+
+    def __call__(self, x):
+        return F.tanhshrink(x)
+
+
+class ThresholdedReLU(Module):
+    def __init__(self, threshold: float = 1.0):
+        self.threshold = float(threshold)
+
+    def __call__(self, x):
+        return F.thresholded_relu(x, self.threshold)

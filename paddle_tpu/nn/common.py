@@ -24,8 +24,7 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer as I
 
-__all__ = ["Linear", "Embedding", "Dropout", "Identity", "Flatten",
-           "Sequential", "LayerList", "call_layer"]
+__all__ = ["Linear", "Embedding", "Dropout", "Identity", "Flatten", "Sequential", "LayerList", "call_layer", "Pad1D", "Pad2D", "Pad3D", "Dropout2D", "Dropout3D", "AlphaDropout", "PixelShuffle", "Upsample", "UpsamplingNearest2D", "UpsamplingBilinear2D", "CosineSimilarity", "PairwiseDistance", "Bilinear", "BilinearTensorProduct"]
 
 _ACCEPTS_TRAINING: dict[type, bool] = {}
 
@@ -170,3 +169,159 @@ class LayerList(Module):
 
     def append(self, layer) -> "LayerList":
         return self.replace(layers=self.layers + (layer,))
+
+
+class Pad1D(Module):
+    """Pad [N, C, L] (reference Pad1D: constant/reflect/replicate)."""
+
+    _MODES = {"constant": "constant", "reflect": "reflect",
+              "replicate": "edge", "circular": "wrap"}
+
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0):
+        self.padding = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)
+        self.mode = self._MODES[mode]
+        self.value = float(value)
+
+    def __call__(self, x):
+        pads = ((0, 0), (0, 0), self.padding)
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=self.mode)
+
+
+class Pad2D(Pad1D):
+    """Pad [N, C, H, W]; ``padding`` int or (left, right, top, bottom)."""
+
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        self.padding = tuple(padding)
+        self.mode = self._MODES[mode]
+        self.value = float(value)
+
+    def __call__(self, x):
+        l, r, t, b = self.padding
+        pads = ((0, 0), (0, 0), (t, b), (l, r))
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=self.mode)
+
+
+class Pad3D(Pad1D):
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0):
+        if isinstance(padding, int):
+            padding = (padding,) * 6
+        self.padding = tuple(padding)
+        self.mode = self._MODES[mode]
+        self.value = float(value)
+
+    def __call__(self, x):
+        l, r, t, b, f, bk = self.padding
+        pads = ((0, 0), (0, 0), (f, bk), (t, b), (l, r))
+        if self.mode == "constant":
+            return jnp.pad(x, pads, constant_values=self.value)
+        return jnp.pad(x, pads, mode=self.mode)
+
+
+class Dropout2D(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, training: bool = False, key=None):
+        return F.dropout2d(x, self.p, training=training, key=key)
+
+
+class Dropout3D(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, training: bool = False, key=None):
+        return F.dropout3d(x, self.p, training=training, key=key)
+
+
+class AlphaDropout(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, training: bool = False, key=None):
+        return F.alpha_dropout(x, self.p, training=training, key=key)
+
+
+class PixelShuffle(Module):
+    def __init__(self, upscale_factor: int):
+        self.upscale_factor = int(upscale_factor)
+
+    def __call__(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+class Upsample(Module):
+    """Resize by scale_factor or size (reference Upsample over
+    interpolate_op)."""
+
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest",
+                 data_format: str = "NCHW"):
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.data_format = data_format
+
+    def __call__(self, x):
+        return F.interpolate(x, scale_factor=self.scale_factor,
+                             size=self.size, mode=self.mode,
+                             data_format=self.data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size, scale_factor, "nearest", data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size, scale_factor, "bilinear", data_format)
+
+
+class CosineSimilarity(Module):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        self.axis, self.eps = int(axis), float(eps)
+
+    def __call__(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Module):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False):
+        self.p, self.epsilon, self.keepdim = float(p), float(epsilon), keepdim
+
+    def __call__(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Bilinear(Module):
+    """out_k = x1 W_k x2 + b_k (reference Bilinear /
+    ``bilinear_tensor_product_op``)."""
+
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, bias: bool = True, key=None):
+        from paddle_tpu.core import rng as _rng
+        from paddle_tpu.nn import initializer as I
+
+        (k1,) = _rng.split_key(key, 1)
+        bound = 1.0 / (in1_features ** 0.5)
+        self.weight = I.Uniform(-bound, bound)(
+            k1, (out_features, in1_features, in2_features))
+        self.bias = jnp.zeros((out_features,)) if bias else None
+
+    def __call__(self, x1, x2):
+        out = jnp.einsum("...i,oij,...j->...o", x1, self.weight, x2)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+BilinearTensorProduct = Bilinear

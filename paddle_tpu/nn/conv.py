@@ -16,8 +16,7 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer as I
 
-__all__ = ["Conv1D", "Conv2D", "Conv2DTranspose", "MaxPool2D", "AvgPool2D",
-           "AdaptiveAvgPool2D"]
+__all__ = ["Conv1D", "Conv2D", "Conv2DTranspose", "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "Conv3D", "Conv1DTranspose", "Conv3DTranspose", "MaxPool1D", "AvgPool1D", "MaxPool3D", "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D", "Pool2D", "RowConv"]
 
 
 def _pair(v):
@@ -139,3 +138,192 @@ class AdaptiveAvgPool2D(Module):
 
     def __call__(self, x):
         return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * 3
+
+
+class Conv3D(Module):
+    """[N, C, D, H, W] conv (reference Conv3D → ``operators/conv_op`` 3D)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size, *,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias: bool = True, dtype=jnp.float32, key=None):
+        k1, _ = rng.split_key(key)
+        ks = _triple(kernel_size)
+        self.weight = I.KaimingUniform()(
+            k1, (out_channels, in_channels // groups) + ks, dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride = _triple(stride)
+        self.padding = padding if isinstance(padding, str) else _triple(padding)
+        self.dilation = _triple(dilation)
+        self.groups = int(groups)
+
+    def __call__(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups)
+
+
+class Conv1DTranspose(Module):
+    """Transposed 1D conv via input-dilated forward conv (same
+    formulation as Conv2DTranspose; reference ``conv_transpose_op``)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 *, stride: int = 1, padding: int = 0, bias: bool = True,
+                 dtype=jnp.float32, key=None):
+        k1, _ = rng.split_key(key)
+        self.weight = I.KaimingUniform()(
+            k1, (in_channels, out_channels, int(kernel_size)), dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride = int(stride)
+        self.padding = int(padding)
+        self.kernel_size = int(kernel_size)
+
+    def __call__(self, x):
+        from jax import lax
+        k, p = self.kernel_size, self.padding
+        w = jnp.flip(self.weight, axis=(2,)).transpose(1, 0, 2)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1,),
+            padding=[(k - 1 - p, k - 1 - p)],
+            lhs_dilation=(self.stride,),
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        if self.bias is not None:
+            y = y + self.bias.reshape(1, -1, 1)
+        return y
+
+
+class Conv3DTranspose(Module):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size, *,
+                 stride=1, padding=0, bias: bool = True, dtype=jnp.float32,
+                 key=None):
+        k1, _ = rng.split_key(key)
+        ks = _triple(kernel_size)
+        self.weight = I.KaimingUniform()(
+            k1, (in_channels, out_channels) + ks, dtype)
+        self.bias = jnp.zeros((out_channels,), dtype) if bias else None
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        self.kernel_size = ks
+
+    def __call__(self, x):
+        from jax import lax
+        k, p = self.kernel_size, self.padding
+        w = jnp.flip(self.weight, axis=(2, 3, 4)).transpose(1, 0, 2, 3, 4)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1),
+            padding=[(ki - 1 - pi, ki - 1 - pi) for ki, pi in zip(k, p)],
+            lhs_dilation=self.stride,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.bias is not None:
+            y = y + self.bias.reshape(1, -1, 1, 1, 1)
+        return y
+
+
+class MaxPool1D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.args = (kernel_size, stride, padding)
+
+    def __call__(self, x):
+        return F.max_pool1d(x, *self.args)
+
+
+class AvgPool1D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.args = (kernel_size, stride, padding)
+
+    def __call__(self, x):
+        return F.avg_pool1d(x, *self.args)
+
+
+class MaxPool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.args = (kernel_size, stride, padding)
+
+    def __call__(self, x):
+        return F.max_pool3d(x, *self.args)
+
+
+class AvgPool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.args = (kernel_size, stride, padding)
+
+    def __call__(self, x):
+        return F.avg_pool3d(x, *self.args)
+
+
+class AdaptiveAvgPool1D(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def __call__(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class Pool2D(Module):
+    """Legacy unified pool layer (reference ``fluid/dygraph/nn.py`` Pool2D:
+    pool_type switch over the modern MaxPool2D/AvgPool2D)."""
+
+    def __init__(self, pool_size, pool_type: str = "max", pool_stride=None,
+                 pool_padding=0, data_format: str = "NCHW"):
+        if pool_type not in ("max", "avg"):
+            raise ValueError(f"pool_type {pool_type!r}")
+        cls = MaxPool2D if pool_type == "max" else AvgPool2D
+        self.pool = cls(pool_size, pool_stride, pool_padding, data_format)
+
+    def __call__(self, x):
+        return self.pool(x)
+
+
+class RowConv(Module):
+    """Lookahead row convolution (reference ``operators/row_conv_op`` —
+    DeepSpeech2's streaming-friendly temporal conv): for [N, T, D] input,
+    out[t] = sum_{i=0..ctx-1} w[i] * x[t+i], per feature channel."""
+
+    def __init__(self, num_channels: int, future_context_size: int,
+                 dtype=jnp.float32, key=None):
+        k1, _ = rng.split_key(key)
+        self.weight = I.XavierUniform()(
+            k1, (int(future_context_size) + 1, num_channels), dtype)
+
+    def __call__(self, x):
+        ctx = self.weight.shape[0]
+        # pad the future edge, then a per-channel (depthwise) correlation
+        xp = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+        out = jnp.zeros_like(x)
+        for i in range(ctx):
+            out = out + xp[:, i:i + x.shape[1]] * self.weight[i]
+        return out

@@ -48,6 +48,15 @@ __all__ = [
     "scaled_dot_product_attention", "rotary_embedding", "apply_rotary",
     "avg_pool2d", "max_pool2d", "adaptive_avg_pool2d", "conv2d", "pad",
     "interpolate", "unfold", "clip", "normalize", "cosine_similarity",
+    # extended surface (see sections below)
+    "hardshrink", "hardtanh", "log_sigmoid", "maxout", "prelu", "selu",
+    "softshrink", "softsign", "tanhshrink", "thresholded_relu",
+    "dropout2d", "dropout3d", "alpha_dropout", "pixel_shuffle",
+    "local_response_norm", "pairwise_distance", "ctc_loss",
+    "margin_ranking_loss", "hsigmoid_loss",
+    "max_pool1d", "avg_pool1d", "max_pool3d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d", "conv1d", "conv3d",
 ]
 
 
@@ -587,3 +596,323 @@ def unfold(x, kernel_size, stride=1, padding=0, dilation=1):
         x, filter_shape=k, window_strides=s, padding="VALID",
         rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+# ---------------------------------------------------------------------------
+# Extended activations (reference python/paddle/nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+def hardshrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    """Max over ``groups`` channel groups (reference ``maxout_op``)."""
+    shape = list(x.shape)
+    if shape[axis] % groups:
+        raise ValueError(f"channels {shape[axis]} % groups {groups} != 0")
+    shape[axis:axis + 1] = [shape[axis] // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def prelu(x, weight):
+    """weight broadcasts per-channel ([C] against axis 1) or scalar."""
+    w = weight
+    if w.ndim == 1 and x.ndim > 2:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, w * x)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def softshrink(x, threshold: float = 0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0.0)
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Dropout variants (reference operators/dropout_op + nn/functional/common.py)
+# ---------------------------------------------------------------------------
+
+def dropout2d(x, p: float = 0.5, training: bool = True, key=None,
+              data_format: str = "NCHW"):
+    """Drop whole channels of [N, C, H, W]."""
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from paddle_tpu.core import rng as _rng
+        key = _rng.next_key()
+    c_axis = 1 if data_format == "NCHW" else -1
+    shape = [x.shape[0], 1, 1, 1]
+    shape[c_axis] = x.shape[c_axis]
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), 0.0)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from paddle_tpu.core import rng as _rng
+        key = _rng.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p,
+                                (x.shape[0], x.shape[1], 1, 1, 1))
+    return jnp.where(keep, x / (1.0 - p), 0.0)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True, key=None):
+    """SELU-preserving dropout (reference alpha_dropout): dropped units
+    take the negative saturation value; affine correction keeps
+    mean/variance."""
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        from paddle_tpu.core import rng as _rng
+        key = _rng.next_key()
+    alpha = 1.6732632423543772 * 1.0507009873554805
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = ((1.0 - p) * (1.0 + p * alpha ** 2)) ** -0.5
+    b = a * alpha * p   # cancels the -alpha mass of the dropped units
+    return a * jnp.where(keep, x, -alpha) + b
+
+
+# ---------------------------------------------------------------------------
+# Geometry / misc (pixel_shuffle_op, lrn_op, interpolate)
+# ---------------------------------------------------------------------------
+
+def pixel_shuffle(x, upscale_factor: int):
+    """[N, C*r^2, H, W] → [N, C, H*r, W*r] (reference pixel_shuffle_op)."""
+    r = int(upscale_factor)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0):
+    """AlexNet-style LRN over channels (reference ``lrn_op``)."""
+    sq = jnp.square(x)
+    half = size // 2
+    pad = jnp.pad(sq, ((0, 0), (half, size - half - 1), (0, 0), (0, 0)))
+    windows = jnp.stack([pad[:, i:i + x.shape[1]] for i in range(size)], 0)
+    denom = k + alpha * jnp.sum(windows, axis=0)
+    return x / denom ** beta
+
+
+def pairwise_distance(a, b, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False):
+    d = jnp.linalg.norm(jnp.abs(a - b) + epsilon, ord=p, axis=-1,
+                        keepdims=keepdim)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Extra losses (ctc, margin ranking, hierarchical sigmoid)
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean"):
+    """CTC (reference ``operators/warpctc_op``): forward-backward over
+    [B, T, V] log-probs; optax's TPU-friendly implementation underneath.
+    ``labels`` are padded [B, L]."""
+    import optax
+
+    B, T, V = log_probs.shape
+    L = labels.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    logit_pad = (t_idx >= input_lengths[:, None]).astype(jnp.float32)
+    l_idx = jnp.arange(L)[None, :]
+    label_pad = (l_idx >= label_lengths[:, None]).astype(jnp.float32)
+    loss = optax.ctc_loss(log_probs, logit_pad, labels, label_pad,
+                          blank_id=blank)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean"):
+    """max(0, -label*(input-other) + margin) (reference
+    margin_rank_loss_op)."""
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def _hsigmoid_paths(num_classes: int):
+    """Complete-binary-tree paths: for each class, the internal-node ids
+    visited and the left/right codes (static, computed host-side)."""
+    import numpy as np
+
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    nodes = np.zeros((num_classes, depth), np.int32)
+    codes = np.zeros((num_classes, depth), np.float32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        # leaf id in a heap-layout complete tree with num_classes leaves
+        j = c + num_classes - 1
+        path = []
+        while j > 0:
+            parent = (j - 1) // 2
+            path.append((parent, float(j == 2 * parent + 2)))
+            j = parent
+        for d, (node, code) in enumerate(reversed(path)):
+            if d < depth:
+                nodes[c, d] = node
+                codes[c, d] = code
+                mask[c, d] = 1.0
+    return nodes, codes, mask
+
+
+def hsigmoid_loss(x, label, weight, bias=None, num_classes: int | None = None,
+                  reduction: str = "mean"):
+    """Hierarchical sigmoid (reference ``operators/hierarchical_sigmoid_op``):
+    O(log V) classification over a complete binary tree. ``weight`` is
+    [num_classes - 1, D] internal-node vectors."""
+    num_classes = num_classes or (weight.shape[0] + 1)
+    nodes, codes, mask = _hsigmoid_paths(num_classes)
+    nodes_l = jnp.asarray(nodes)[label]          # [B, depth]
+    codes_l = jnp.asarray(codes)[label]
+    mask_l = jnp.asarray(mask)[label]
+    w = weight[nodes_l]                          # [B, depth, D]
+    logit = jnp.einsum("bd,bkd->bk", x, w)
+    if bias is not None:
+        logit = logit + bias[nodes_l]
+    # BCE toward the path codes, masked to the real path length
+    per_node = (jnp.maximum(logit, 0) - logit * codes_l
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    loss = jnp.sum(per_node * mask_l, axis=1)
+    return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# N-d pooling + conv3d (generalize the 2D versions)
+# ---------------------------------------------------------------------------
+
+def _tuple_n(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+def _pool_nd(x, nd, kernel_size, stride, padding, init, op, count_avg=False):
+    k = _tuple_n(kernel_size, nd)
+    s = _tuple_n(stride if stride is not None else kernel_size, nd)
+    p = _tuple_n(padding, nd)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    out = lax.reduce_window(x, init, op, window, strides, pads)
+    if count_avg:
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return out / counts
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, 1, kernel_size, stride, padding, -jnp.inf, lax.max)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _pool_nd(x, 1, kernel_size, stride, padding, 0.0, lax.add,
+                    count_avg=True) if exclusive else _pool_nd(
+        x, 1, kernel_size, stride, padding, 0.0, lax.add) / (
+        _tuple_n(kernel_size, 1)[0])
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, 3, kernel_size, stride, padding, -jnp.inf, lax.max)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, 3, kernel_size, stride, padding, 0.0, lax.add,
+                    count_avg=True)
+
+
+def _adaptive_pool_nd(x, nd, output_size, op):
+    out = _tuple_n(output_size, nd)
+    spatial = x.shape[2:]
+    for size, dim in zip(out, spatial):
+        if dim % size:
+            raise NotImplementedError(
+                f"adaptive pool needs input {dim} divisible by output "
+                f"{size} (XLA static windows)")
+    k = tuple(dim // size for size, dim in zip(out, spatial))
+    if op == "max":
+        return _pool_nd(x, nd, k, k, 0, -jnp.inf, lax.max)
+    return _pool_nd(x, nd, k, k, 0, 0.0, lax.add, count_avg=True)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool_nd(x, 1, output_size, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size):
+    return _adaptive_pool_nd(x, 3, output_size, "avg")
+
+
+def adaptive_max_pool1d(x, output_size):
+    return _adaptive_pool_nd(x, 1, output_size, "max")
+
+
+def adaptive_max_pool2d(x, output_size):
+    return _adaptive_pool_nd(x, 2, output_size, "max")
+
+
+def adaptive_max_pool3d(x, output_size):
+    return _adaptive_pool_nd(x, 3, output_size, "max")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1):
+    """[N, C, D, H, W] conv (reference ``operators/conv_op`` 3D path)."""
+    s = _tuple_n(stride, 3)
+    d = _tuple_n(dilation, 3)
+    if isinstance(padding, str):
+        pads = padding
+    else:
+        p = _tuple_n(padding, 3)
+        pads = tuple((pi, pi) for pi in p)
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=s, padding=pads, rhs_dilation=d,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1):
+    """[N, C, L] conv via the general dilated conv."""
+    if isinstance(padding, str):
+        pads = padding
+    else:
+        p = _tuple_n(padding, 1)
+        pads = ((p[0], p[0]),)
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=_tuple_n(stride, 1), padding=pads,
+        rhs_dilation=_tuple_n(dilation, 1), feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out

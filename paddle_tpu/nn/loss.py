@@ -9,8 +9,7 @@ from __future__ import annotations
 from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
 
-__all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
-           "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss"]
+__all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss", "CTCLoss", "MarginRankingLoss", "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Module):
@@ -84,3 +83,49 @@ class KLDivLoss(Module):
 
     def __call__(self, log_pred, target):
         return F.kl_div(log_pred, target, self.reduction)
+
+
+class CTCLoss(Module):
+    """Connectionist temporal classification (reference CTCLoss →
+    ``operators/warpctc_op``)."""
+
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        self.blank = int(blank)
+        self.reduction = reduction
+
+    def __call__(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class MarginRankingLoss(Module):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        self.margin = float(margin)
+        self.reduction = reduction
+
+    def __call__(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class HSigmoidLoss(Module):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    HSigmoidLoss → ``operators/hierarchical_sigmoid_op``): O(log V)
+    normalization for huge vocabularies/label sets."""
+
+    def __init__(self, feature_size: int, num_classes: int, *,
+                 bias: bool = True, key=None):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core import rng as _rng
+        from paddle_tpu.nn import initializer as I
+
+        (k1,) = _rng.split_key(key, 1)
+        self.weight = I.XavierUniform()(
+            k1, (num_classes - 1, feature_size))
+        self.bias = jnp.zeros((num_classes - 1,)) if bias else None
+        self.num_classes = int(num_classes)
+
+    def __call__(self, x, label):
+        return F.hsigmoid_loss(x, label, self.weight, self.bias,
+                               self.num_classes)

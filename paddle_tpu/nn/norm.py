@@ -15,6 +15,7 @@ so plain BatchNorm here already has SyncBatchNorm semantics
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -24,8 +25,7 @@ from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.stateful import new_uid, record_state
 
-__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
-           "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm2D"]
+__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm2D", "InstanceNorm1D", "InstanceNorm3D", "LocalResponseNorm", "SpectralNorm"]
 
 
 class LayerNorm(Module):
@@ -148,3 +148,70 @@ class InstanceNorm2D(Module):
         # instance norm = group norm with one group per channel
         return F.group_norm(x, self.num_features, self.weight, self.bias,
                             self.epsilon, "NCHW")
+
+
+class InstanceNorm1D(Module):
+    """[N, C, L] instance norm (group norm with one group per channel)."""
+
+    def __init__(self, num_features: int, *, epsilon: float = 1e-5,
+                 dtype=jnp.float32):
+        self.num_features = int(num_features)
+        self.epsilon = float(epsilon)
+        self.weight = jnp.ones((num_features,), dtype)
+        self.bias = jnp.zeros((num_features,), dtype)
+
+    def __call__(self, x):
+        return F.group_norm(x, self.num_features, self.weight, self.bias,
+                            self.epsilon, "NCHW")
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    """[N, C, D, H, W] instance norm."""
+
+
+class LocalResponseNorm(Module):
+    def __init__(self, size: int = 5, alpha: float = 1e-4,
+                 beta: float = 0.75, k: float = 1.0):
+        self.size, self.alpha = int(size), float(alpha)
+        self.beta, self.k = float(beta), float(k)
+
+    def __call__(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k)
+
+
+class SpectralNorm(Module):
+    """Spectral normalization of a weight (reference ``spectral_norm_op``):
+    W / sigma_max(W), sigma estimated by power iteration. The u/v vectors
+    are running state on the state tape (like BN statistics)."""
+
+    _nontrainable = ("u",)
+
+    def __init__(self, weight_shape, *, n_power_iterations: int = 1,
+                 epsilon: float = 1e-12, dim: int = 0, key=None):
+        from paddle_tpu.core import rng as _rng
+        from paddle_tpu.nn.stateful import new_uid
+
+        (k1,) = _rng.split_key(key, 1)
+        self.dim = int(dim)
+        h = weight_shape[dim]
+        self.n_power_iterations = int(n_power_iterations)
+        self.epsilon = float(epsilon)
+        self.u = jax.random.normal(k1, (h,))
+        self._uid = new_uid()
+
+    def __call__(self, weight, training: bool = False):
+        from paddle_tpu.nn.stateful import record_state
+
+        w = jnp.moveaxis(weight, self.dim, 0)
+        w2 = w.reshape(w.shape[0], -1)
+        u = self.u
+        for _ in range(self.n_power_iterations):
+            v = w2.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), self.epsilon)
+            u = w2 @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), self.epsilon)
+        sigma = u @ w2 @ v
+        if training:
+            record_state(self._uid, u=jax.lax.stop_gradient(u))
+        return weight / jax.lax.stop_gradient(sigma)

@@ -19,7 +19,7 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer as I
 
-__all__ = ["LSTMCell", "GRUCell", "SimpleRNNCell", "RNN", "LSTM", "GRU"]
+__all__ = ["LSTMCell", "GRUCell", "SimpleRNNCell", "RNN", "LSTM", "GRU", "SimpleRNN", "BiRNN"]
 
 
 class SimpleRNNCell(Module):
@@ -153,3 +153,38 @@ class GRU(_MultiLayerRNN):
                  *, time_major: bool = False, dtype=jnp.float32, key=None):
         super().__init__(GRUCell, input_size, hidden_size, num_layers,
                          time_major=time_major, dtype=dtype, key=key)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    """Multi-layer Elman RNN (reference SimpleRNN)."""
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 *, time_major: bool = False, dtype=jnp.float32, key=None):
+        super().__init__(SimpleRNNCell, input_size, hidden_size, num_layers,
+                         time_major=time_major, dtype=dtype, key=key)
+
+
+class BiRNN(Module):
+    """Bidirectional wrapper (reference BiRNN): run a forward and a
+    backward cell over the sequence and concatenate the features."""
+
+    def __init__(self, cell_fw, cell_bw, *, time_major: bool = False):
+        self.fw = RNN(cell_fw, time_major=time_major)
+        self.bw = RNN(cell_bw, time_major=time_major)
+        self.time_major = bool(time_major)
+
+    def __call__(self, x, initial_states=None):
+        t_axis = 0 if self.time_major else 1
+        init_fw, init_bw = (initial_states if initial_states is not None
+                            else (None, None))
+        out_fw, st_fw = self.fw(x, init_fw)
+        rev = jnp.flip(x, axis=t_axis)
+        out_bw, st_bw = self.bw(rev, init_bw)
+        out_bw = jnp.flip(out_bw, axis=t_axis)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+# reference exposes RNNCellBase as the subclassing point for custom cells;
+# cells here are plain Modules with ``__call__(x, state) -> (out, state)``
+# and ``state_shape`` semantics carried by convention
+RNNCellBase = Module
