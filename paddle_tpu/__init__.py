@@ -66,6 +66,8 @@ from paddle_tpu import metric  # noqa: E402
 from paddle_tpu import io  # noqa: E402
 from paddle_tpu.core import profiler  # noqa: E402
 from paddle_tpu import quant  # noqa: E402
+from paddle_tpu.tensor_ops import *  # noqa: E402,F401,F403
+from paddle_tpu import tensor_ops as tensor  # noqa: E402
 
 __all__ = [
     "__version__",

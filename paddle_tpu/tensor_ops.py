@@ -1,0 +1,508 @@
+"""paddle.tensor API surface — paddle calling conventions over jax.numpy.
+
+Reference: ``python/paddle/tensor/`` (manipulation.py, math.py, linalg.py,
+search.py, logic.py, stat.py — ~8.6k LoC of Python dispatching to the
+C++ op library). On TPU these are jnp/lax one-liners; what this module
+adds is the *paddle semantics* where they differ from numpy:
+``split(num_or_sections)``, ``topk``/``sort`` return conventions,
+``gather`` defaulting to axis 0, ``scatter`` overwrite-vs-add,
+``norm``'s fro default, ``unique``'s optional index/counts outputs, etc.
+
+Everything here is jit-compatible except the documented exceptions
+(``nonzero``/``masked_select`` produce data-dependent shapes — eager
+only, same caveat the reference's dynamic-shape ops carry on XLA).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    # manipulation
+    "concat", "split", "chunk", "stack", "unstack", "unbind", "squeeze",
+    "unsqueeze", "reshape", "flatten", "transpose", "t", "flip", "roll",
+    "tile", "expand", "expand_as", "broadcast_to", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "index_select", "index_sample",
+    "masked_select", "unique", "shard_index",
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "sqrt", "rsqrt", "exp", "log", "log2", "log10", "log1p", "abs", "ceil",
+    "floor", "round", "sign", "reciprocal", "square", "maximum", "minimum",
+    "sum", "mean", "max", "min", "prod", "cumsum", "cumprod", "logsumexp",
+    "argmax", "argmin", "addmm", "matmul", "dot", "bmm", "mv", "kron",
+    "trace", "multiply", "erf", "isnan", "isinf", "isfinite", "clip",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "atan2",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "allclose", "equal_all", "is_empty",
+    # linalg
+    "norm", "dist", "cross", "cholesky", "histogram", "tril", "triu",
+    "diag", "meshgrid",
+    # search / sort
+    "argsort", "sort", "topk", "where", "nonzero",
+    # stat
+    "std", "var", "median", "numel",
+]
+
+
+# ---------------------------------------------------------------------------
+# manipulation (reference python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+
+def concat(x, axis: int = 0):
+    return jnp.concatenate(x, axis=axis)
+
+
+def split(x, num_or_sections, axis: int = 0):
+    """paddle semantics: int → equal parts; list → section sizes (a -1
+    entry infers its size)."""
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    import builtins
+
+    sections = list(num_or_sections)
+    if -1 in sections:
+        known = builtins.sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks: int, axis: int = 0):
+    return jnp.split(x, chunks, axis=axis)
+
+
+def stack(x, axis: int = 0):
+    return jnp.stack(x, axis=axis)
+
+
+def unstack(x, axis: int = 0):
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+unbind = unstack
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    stop = stop_axis if stop_axis >= 0 else x.ndim + stop_axis
+    return x.reshape(x.shape[:start_axis] + (-1,) + x.shape[stop + 1:])
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def t(x):
+    return x.T
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+broadcast_to = expand
+
+
+def gather(x, index, axis: int = 0):
+    """Row gather along ``axis`` (reference ``gather_op``; axis default 0
+    unlike numpy.take's flattened default)."""
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    """Gather by coordinate tuples in the trailing dim of ``index``."""
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """Row scatter into axis 0 (reference ``scatter_op``): overwrite or
+    accumulate."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def index_select(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    """Per-row column sampling: out[i, j] = x[i, index[i, j]]
+    (reference ``index_sample_op``)."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def masked_select(x, mask):
+    """Data-dependent output shape → eager only (same XLA caveat as the
+    reference's dynamic-shape path)."""
+    import numpy as np
+
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def unique(x, return_index: bool = False, return_inverse: bool = False,
+           return_counts: bool = False):
+    import numpy as np
+
+    out = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts)
+    if isinstance(out, tuple):
+        return tuple(jnp.asarray(o) for o in out)
+    return jnp.asarray(out)
+
+
+def shard_index(x, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1):
+    """Map global ids to shard-local ids (reference ``shard_index_op``,
+    the PS sparse-table row router)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+# ---------------------------------------------------------------------------
+# math (reference python/paddle/tensor/math.py)
+# ---------------------------------------------------------------------------
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+for _name in ("sqrt", "exp", "log", "log2", "log10", "log1p", "abs",
+              "ceil", "floor", "sign", "square", "sin", "cos", "tan",
+              "asin", "acos", "atan", "sinh", "cosh", "tanh", "isnan",
+              "isinf", "isfinite", "cumsum", "cumprod", "atan2"):
+    globals()[_name] = getattr(jnp, _name)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def sum(x, axis=None, keepdim: bool = False):
+    return jnp.sum(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim: bool = False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim: bool = False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim: bool = False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim: bool = False):
+    return jnp.prod(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim: bool = False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim: bool = False):
+    out = jnp.argmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim and axis is not None else out
+
+
+def argmin(x, axis=None, keepdim: bool = False):
+    out = jnp.argmin(x, axis=axis)
+    return jnp.expand_dims(out, axis) if keepdim and axis is not None else out
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return x @ vec
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+# ---------------------------------------------------------------------------
+# logic (reference python/paddle/tensor/logic.py)
+# ---------------------------------------------------------------------------
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def allclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
+             equal_nan: bool = False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def is_empty(x):
+    return x.size == 0
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference python/paddle/tensor/linalg.py)
+# ---------------------------------------------------------------------------
+
+def norm(x, p="fro", axis=None, keepdim: bool = False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def dist(x, y, p: float = 2.0):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+def cross(x, y, axis: int = -1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cholesky(x, upper: bool = False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def histogram(x, bins: int = 100, min=0, max=0):
+    if min == 0 and max == 0:
+        min, max = float(jnp.min(x)), float(jnp.max(x))
+    hist, _ = jnp.histogram(x, bins=bins, range=(min, max))
+    return hist
+
+
+def tril(x, diagonal: int = 0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal: int = 0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset: int = 0):
+    return jnp.diag(x, k=offset)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+# ---------------------------------------------------------------------------
+# search / sort (reference python/paddle/tensor/search.py)
+# ---------------------------------------------------------------------------
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    idx = jnp.argsort(x, axis=axis)
+    return jnp.flip(idx, axis=axis) if descending else idx
+
+
+def sort(x, axis: int = -1, descending: bool = False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def topk(x, k: int, axis: int = -1, largest: bool = True,
+         sorted: bool = True):
+    """(values, indices), paddle convention."""
+    del sorted
+    if axis in (-1, x.ndim - 1):
+        if largest:
+            return lax.top_k(x, k)
+        vals, idx = lax.top_k(-x, k)
+        return -vals, idx
+    x_m = jnp.moveaxis(x, axis, -1)
+    vals, idx = topk(x_m, k, -1, largest)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple: bool = False):
+    """Eager only (data-dependent shape)."""
+    import numpy as np
+
+    out = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(o) for o in out)
+    return jnp.asarray(np.stack(out, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# stat (reference python/paddle/tensor/stat.py)
+# ---------------------------------------------------------------------------
+
+def std(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim: bool = False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def numel(x):
+    return x.size

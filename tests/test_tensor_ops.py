@@ -1,0 +1,91 @@
+"""paddle.tensor API semantics tests — the conventions that differ from
+numpy (split sections, topk tuples, gather axis, scatter modes, norm
+default, shard_index routing)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def test_split_sections_with_inference():
+    x = jnp.arange(12).reshape(12, 1)
+    a, b, c = P.split(x, [3, -1, 4], axis=0)
+    assert a.shape[0] == 3 and b.shape[0] == 5 and c.shape[0] == 4
+    parts = P.split(x, 3)
+    assert len(parts) == 3 and parts[0].shape[0] == 4
+
+
+def test_topk_and_sort_conventions():
+    x = jnp.asarray([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]])
+    vals, idx = P.topk(x, 2)
+    np.testing.assert_array_equal(np.asarray(vals), [[3, 2], [9, 8]])
+    np.testing.assert_array_equal(np.asarray(idx), [[0, 2], [0, 2]])
+    vals_s, idx_s = P.topk(x, 2, largest=False)
+    np.testing.assert_array_equal(np.asarray(vals_s), [[1, 2], [7, 8]])
+    np.testing.assert_array_equal(np.asarray(P.sort(x, descending=True)),
+                                  [[3, 2, 1], [9, 8, 7]])
+    np.testing.assert_array_equal(
+        np.asarray(P.argsort(x, descending=True)[0]), [0, 2, 1])
+    # topk along a non-last axis
+    v2, i2 = P.topk(x, 1, axis=0)
+    np.testing.assert_array_equal(np.asarray(v2), [[9, 7, 8]])
+
+
+def test_gather_scatter_semantics():
+    x = jnp.asarray(np.arange(12.0).reshape(4, 3))
+    np.testing.assert_array_equal(np.asarray(P.gather(x, jnp.asarray([2, 0]))),
+                                  [[6, 7, 8], [0, 1, 2]])
+    upd = jnp.ones((2, 3))
+    over = P.scatter(x, jnp.asarray([0, 1]), upd, overwrite=True)
+    np.testing.assert_array_equal(np.asarray(over[0]), [1, 1, 1])
+    acc = P.scatter(x, jnp.asarray([0, 0]), upd, overwrite=False)
+    np.testing.assert_array_equal(np.asarray(acc[0]), [2, 3, 4])
+    nd = P.gather_nd(x, jnp.asarray([[0, 1], [3, 2]]))
+    np.testing.assert_array_equal(np.asarray(nd), [1, 11])
+    samp = P.index_sample(x, jnp.asarray([[0, 2], [1, 1], [2, 0], [0, 0]]))
+    np.testing.assert_array_equal(np.asarray(samp[0]), [0, 2])
+
+
+def test_norm_defaults_and_dist():
+    x = jnp.asarray([[3.0, 4.0], [0.0, 0.0]])
+    assert float(P.norm(x)) == 5.0                    # fro over all
+    np.testing.assert_allclose(np.asarray(P.norm(x, p=2, axis=1)), [5, 0])
+    assert float(P.dist(x, jnp.zeros_like(x), p=2)) == 5.0
+
+
+def test_shard_index_routes_ps_rows():
+    ids = jnp.asarray([0, 5, 10, 15])
+    # 16 ids over 4 shards: shard size 4
+    out = P.shard_index(ids, 16, 4, shard_id=1)
+    np.testing.assert_array_equal(np.asarray(out), [-1, 1, -1, -1])
+
+
+def test_unique_and_masked_select_eager():
+    x = jnp.asarray([3, 1, 3, 2, 1])
+    u, inv, counts = P.unique(x, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(counts), [2, 1, 2])
+    np.testing.assert_array_equal(np.asarray(u[inv]), np.asarray(x))
+    sel = P.masked_select(x, x > 1)
+    np.testing.assert_array_equal(np.asarray(sel), [3, 3, 2])
+    nz = P.nonzero(jnp.asarray([0, 3, 0, 4]))
+    np.testing.assert_array_equal(np.asarray(nz), [[1], [3]])
+
+
+def test_math_and_stat_conventions():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    assert float(P.std(x)) == pytest.approx(np.std(np.arange(1, 5),
+                                                   ddof=1))
+    assert float(P.var(x, unbiased=False)) == pytest.approx(1.25)
+    np.testing.assert_allclose(
+        np.asarray(P.matmul(x, x, transpose_y=True)),
+        np.asarray(x) @ np.asarray(x).T)
+    np.testing.assert_allclose(np.asarray(P.addmm(jnp.ones((2, 2)), x, x,
+                                                  beta=2.0, alpha=1.0)),
+                               2 + np.asarray(x) @ np.asarray(x))
+    assert int(P.numel(x)) == 4
+    np.testing.assert_array_equal(np.asarray(P.flatten(x)), [1, 2, 3, 4])
+    h = P.histogram(jnp.asarray([0.0, 1.0, 1.0, 2.0]), bins=2)
+    np.testing.assert_array_equal(np.asarray(h), [1, 3])
