@@ -68,6 +68,9 @@ from paddle_tpu.core import profiler  # noqa: E402
 from paddle_tpu import quant  # noqa: E402
 from paddle_tpu.tensor_ops import *  # noqa: E402,F401,F403
 from paddle_tpu import tensor_ops as tensor  # noqa: E402
+from paddle_tpu import jit  # noqa: E402
+from paddle_tpu import regularizer  # noqa: E402
+from paddle_tpu import text  # noqa: E402
 
 __all__ = [
     "__version__",

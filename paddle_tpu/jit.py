@@ -1,0 +1,79 @@
+"""paddle.jit equivalent — program capture and saved inference functions.
+
+Reference: ``python/paddle/fluid/dygraph/jit.py`` (``@declarative`` /
+``paddle.jit.to_static``: an AST transpiler rewriting imperative Python
+into ProgramDesc graphs, ``dygraph_to_static/program_translator.py:729``)
+plus ``paddle.jit.save/load`` (TranslatedLayer serialization).
+
+On TPU the entire AST-transpiler layer is unnecessary: jax traces the
+Python directly, so ``to_static`` IS ``jax.jit`` (with paddle's
+``input_spec`` mapped to shape/dtype-declared example inputs) and
+save/load ride the StableHLO export path (``paddle_tpu.io.export``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.io.export import load_inference_model, save_inference_model
+
+__all__ = ["to_static", "not_to_static", "save", "load", "InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference ``paddle.static.InputSpec``);
+    None dims are unsupported under XLA's static-shape model — pad or
+    bucket instead (the documented TPU recipe)."""
+
+    def __init__(self, shape: Sequence[int], dtype="float32",
+                 name: str | None = None):
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            raise ValueError(
+                "dynamic dims are not supported on TPU (XLA compiles "
+                "static shapes); bucket or pad the input instead")
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+
+    def example(self):
+        return jnp.zeros(self.shape, self.dtype)
+
+
+def to_static(function=None, *, input_spec: Sequence[InputSpec] | None = None,
+              **jit_kwargs):
+    """``@to_static`` — compile a Python callable.
+
+    With ``input_spec``, the function is traced ahead of time against the
+    declared shapes (the reference's eager program capture); without it,
+    compilation happens at first call per shape signature, which is
+    plain ``jax.jit`` behavior.
+    """
+
+    def wrap(fn):
+        jitted = jax.jit(fn, **jit_kwargs)
+        if input_spec:
+            jitted.lower(*[s.example() for s in input_spec])
+        return jitted
+
+    return wrap(function) if function is not None else wrap
+
+
+def not_to_static(fn):
+    """Marker no-op (reference ``@not_to_static`` excludes a function from
+    AST transpilation; with tracing there is nothing to exclude)."""
+    return fn
+
+
+def save(function, path: str, input_spec: Sequence[InputSpec]):
+    """``paddle.jit.save``: serialize a traced function (StableHLO)."""
+    save_inference_model(path, function,
+                         [s.example() for s in input_spec],
+                         forward=lambda f, *xs: f(*xs))
+
+
+def load(path: str):
+    """``paddle.jit.load``: a Predictor; call ``.run(*inputs)``."""
+    return load_inference_model(path)

@@ -43,12 +43,21 @@ class Optimizer:
                  **kwargs):
         self.learning_rate = learning_rate
         self.grad_clip = grad_clip
+        # paddle.regularizer.L1Decay/L2Decay objects: their transform
+        # joins the gradient before moment accumulation (reference
+        # regularizer semantics); plain floats keep the per-class handling
+        reg_transform = None
+        if hasattr(weight_decay, "transform"):
+            reg_transform = weight_decay.transform()
+            weight_decay = 0.0
         self.weight_decay = float(weight_decay)
         self.multi_precision = multi_precision  # moments always fp32 here
         transforms = []
         if grad_clip is not None:
             transforms.append(grad_clip if isinstance(
                 grad_clip, T.GradientTransformation) else grad_clip.transform())
+        if reg_transform is not None:
+            transforms.append(reg_transform)
         transforms.extend(self._build(**kwargs))
         if not self._applies_own_lr:
             transforms.append(

@@ -1,0 +1,40 @@
+"""paddle.regularizer — L1/L2 weight decay declarations.
+
+Reference: ``python/paddle/regularizer.py`` (L1Decay/L2Decay objects
+attached to an optimizer or per-parameter; applied as gradient terms by
+the backward pass). Here they are declarative objects the optimizers
+unwrap: L2 folds into the existing decoupled/coupled weight-decay
+transforms, L1 adds a ``sign(p)`` gradient term.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import transform as T
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def transform(self) -> T.GradientTransformation:
+        return T.add_decayed_weights(self.coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def transform(self) -> T.GradientTransformation:
+        coeff = self.coeff
+
+        def update(grads, state, params=None):
+            out = T._map(
+                lambda g, p: g + coeff * jnp.sign(p).astype(g.dtype),
+                grads, params)
+            return out, state
+
+        return T.GradientTransformation(lambda p: (), update)

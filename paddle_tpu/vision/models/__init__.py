@@ -3,5 +3,5 @@ from paddle_tpu.vision.models.resnet import (
     ResNet, resnet18, resnet34, resnet50, resnet101,
 )
 from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg16
-from paddle_tpu.vision.models.mobilenet import MobileNetV2
+from paddle_tpu.vision.models.mobilenet import MobileNetV1, MobileNetV2
 from paddle_tpu.vision.models.vit import ViT, vit_b_16, vit_l_16

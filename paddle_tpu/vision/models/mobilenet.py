@@ -1,4 +1,4 @@
-"""MobileNetV2 (reference ``python/paddle/vision/models/mobilenetv2.py``).
+"""MobileNetV1/V2 (reference ``python/paddle/vision/models/mobilenetv{1,2}.py``).
 Depthwise convs = grouped conv (groups == channels), which XLA lowers to
 TPU-friendly contractions."""
 
@@ -10,7 +10,7 @@ from paddle_tpu.nn.common import Dropout, Linear
 from paddle_tpu.nn.conv import AdaptiveAvgPool2D, Conv2D
 from paddle_tpu.nn.norm import BatchNorm2D
 
-__all__ = ["MobileNetV2"]
+__all__ = ["MobileNetV1", "MobileNetV2"]
 
 
 class ConvBNReLU(Module):
@@ -76,3 +76,43 @@ class MobileNetV2(Module):
         x = self.head_conv(x, training=training)
         x = self.pool(x).reshape(x.shape[0], -1)
         return self.fc(self.dropout(x, training=training))
+
+
+class DepthwiseSeparable(Module):
+    """Depthwise 3x3 + pointwise 1x1 (reference mobilenetv1.py block)."""
+
+    def __init__(self, in_c, out_c, stride):
+        self.dw = ConvBNReLU(in_c, in_c, kernel=3, stride=stride,
+                             groups=in_c)
+        self.pw = ConvBNReLU(in_c, out_c, kernel=1)
+
+    def __call__(self, x, training: bool = False):
+        return self.pw(self.dw(x, training=training), training=training)
+
+
+class MobileNetV1(Module):
+    """MobileNetV1 (reference ``python/paddle/vision/models/mobilenetv1.py``)."""
+
+    def __init__(self, num_classes: int = 1000, scale: float = 1.0):
+        def c(ch):
+            return int(ch * scale)
+
+        self.stem = ConvBNReLU(3, c(32), stride=2)
+        cfg = [
+            # in, out, stride
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1), (512, 1024, 2), (1024, 1024, 1),
+        ]
+        self.blocks = tuple(DepthwiseSeparable(c(i), c(o), s)
+                            for i, o, s in cfg)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c(1024), num_classes)
+
+    def __call__(self, x, training: bool = False):
+        x = self.stem(x, training=training)
+        for b in self.blocks:
+            x = b(x, training=training)
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.fc(x)

@@ -1,0 +1,88 @@
+"""paddle.jit shim, regularizers, FLOPs counter, MobileNetV1."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import jit, nn, regularizer
+from paddle_tpu import optimizer as optim
+
+
+def test_to_static_compiles_and_runs():
+    calls = []
+
+    @jit.to_static
+    def f(x):
+        calls.append(1)
+        return x * 2 + 1
+
+    y = f(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(y), 3 * np.ones(4))
+    f(jnp.ones(4))
+    assert len(calls) == 1  # traced once: it IS compiled
+
+
+def test_to_static_input_spec_pretraces():
+    @jit.to_static(input_spec=[jit.InputSpec([2, 3], "float32")])
+    def g(x):
+        return x.sum(axis=1)
+
+    out = g(jnp.ones((2, 3)))
+    assert out.shape == (2,)
+    with pytest.raises(ValueError, match="dynamic dims"):
+        jit.InputSpec([None, 3])
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    def f(x):
+        return jnp.tanh(x) @ jnp.ones((4, 2))
+
+    spec = [jit.InputSpec([3, 4], "float32")]
+    jit.save(f, str(tmp_path / "fn"), spec)
+    pred = jit.load(str(tmp_path / "fn"))
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pred.run(x)),
+                               np.asarray(f(jnp.asarray(x))), rtol=1e-6)
+
+
+def test_l2_decay_matches_float_weight_decay():
+    paddle_tpu.seed(0)
+    g = jnp.asarray([1.0, -1.0])
+    p = jnp.asarray([2.0, 3.0])
+    o1 = optim.Momentum(0.1, weight_decay=0.01)
+    o2 = optim.Momentum(0.1, weight_decay=regularizer.L2Decay(0.01))
+    u1, _ = o1.update(g, o1.init(p), p)
+    u2, _ = o2.update(g, o2.init(p), p)
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u2), rtol=1e-6)
+
+
+def test_l1_decay_adds_sign_term():
+    g = jnp.zeros(3)
+    p = jnp.asarray([2.0, -3.0, 0.0])
+    o = optim.SGD(1.0, weight_decay=regularizer.L1Decay(0.5))
+    u, _ = o.update(g, o.init(p), p)
+    np.testing.assert_allclose(np.asarray(u), [-0.5, 0.5, 0.0], rtol=1e-6)
+
+
+def test_flops_counter_linear():
+    from paddle_tpu.hapi import flops
+
+    layer = nn.Linear(64, 32, bias=False)
+    n = flops(layer, jnp.ones((8, 64)))
+    # 2 * B * I * O multiply-adds
+    expected = 2 * 8 * 64 * 32
+    assert 0.5 * expected <= n <= 2 * expected, (n, expected)
+
+
+def test_mobilenet_v1_forward():
+    from paddle_tpu.vision.models import MobileNetV1
+
+    paddle_tpu.seed(0)
+    m = MobileNetV1(num_classes=10, scale=0.25)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 32, 32)
+                    .astype(np.float32))
+    out = m(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
