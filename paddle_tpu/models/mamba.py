@@ -57,22 +57,62 @@ class MambaConfig:
         return cls(**base)
 
 
-def selective_scan(u, delta, A, B, C, D):
+def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
     """y = SSM(u) via parallel associative scan.
 
     u:[B,T,Ei] delta:[B,T,Ei] A:[Ei,N] B,C:[B,T,N] D:[Ei]
+
+    ``chunk_size=None`` runs one associative scan over T — fastest, but
+    it materializes the [B, T, Ei, N] discretized operands (the reason
+    upstream Mamba needs a fused CUDA kernel). ``chunk_size=k`` instead
+    runs a ``lax.scan`` over T/k chunks carrying only the [B, Ei, N]
+    state, with the parallel scan inside each chunk: peak memory drops
+    by T/k at one extra sequential dimension — the memory shape a long-
+    context Mamba needs, kept XLA-fusible (no hand-written kernel; the
+    within-chunk scan fuses into large elementwise blocks on the VPU).
     """
-    # discretize: a = exp(Δ A)  [B,T,Ei,N];  b = Δ B u
-    dA = jnp.exp(delta[..., None] * A)                       # [B,T,Ei,N]
-    dBu = (delta * u)[..., None] * B[:, :, None, :]          # [B,T,Ei,N]
+    if chunk_size is None or chunk_size >= u.shape[1]:
+        dA = jnp.exp(delta[..., None] * A)                   # [B,T,Ei,N]
+        dBu = (delta * u)[..., None] * B[:, :, None, :]      # [B,T,Ei,N]
+
+        def combine(left, right):
+            a1, b1 = left
+            a2, b2 = right
+            return a1 * a2, a2 * b1 + b2
+
+        _, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+        y = jnp.einsum("btin,btn->bti", h, C)
+        return y + u * D
+
+    Bsz, T, Ei = u.shape
+    k = int(chunk_size)
+    if T % k:
+        raise ValueError(f"T={T} not divisible by chunk_size={k}")
 
     def combine(left, right):
         a1, b1 = left
         a2, b2 = right
         return a1 * a2, a2 * b1 + b2
 
-    _, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
-    y = jnp.einsum("btin,btn->bti", h, C)
+    def chunk_step(h0, args):
+        uc, dc, Bc, Cc = args                                # [B,k,...]
+        dA = jnp.exp(dc[..., None] * A)                      # [B,k,Ei,N]
+        dBu = (dc * uc)[..., None] * Bc[:, :, None, :]
+        cumA, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+        # inject the carried state: h_t += (prod_{<=t} dA) * h0
+        h = h + cumA * h0[:, None]
+        yc = jnp.einsum("btin,btn->bti", h, Cc)
+        return h[:, -1], yc
+
+    def to_chunks(x):
+        return jnp.moveaxis(
+            x.reshape(Bsz, T // k, k, *x.shape[2:]), 1, 0)   # [nc,B,k,...]
+
+    h0 = jnp.zeros((Bsz, Ei, A.shape[-1]), u.dtype)
+    _, ys = jax.lax.scan(chunk_step, h0,
+                         (to_chunks(u), to_chunks(delta),
+                          to_chunks(B), to_chunks(C)))
+    y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, T, Ei)
     return y + u * D
 
 

@@ -261,3 +261,33 @@ def test_hapi_eval_predict_sharded_on_mesh(devices8):
         assert len(sx.sharding.device_set) == 8
         preds = model.predict_batch(x)
         assert preds.shape == (16, 4)
+
+
+def test_selective_scan_chunked_matches_full():
+    """Chunked state-passing scan must be exact vs the one-shot scan,
+    values and gradients (the memory-scaling path for long-context
+    Mamba)."""
+    import jax
+    from paddle_tpu.models.mamba import selective_scan
+
+    rs = np.random.RandomState(0)
+    B, T, Ei, N = 2, 32, 4, 3
+    u = jnp.asarray(rs.randn(B, T, Ei).astype(np.float32))
+    delta = jnp.asarray(0.1 + np.abs(rs.randn(B, T, Ei)).astype(np.float32))
+    A = jnp.asarray(-np.abs(rs.randn(Ei, N)).astype(np.float32))
+    Bc = jnp.asarray(rs.randn(B, T, N).astype(np.float32))
+    Cc = jnp.asarray(rs.randn(B, T, N).astype(np.float32))
+    D = jnp.asarray(rs.randn(Ei).astype(np.float32))
+
+    full = selective_scan(u, delta, A, Bc, Cc, D)
+    for k in (4, 8, 16):
+        chunked = selective_scan(u, delta, A, Bc, Cc, D, chunk_size=k)
+        np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    g_full = jax.grad(lambda uu: selective_scan(
+        uu, delta, A, Bc, Cc, D).sum())(u)
+    g_chunk = jax.grad(lambda uu: selective_scan(
+        uu, delta, A, Bc, Cc, D, chunk_size=8).sum())(u)
+    np.testing.assert_allclose(np.asarray(g_chunk), np.asarray(g_full),
+                               rtol=2e-4, atol=2e-5)
