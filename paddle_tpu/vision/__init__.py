@@ -5,4 +5,7 @@ datasets: MNIST/CIFAR/..., transforms).
 """
 
 from paddle_tpu.vision import models, transforms
-from paddle_tpu.vision.datasets import MNIST, RandomImageDataset
+from paddle_tpu.vision.datasets import (
+    Cifar10, Cifar100, DatasetFolder, FashionMNIST, Flowers, ImageFolder,
+    MNIST, RandomImageDataset, VOC2012,
+)

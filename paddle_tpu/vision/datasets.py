@@ -16,7 +16,9 @@ import numpy as np
 
 from paddle_tpu.data.dataset import Dataset
 
-__all__ = ["MNIST", "RandomImageDataset"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012",
+           "RandomImageDataset"]
 
 
 def _read_idx(path: str) -> np.ndarray:
@@ -86,3 +88,203 @@ class RandomImageDataset(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    """Same idx wire format as MNIST (reference
+    ``vision/datasets/mnist.py`` FashionMNIST subclass); point ``root``
+    at the fashion-mnist idx files."""
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-version tar.gz (reference
+    ``vision/datasets/cifar.py``): pickled batches of
+    {data: [N, 3072] uint8, labels}. No download (zero egress)."""
+
+    _PREFIXES = ("data_batch", "test_batch")
+    _LABEL_KEYS = (b"labels", "labels")
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform=None, backend: str = "cv2"):
+        import pickle
+        import tarfile
+
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Cifar data_file {data_file!r} not found (no download "
+                "in this zero-egress environment)")
+        want = self._PREFIXES[0] if mode == "train" else self._PREFIXES[1]
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in sorted(tf.getmembers(), key=lambda m: m.name):
+                base = os.path.basename(member.name)
+                if not base.startswith(want):
+                    continue
+                batch = pickle.loads(tf.extractfile(member).read(),
+                                     encoding="bytes")
+                data = batch[b"data"] if b"data" in batch else batch["data"]
+                labs = None
+                for k in self._LABEL_KEYS:
+                    if k in batch:
+                        labs = batch[k]
+                        break
+                images.append(np.asarray(data, np.uint8))
+                labels.extend(labs)
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _PREFIXES = ("train", "test")
+    _LABEL_KEYS = (b"fine_labels", "fine_labels")
+
+
+def _default_image_loader(path: str) -> np.ndarray:
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory layout (reference
+    ``vision/datasets/folder.py``): ``root/class_x/xxx.ext``. The image
+    decoder is pluggable; defaults to PIL (npy files load directly)."""
+
+    EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+    def __init__(self, root: str, loader=None, extensions=None,
+                 transform=None):
+        self.loader = loader or _default_image_loader
+        self.transform = transform
+        exts = tuple(extensions or self.EXTS)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root!r}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(exts):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference ``vision/datasets/flowers.py``):
+    image tgz + scipy .mat labels/setids, all local paths."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file: str, label_file: str, setid_file: str,
+                 mode: str = "train", transform=None):
+        import tarfile
+
+        from scipy.io import loadmat
+
+        for p in (data_file, label_file, setid_file):
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"{p!r} not found (no download)")
+        labels = loadmat(label_file)["labels"][0]
+        ids = loadmat(setid_file)[self._SPLIT_KEY[mode]][0]
+        self._wanted = {f"image_{i:05d}.jpg": int(labels[i - 1]) - 1
+                        for i in ids}
+        self._tar_path = data_file
+        with tarfile.open(data_file) as tf:
+            self._members = [m.name for m in tf.getmembers()
+                             if os.path.basename(m.name) in self._wanted]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import io
+        import tarfile
+
+        from PIL import Image
+
+        name = self._members[idx]
+        with tarfile.open(self._tar_path) as tf:
+            data = tf.extractfile(name).read()
+        img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self._wanted[os.path.basename(name)])
+
+    def __len__(self):
+        return len(self._members)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference
+    ``vision/datasets/voc2012.py``): returns (image, label_mask) from the
+    local VOCtrainval tar."""
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 transform=None):
+        import tarfile
+
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(f"{data_file!r} not found (no download)")
+        self._tar_path = data_file
+        want = {"train": "train.txt", "valid": "val.txt",
+                "test": "val.txt"}[mode]
+        with tarfile.open(data_file) as tf:
+            names = {m.name for m in tf.getmembers()}
+            seg_list = next(n for n in names
+                            if n.endswith(f"Segmentation/{want}"))
+            ids = tf.extractfile(seg_list).read().decode().split()
+            self._pairs = []
+            for i in ids:
+                img = next((n for n in names
+                            if n.endswith(f"JPEGImages/{i}.jpg")), None)
+                msk = next((n for n in names
+                            if n.endswith(f"SegmentationClass/{i}.png")),
+                           None)
+                if img and msk:
+                    self._pairs.append((img, msk))
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import io
+        import tarfile
+
+        from PIL import Image
+
+        img_name, msk_name = self._pairs[idx]
+        with tarfile.open(self._tar_path) as tf:
+            img = np.asarray(Image.open(io.BytesIO(
+                tf.extractfile(img_name).read())).convert("RGB"))
+            mask = np.asarray(Image.open(io.BytesIO(
+                tf.extractfile(msk_name).read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask.astype(np.int64)
+
+    def __len__(self):
+        return len(self._pairs)

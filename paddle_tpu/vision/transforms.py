@@ -6,7 +6,11 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Compose", "Normalize", "Resize", "RandomCrop",
-           "RandomHorizontalFlip", "ToCHW", "CenterCrop"]
+           "RandomHorizontalFlip", "ToCHW", "CenterCrop", "BaseTransform",
+           "ToTensor", "Transpose", "Pad", "RandomVerticalFlip",
+           "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+           "HueTransform", "ColorJitter", "Grayscale", "RandomRotation",
+           "RandomResizedCrop"]
 
 
 class Compose:
@@ -84,3 +88,241 @@ class RandomHorizontalFlip:
         if self.rs.rand() < self.prob:
             return img[:, :, ::-1].copy()
         return img
+
+
+class BaseTransform:
+    """Subclassing point for custom transforms (reference BaseTransform;
+    the keys/data-structure plumbing of the reference collapses to plain
+    ``__call__`` here)."""
+
+    def __call__(self, img):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ToTensor:
+    """HWC uint8/float image → CHW float32 in [0, 1] (reference
+    to_tensor)."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        img = img.transpose(2, 0, 1).astype(np.float32)
+        if img.max() > 1.0:
+            img = img / 255.0
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode: str = "constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        self.padding = tuple(padding)  # left, top, right, bottom
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        l, t, r, b = self.padding
+        img = np.asarray(img)
+        pads = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(img, pads, constant_values=self.fill)
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.mode]
+        return np.pad(img, pads, mode=mode)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = float(prob)
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return img
+
+
+class BrightnessTransform:
+    """Scale brightness by U[max(0,1-v), 1+v] (reference semantics)."""
+
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return _clip_like(np.asarray(img, np.float32) * f, img)
+
+
+class ContrastTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        arr = np.asarray(img, np.float32)
+        mean = _gray(arr).mean()
+        return _clip_like(mean + f * (arr - mean), img)
+
+
+class SaturationTransform:
+    def __init__(self, value: float):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        arr = np.asarray(img, np.float32)
+        gray = _gray(arr)[..., None]
+        return _clip_like(gray + f * (arr - gray), img)
+
+
+class HueTransform:
+    """Shift hue by U[-v, v] (v <= 0.5), via the HSV round trip the
+    reference's cv2/PIL paths perform."""
+
+    def __init__(self, value: float):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        import colorsys
+
+        shift = np.random.uniform(-self.value, self.value)
+        arr = np.asarray(img, np.float32)
+        scale = 255.0 if arr.max() > 1.0 else 1.0
+        rgb = arr / scale
+        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+        maxc = rgb.max(-1)
+        minc = rgb.min(-1)
+        v = maxc
+        delta = maxc - minc
+        s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+        # hue in [0,1)
+        rc = np.where(delta > 0, (maxc - r) / np.maximum(delta, 1e-12), 0)
+        gc = np.where(delta > 0, (maxc - g) / np.maximum(delta, 1e-12), 0)
+        bc = np.where(delta > 0, (maxc - b) / np.maximum(delta, 1e-12), 0)
+        h = np.where(maxc == r, bc - gc,
+                     np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+        h = (h / 6.0) % 1.0
+        h = (h + shift) % 1.0
+        i = np.floor(h * 6.0)
+        f = h * 6.0 - i
+        p = v * (1.0 - s)
+        q = v * (1.0 - s * f)
+        t = v * (1.0 - s * (1.0 - f))
+        i = i.astype(np.int32) % 6
+        r2 = np.choose(i, [v, q, p, p, t, v])
+        g2 = np.choose(i, [t, v, v, q, p, p])
+        b2 = np.choose(i, [p, p, t, v, v, q])
+        out = np.stack([r2, g2, b2], axis=-1) * scale
+        return _clip_like(out, img)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels: int = 1):
+        self.num_output_channels = int(num_output_channels)
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        gray = _gray(arr)[..., None]
+        out = np.repeat(gray, self.num_output_channels, axis=-1)
+        return _clip_like(out, img)
+
+
+class RandomRotation:
+    def __init__(self, degrees):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+
+    def __call__(self, img):
+        from scipy import ndimage
+
+        angle = np.random.uniform(*self.degrees)
+        arr = np.asarray(img)
+        out = ndimage.rotate(arr.astype(np.float32), angle,
+                             axes=(0, 1), reshape=False, order=1)
+        return _clip_like(out, img)
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize, HWC layout (reference
+    RandomResizedCrop; the new-style transforms here follow the
+    reference's PIL/cv2 HWC convention — ``Resize``/``CenterCrop`` above
+    predate them and stay CHW for the MNIST pipelines)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    @staticmethod
+    def _resize_hwc(arr, size):
+        h, w = arr.shape[:2]
+        oh, ow = size
+        yi = (np.arange(oh) * h // oh).clip(0, h - 1)
+        xi = (np.arange(ow) * w // ow).clip(0, w - 1)
+        return arr[yi][:, xi]
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                crop = arr[top:top + ch, left:left + cw]
+                return self._resize_hwc(crop, self.size)
+        side = min(h, w)
+        top, left = (h - side) // 2, (w - side) // 2
+        return self._resize_hwc(arr[top:top + side, left:left + side],
+                                self.size)
+
+
+def _gray(arr):
+    if arr.ndim == 3 and arr.shape[-1] == 3:
+        return arr @ np.asarray([0.299, 0.587, 0.114], np.float32)
+    return arr.reshape(arr.shape[:2] + (-1,)).mean(-1)
+
+
+def _clip_like(arr, ref):
+    ref = np.asarray(ref)
+    if ref.dtype == np.uint8:
+        return np.clip(arr, 0, 255).astype(np.uint8)
+    return arr.astype(np.float32)
