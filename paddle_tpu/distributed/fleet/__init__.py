@@ -25,6 +25,7 @@ from paddle_tpu.distributed.fleet.strategy_compiler import (
     TrainState,
     build_train_step,
 )
+from paddle_tpu.distributed.fleet import metrics
 from paddle_tpu.core.strategy import DistributedStrategy
 from paddle_tpu.parallel import mesh as _mesh_mod
 from paddle_tpu.parallel.env import init_parallel_env

@@ -1,0 +1,66 @@
+"""Distributed metric aggregation (reference
+``python/paddle/distributed/fleet/metrics/metric.py``: sum/max/min/acc/
+auc helpers all-reducing numpy values over trainers via fleet util).
+
+TPU mapping: cross-host aggregation rides the same coordination service
+collectives as training (``multihost_utils.process_allgather``); in a
+single process they are identities, so metric code is topology-agnostic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "mean", "acc", "auc"]
+
+
+def _gather(value) -> np.ndarray:
+    """[world, ...] stack of every process's value."""
+    import jax
+
+    value = np.asarray(value)
+    if jax.process_count() == 1:
+        return value[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(value))
+
+
+def sum(value):  # noqa: A001 - reference names kept
+    return _gather(value).sum(axis=0)
+
+
+def max(value):  # noqa: A001
+    return _gather(value).max(axis=0)
+
+
+def min(value):  # noqa: A001
+    return _gather(value).min(axis=0)
+
+
+def mean(value):
+    return _gather(value).mean(axis=0)
+
+
+def acc(correct, total):
+    """Global accuracy from per-trainer (correct, total) counts."""
+    c = _gather(correct).sum()
+    t = _gather(total).sum()
+    return float(c) / float(np.maximum(t, 1))
+
+
+def auc(stat_pos, stat_neg, num_thresholds: int | None = None):
+    """Global AUC from per-trainer positive/negative histogram buckets
+    (the reference's distributed AUC: bucket counts all-reduced, then one
+    trapezoid pass)."""
+    pos = _gather(np.asarray(stat_pos, np.float64)).sum(axis=0)
+    neg = _gather(np.asarray(stat_neg, np.float64)).sum(axis=0)
+    # walk thresholds from high to low accumulating TP/FP
+    tp = np.cumsum(pos[::-1])
+    fp = np.cumsum(neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    tpr = np.concatenate([[0.0], tp / tot_pos])
+    fpr = np.concatenate([[0.0], fp / tot_neg])
+    return float(np.trapezoid(tpr, fpr))

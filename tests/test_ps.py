@@ -371,3 +371,20 @@ def test_native_feed_throughput_vs_python(tmp_path):
                                    np.int64))
     python_t = time.perf_counter() - t0
     assert native_t < python_t, (native_t, python_t)
+
+
+# ---------------------------------------------------------------------------
+# fleet distributed metrics (reference fleet/metrics/metric.py)
+# ---------------------------------------------------------------------------
+
+def test_fleet_metrics_single_process():
+    from paddle_tpu.distributed.fleet import metrics as fm
+
+    assert fm.sum(np.asarray([1.0, 2.0])).tolist() == [1.0, 2.0]
+    assert fm.acc(np.asarray(8), np.asarray(10)) == 0.8
+    # perfect separation → auc 1; random → 0.5-ish
+    pos = np.zeros(10); pos[9] = 100     # all positives score high
+    neg = np.zeros(10); neg[0] = 100     # all negatives score low
+    assert fm.auc(pos, neg) > 0.99
+    uniform = np.ones(10)
+    assert abs(fm.auc(uniform, uniform) - 0.5) < 1e-6
