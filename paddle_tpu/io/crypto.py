@@ -1,0 +1,79 @@
+"""Encrypted model artifacts.
+
+Reference: ``paddle/fluid/framework/io/crypto/aes_cipher.cc`` +
+``cipher_utils.cc`` (AES-encrypted inference models loaded by the
+predictor with a user key). Modernized: AES-256-GCM (authenticated —
+tampered artifacts fail loudly, which the reference's CBC mode cannot
+guarantee) with a scrypt-derived key from a passphrase.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["encrypt_bytes", "decrypt_bytes", "save_state_dict_encrypted",
+           "load_state_dict_encrypted", "generate_key"]
+
+_MAGIC = b"PTPUENC1"
+
+
+def generate_key() -> bytes:
+    """Random 32-byte key (CipherUtils::GenKey analogue)."""
+    return os.urandom(32)
+
+
+def _derive(key: bytes | str, salt: bytes) -> bytes:
+    if isinstance(key, bytes) and len(key) == 32:
+        return key
+    from cryptography.hazmat.primitives.kdf.scrypt import Scrypt
+
+    raw = key.encode() if isinstance(key, str) else key
+    return Scrypt(salt=salt, length=32, n=2 ** 14, r=8, p=1).derive(raw)
+
+
+def encrypt_bytes(data: bytes, key: bytes | str) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    salt = os.urandom(16)
+    nonce = os.urandom(12)
+    k = _derive(key, salt)
+    ct = AESGCM(k).encrypt(nonce, data, _MAGIC)
+    return _MAGIC + salt + nonce + ct
+
+
+def decrypt_bytes(blob: bytes, key: bytes | str) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    if blob[:8] != _MAGIC:
+        raise ValueError("not a paddle_tpu encrypted artifact")
+    salt, nonce, ct = blob[8:24], blob[24:36], blob[36:]
+    k = _derive(key, salt)
+    return AESGCM(k).decrypt(nonce, ct, _MAGIC)
+
+
+def save_state_dict_encrypted(model, path: str, key: bytes | str) -> None:
+    """Encrypted counterpart of ``io.save_state_dict``."""
+    import io as _io
+
+    import numpy as np
+
+    from paddle_tpu.io.checkpoint import state_dict
+
+    buf = _io.BytesIO()
+    np.savez(buf, **state_dict(model))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(encrypt_bytes(buf.getvalue(), key))
+
+
+def load_state_dict_encrypted(model, path: str, key: bytes | str):
+    import io as _io
+
+    import numpy as np
+
+    from paddle_tpu.io.checkpoint import set_state_dict
+
+    with open(path, "rb") as f:
+        data = decrypt_bytes(f.read(), key)
+    with np.load(_io.BytesIO(data)) as npz:
+        return set_state_dict(model, dict(npz))

@@ -110,3 +110,33 @@ def test_auto_checkpoint_fresh_run_no_resume(tmp_path):
     losses, r = _toy_training(tmp_path, 2, ckdir=tmp_path / "fresh")
     assert not r.resumed
     assert sorted(losses) == [0, 1]
+
+
+def test_encrypted_state_dict_roundtrip(tmp_path):
+    """AES-GCM encrypted save/load (reference aes_cipher.cc role):
+    round-trips with the right key, fails loudly with the wrong key or a
+    tampered file."""
+    paddle_tpu.seed(5)
+    model = nn.Linear(4, 3)
+    path = str(tmp_path / "model.enc")
+    io.save_state_dict_encrypted(model, path, key="hunter2")
+
+    blank = nn.Linear(4, 3)
+    restored = io.load_state_dict_encrypted(blank, path, key="hunter2")
+    np.testing.assert_array_equal(np.asarray(restored.weight),
+                                  np.asarray(model.weight))
+
+    with pytest.raises(Exception):
+        io.load_state_dict_encrypted(blank, path, key="wrong")
+
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(Exception):
+        io.load_state_dict_encrypted(blank, path, key="hunter2")
+
+    kb = io.generate_key()
+    io.save_state_dict_encrypted(model, path, key=kb)
+    r2 = io.load_state_dict_encrypted(blank, path, key=kb)
+    np.testing.assert_array_equal(np.asarray(r2.weight),
+                                  np.asarray(model.weight))
