@@ -118,6 +118,19 @@ def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
 
 
 def _self_attention_wrapper(inner, q, k, v, mesh, axis, causal, scale):
+    # Composition with other manual collectives (the pipeline's shard_map
+    # over "pp"): inside a manual computation the ambient mesh is
+    # *abstract* and must be the one handed to the nested shard_map; and
+    # if ``axis`` itself is already manual (the pipeline runs stages
+    # sequence-sharded), there is nothing to wrap — call the ring body
+    # directly in the per-device view.
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and am.shape and axis in am.shape:
+        types = dict(zip(am.axis_names, am.axis_types))
+        if types[axis] == jax.sharding.AxisType.Manual:
+            return inner(q, k, v, axis=axis, causal=causal, scale=scale)
+        if any(t == jax.sharding.AxisType.Manual for t in am.axis_types):
+            mesh = am  # nested shard_map must reference the context mesh
     spec = P(None, axis, None, None)
     f = jax.shard_map(
         partial(inner, axis=axis, causal=causal, scale=scale),

@@ -332,3 +332,20 @@ def test_fp16_allreduce_matches_fp32_reduction(devices8):
     ref_losses, _, _ = run_steps(s0, lr=1e-3)
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-2)
     assert losses[-1] < losses[0]
+
+
+def test_pipeline_composes_with_ring_attention(devices8):
+    """pp=2 x sp=2 x dp=2: ring attention inside the pipeline's manual
+    shard_map (the nested-manual composition that needs the abstract-mesh
+    handling + GSPMD fallback). Losses must match plain DP."""
+    s = DistributedStrategy()
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.pipeline.num_microbatches = 2
+    s.sequence_parallel.enable = True
+    s.sequence_parallel.degree = 2
+    s.sequence_parallel.mode = "ring"
+    cfg = LlamaConfig.tiny(num_layers=4)
+    losses, _, _ = run_steps(s, cfg=cfg)
+    ref, _, _ = run_steps(DistributedStrategy(), cfg=cfg)
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
