@@ -1,0 +1,183 @@
+"""ERNIE family: bidirectional encoder with MLM pretraining.
+
+BASELINE.json config parity: "ERNIE-3.0 / GPT-3 6.7B with tensor+pipeline
+parallel" — the encoder-side flagship. Architecture follows the
+ERNIE/BERT recipe (token+position+segment embeddings, post-LN
+transformer encoder, pooler, MLM + sentence-order heads) with the same
+fsdp×tp sharding layout as the decoder models; layers are scan-stacked
+(nn.ScannedBlocks) so the pipeline/recompute strategies compose
+unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import rng
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common import Dropout, Embedding, Linear
+from paddle_tpu.nn.initializer import Normal
+from paddle_tpu.nn.norm import LayerNorm
+from paddle_tpu.nn.scan import ScannedBlocks
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining"]
+
+
+@dataclass(frozen=True)
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 4
+    dropout: float = 0.1
+    dtype: str = "bfloat16"
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    init_std: float = 0.02
+
+    @classmethod
+    def base(cls) -> "ErnieConfig":
+        return cls()
+
+    @classmethod
+    def large(cls) -> "ErnieConfig":
+        return cls(hidden_size=1024, num_layers=24, num_heads=16,
+                   intermediate_size=4096)
+
+    @classmethod
+    def ernie3_xl(cls) -> "ErnieConfig":
+        """ERNIE-3.0-style scale-up (shared-backbone width)."""
+        return cls(hidden_size=4096, num_layers=48, num_heads=64,
+                   intermediate_size=16384, remat=True)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ErnieConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, max_seq_len=64,
+                    dropout=0.0, dtype="float32")
+        base.update(kw)
+        return cls(**base)
+
+
+class ErnieBlock(Module):
+    """Post-LN encoder block (BERT/ERNIE convention: residual then LN)."""
+
+    def __init__(self, cfg: ErnieConfig, key=None):
+        keys = rng.split_key(key, 4)
+        E, I_ = cfg.hidden_size, cfg.intermediate_size
+        dtype = jnp.dtype(cfg.dtype)
+        init = Normal(0.0, cfg.init_std)
+        out_init = Normal(0.0, cfg.init_std / math.sqrt(2 * cfg.num_layers))
+        self.wqkv = Linear(E, 3 * E, weight_init=init, dtype=dtype,
+                           key=keys[0], pspec=P("fsdp", "tp"))
+        self.wo = Linear(E, E, weight_init=out_init, dtype=dtype,
+                         key=keys[1], pspec=P("tp", "fsdp"))
+        self.attn_ln = LayerNorm(E, dtype=dtype)
+        self.fc1 = Linear(E, I_, weight_init=init, dtype=dtype,
+                          key=keys[2], pspec=P("fsdp", "tp"))
+        self.fc2 = Linear(I_, E, weight_init=out_init, dtype=dtype,
+                          key=keys[3], pspec=P("tp", "fsdp"))
+        self.ffn_ln = LayerNorm(E, dtype=dtype)
+        self.drop = Dropout(cfg.dropout)
+        self.num_heads = cfg.num_heads
+        self.head_dim = E // cfg.num_heads
+
+    def __call__(self, x, mask=None, training: bool = False):
+        B, T, E = x.shape
+        qkv = self.wqkv(x).reshape(B, T, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = F.scaled_dot_product_attention(q, k, v, mask=mask, causal=False)
+        x = self.attn_ln(
+            x + self.drop(self.wo(a.reshape(B, T, E)), training=training))
+        h = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        return self.ffn_ln(x + self.drop(h, training=training))
+
+
+class ErnieModel(Module):
+    """Backbone: embeddings → encoder stack → (sequence_output, pooled)."""
+
+    def __init__(self, cfg: ErnieConfig, key=None):
+        keys = rng.split_key(key, 5 + cfg.num_layers)
+        dtype = jnp.dtype(cfg.dtype)
+        init = Normal(0.0, cfg.init_std)
+        E = cfg.hidden_size
+        self.word_emb = Embedding(cfg.vocab_size, E, weight_init=init,
+                                  dtype=dtype, key=keys[0],
+                                  pspec=P("tp", "fsdp"))
+        self.pos_emb = Embedding(cfg.max_seq_len, E, weight_init=init,
+                                 dtype=dtype, key=keys[1],
+                                 pspec=P(None, "fsdp"))
+        self.type_emb = Embedding(cfg.type_vocab_size, E, weight_init=init,
+                                  dtype=dtype, key=keys[2])
+        self.emb_ln = LayerNorm(E, dtype=dtype)
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = ScannedBlocks(
+            lambda i: ErnieBlock(cfg, key=keys[5 + i]), cfg.num_layers,
+            remat=cfg.remat, remat_policy=cfg.remat_policy)
+        self.pooler = Linear(E, E, weight_init=init, dtype=dtype,
+                             key=keys[3])
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 training: bool = False):
+        T = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_emb(input_ids) + self.pos_emb(jnp.arange(T))
+             + self.type_emb(token_type_ids))
+        x = self.drop(self.emb_ln(x), training=training)
+        mask = None
+        if attention_mask is not None:
+            # [B, T] 1=keep → additive [B, 1, 1, T]
+            mask = (1.0 - attention_mask[:, None, None, :]) * -1e9
+        x = self.blocks(x, mask=mask, training=training)
+        pooled = jnp.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(Module):
+    """MLM + sentence-order heads (the ERNIE pretraining objectives)."""
+
+    def __init__(self, cfg: ErnieConfig, key=None):
+        k1, k2, k3 = rng.split_key(key, 3)
+        dtype = jnp.dtype(cfg.dtype)
+        init = Normal(0.0, cfg.init_std)
+        self.ernie = ErnieModel(cfg, key=k1)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                    weight_init=init, dtype=dtype, key=k2)
+        self.mlm_ln = LayerNorm(cfg.hidden_size, dtype=dtype)
+        self.sop_head = Linear(cfg.hidden_size, 2, weight_init=init,
+                               dtype=dtype, key=k3)
+        self.config = cfg
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 training: bool = False):
+        seq, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
+                                 training=training)
+        h = self.mlm_ln(F.gelu(self.mlm_transform(seq), approximate=True))
+        # decode against the (tied) word embedding — ERNIE ties MLM output
+        mlm_logits = h @ self.ernie.word_emb.weight.T
+        sop_logits = self.sop_head(pooled)
+        return mlm_logits, sop_logits
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None, sop_labels=None, ignore_index: int = -100,
+             training: bool = True):
+        """MLM cross-entropy over masked positions (+ optional
+        sentence-order loss)."""
+        mlm_logits, sop_logits = self(input_ids, token_type_ids,
+                                      attention_mask, training=training)
+        loss = F.cross_entropy(mlm_logits.astype(jnp.float32), labels,
+                               ignore_index=ignore_index)
+        if sop_labels is not None:
+            loss = loss + F.cross_entropy(
+                sop_logits.astype(jnp.float32), sop_labels)
+        return loss
