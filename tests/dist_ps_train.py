@@ -1,0 +1,59 @@
+"""PS-mode multi-process worker for launcher tests: rank 0 hosts the
+parameter server, all ranks train a sparse embedding against it (the
+reference's ps-mode TestDistBase workload shape)."""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import Communicator, ParameterServer, PSClient
+
+
+def main():
+    out_dir = os.environ.get("TOY_OUT", ".")
+    rank = int(os.environ["PTPU_RANK"])
+    world = int(os.environ["PTPU_NUM_PROCESSES"])
+    port = int(os.environ["PS_PORT"])
+
+    server = None
+    if rank == 0:
+        server = ParameterServer(port=port).start()
+    # every rank (incl. 0, which also trains) connects to the service
+    import time
+    for _ in range(100):
+        try:
+            client = PSClient(f"127.0.0.1:{port}")
+            break
+        except OSError:
+            time.sleep(0.1)
+    comm = Communicator(client, "sync")
+    comm.create_table("emb", 4, optimizer="sgd", lr=0.05, seed=1)
+
+    ids = np.arange(rank * 4, rank * 4 + 4)      # disjoint rows per rank
+    target = np.zeros((4, 4), np.float32)
+    client.barrier(world)
+    losses = []
+    for _ in range(20):
+        rows = comm.pull("emb", ids)
+        losses.append(float(((rows - target) ** 2).sum()))
+        comm.push_grad("emb", ids, 2 * (rows - target))
+    client.barrier(world)
+
+    with open(os.path.join(out_dir, f"ps_losses.{rank}.json"), "w") as f:
+        json.dump(losses, f)
+    client.barrier(world)
+    if server is not None:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

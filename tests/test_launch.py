@@ -72,3 +72,28 @@ def _logs(tmp_path):
         for p in logdir.iterdir():
             out[p.name] = p.read_text()[-2000:]
     return out
+
+
+@pytest.mark.slow
+def test_launch_ps_mode_2proc(tmp_path):
+    """rank 0 hosts the PS service; both ranks train disjoint sparse rows
+    through it (the reference's PS-mode distributed test shape)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["TOY_OUT"] = str(tmp_path)
+    env["PS_PORT"] = str(port)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc", "2", "--log_dir", str(tmp_path / "logs"),
+         os.path.join(REPO, "tests", "dist_ps_train.py")],
+        env=env, cwd=REPO, timeout=240, capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, _logs(tmp_path))
+    for rank in range(2):
+        with open(tmp_path / f"ps_losses.{rank}.json") as f:
+            losses = json.load(f)
+        assert losses[-1] < losses[0] * 0.1, (rank, losses[:3], losses[-3:])
