@@ -71,6 +71,7 @@ from paddle_tpu import tensor_ops as tensor  # noqa: E402
 from paddle_tpu import jit  # noqa: E402
 from paddle_tpu import regularizer  # noqa: E402
 from paddle_tpu import text  # noqa: E402
+from paddle_tpu.hapi.flops import flops, summary  # noqa: E402
 
 __all__ = [
     "__version__",

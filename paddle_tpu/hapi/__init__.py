@@ -9,4 +9,4 @@ from paddle_tpu.hapi.callbacks import (
     ProgBarLogger,
 )
 from paddle_tpu.hapi.model import Model
-from paddle_tpu.hapi.flops import flops  # noqa: E402
+from paddle_tpu.hapi.flops import flops, summary  # noqa: E402

@@ -86,3 +86,13 @@ def test_mobilenet_v1_forward():
     out = m(x)
     assert out.shape == (2, 10)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_summary_counts_params_and_buffers():
+    import paddle_tpu as P
+
+    paddle_tpu.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    out = P.summary(m)
+    assert "Total params: 90" in out
+    assert "trainable 74" in out and "buffers 16" in out
