@@ -400,3 +400,39 @@ def test_ernie_pretraining_trains_hybrid(devices8):
     am = jnp.asarray((rs.rand(2, 32) > 0.3).astype(np.float32))
     out, pooled = m2.ernie(jnp.asarray(masked[:2]), attention_mask=am)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_strategy_json_roundtrip_all_configs():
+    """Every strategy section (incl. the round-2 additions: fp16_allreduce,
+    expert_parallel, localsgd) survives the JSON round trip — the
+    reference's proto-serializable-config contract."""
+    s = DistributedStrategy()
+    s.amp.enable = True
+    s.amp.dtype = "float16"
+    s.recompute.enable = True
+    s.gradient_merge.enable = True
+    s.gradient_merge.k_steps = 4
+    s.localsgd.enable = True
+    s.localsgd.k_steps = 3
+    s.fp16_allreduce.enable = True
+    s.fp16_allreduce.dtype = "float16"
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 4
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.pipeline.schedule = "1f1b"
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    s.sequence_parallel.enable = True
+    s.sequence_parallel.mode = "ulysses"
+    s.expert_parallel.enable = True
+    s.expert_parallel.degree = 8
+
+    s2 = DistributedStrategy.from_json(s.to_json())
+    assert s2.to_json() == s.to_json()
+    assert s2.localsgd.k_steps == 3
+    assert s2.fp16_allreduce.dtype == "float16"
+    assert s2.expert_parallel.degree == 8
+    assert s2.pipeline.schedule == "1f1b"
+    assert s2.parallel_degrees() == s.parallel_degrees()
