@@ -143,6 +143,12 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     use_1f1b = use_pp and pp_cfg.schedule == "1f1b"
     if use_pp and (strategy.sequence_parallel.enable
                    and strategy.sequence_parallel.degree > 1):
+        if strategy.sequence_parallel.mode == "ulysses":
+            raise NotImplementedError(
+                "pipeline + Ulysses sequence parallelism: the nested "
+                "all_to_all aborts inside the XLA compiler today — use "
+                "sequence_parallel.mode='ring' with pipelines (parity-"
+                "tested), or Ulysses without pp")
         # pp∘sp nests a shard_map (ring attention) inside a manual
         # computation (the pipeline); the Shardy partitioner cannot lower
         # nested manual axes yet — fall back to GSPMD for this build.

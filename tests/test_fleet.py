@@ -436,3 +436,20 @@ def test_strategy_json_roundtrip_all_configs():
     assert s2.expert_parallel.degree == 8
     assert s2.pipeline.schedule == "1f1b"
     assert s2.parallel_degrees() == s.parallel_degrees()
+
+
+def test_pipeline_rejects_ulysses(devices8):
+    """pp + Ulysses aborts inside the XLA compiler (nested all_to_all);
+    the strategy compiler must refuse it loudly and point at ring mode."""
+    s = DistributedStrategy()
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.sequence_parallel.enable = True
+    s.sequence_parallel.degree = 2
+    s.sequence_parallel.mode = "ulysses"
+    mesh = M.mesh_from_strategy(s)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
+    with M.MeshContext(mesh):
+        with pytest.raises(NotImplementedError, match="ring"):
+            dist.fleet.build_train_step(
+                model, optimizer=optim.AdamW(1e-3), strategy=s, mesh=mesh)
