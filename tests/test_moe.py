@@ -140,3 +140,39 @@ def test_moe_expert_parallel_matches_single(devices8):
 
     dp_losses, _ = run(DistributedStrategy())
     np.testing.assert_allclose(ep_losses, dp_losses, rtol=2e-4)
+
+
+def test_moe_ep_fsdp_hybrid(devices8):
+    """ep=2 x fsdp=2 x dp=2: expert weights sharded over BOTH ep and fsdp
+    (ZeRO-3 inside each expert shard); loss parity with dp-only."""
+    def run(strategy):
+        paddle_tpu.seed(11)
+        cfg = MoEConfig.tiny(num_experts=2)
+        model = MoEForCausalLM(cfg)
+        mesh = M.mesh_from_strategy(strategy)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 16))
+                          .astype(np.int32))
+        with M.MeshContext(mesh):
+            step = dist.fleet.build_train_step(
+                model, optimizer=optim.AdamW(1e-2), strategy=strategy,
+                mesh=mesh)
+            state = step.init_state(model)
+            batch = step.shard_batch({"input_ids": ids, "labels": ids})
+            losses = []
+            for i in range(3):
+                state, metrics = step(state, batch, jax.random.PRNGKey(i))
+                losses.append(float(metrics["loss"]))
+        return losses, state
+
+    s = DistributedStrategy()
+    s.expert_parallel.enable = True
+    s.expert_parallel.degree = 2
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 2
+    hybrid_losses, st = run(s)
+    w = st.model.blocks[0].moe.w_gate
+    assert w.sharding.spec[0] == "ep" and "fsdp" in str(w.sharding.spec)
+    ref_losses, _ = run(DistributedStrategy())
+    np.testing.assert_allclose(hybrid_losses, ref_losses, rtol=2e-4)
