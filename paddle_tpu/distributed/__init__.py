@@ -24,10 +24,13 @@ def __getattr__(name):
     if name == "launch":
         from paddle_tpu.distributed import launch
         return launch
+    if name == "spawn":
+        from paddle_tpu.distributed.launch import spawn
+        return spawn
     raise AttributeError(name)
 
 
-__all__ = ["fleet", "launch", "DistributedStrategy", "init_parallel_env",
+__all__ = ["fleet", "launch", "spawn", "DistributedStrategy", "init_parallel_env",
            "ParallelEnv", "get_rank", "get_world_size", "all_reduce",
            "all_gather", "reduce_scatter", "broadcast", "reduce",
            "all_to_all", "barrier", "ReduceOp"]

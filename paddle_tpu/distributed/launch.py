@@ -147,3 +147,62 @@ def main(argv: list[str] | None = None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+def spawn(func, args=(), nprocs: int = 1, *, coordinator: str | None = None,
+          extra_env: dict[str, str] | None = None, timeout: float = 600.0):
+    """``paddle.distributed.spawn`` equivalent (reference
+    ``python/paddle/distributed/spawn.py:238``): run ``func(*args)`` in
+    ``nprocs`` processes with the PTPU_* env wired, wait for all, and
+    tear the pod down if any worker fails.
+
+    ``func`` must be importable (module-level) — the workers are real
+    ``spawn``-context processes, same as the reference.
+    """
+    import multiprocessing as mp
+
+    if coordinator is None:
+        coordinator = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PTPU_COORDINATOR": coordinator,
+            "PTPU_NUM_PROCESSES": str(nprocs),
+            "PTPU_RANK": str(rank),
+            "PTPU_LOCAL_RANK": str(rank),
+            **(extra_env or {}),
+        }
+        p = ctx.Process(target=_spawn_main, args=(func, args, env),
+                        daemon=False)
+        p.start()
+        procs.append(p)
+
+    deadline = time.time() + timeout
+    try:
+        while True:
+            codes = [p.exitcode for p in procs]
+            bad = [c for c in codes if c not in (None, 0)]
+            if bad:
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                raise RuntimeError(f"spawn worker failed with exit {bad[0]}")
+            if all(c == 0 for c in codes):
+                return
+            if time.time() > deadline:
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                raise TimeoutError(f"spawn workers still running after "
+                                   f"{timeout}s")
+            time.sleep(_POLL_S)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+
+def _spawn_main(func, args, env):
+    os.environ.update(env)
+    func(*args)

@@ -73,3 +73,19 @@ def main():
 
 if __name__ == "__main__":
     main()
+
+
+def spawn_worker(out_dir):
+    """Module-level worker for distributed.spawn tests."""
+    env = dist.init_parallel_env()
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                          in_specs=P(), out_specs=P()))
+    out = f(jnp.asarray([1.0 * (env.rank + 1)]))
+    # replicated psum: every rank sees sum over ranks
+    with open(os.path.join(out_dir, f"spawn.{env.rank}.txt"), "w") as fh:
+        fh.write(str(float(np.asarray(jax.device_get(out))[0])))

@@ -97,3 +97,25 @@ def test_launch_ps_mode_2proc(tmp_path):
         with open(tmp_path / f"ps_losses.{rank}.json") as f:
             losses = json.load(f)
         assert losses[-1] < losses[0] * 0.1, (rank, losses[:3], losses[-3:])
+
+
+@pytest.mark.slow
+def test_spawn_runs_collective(tmp_path):
+    """distributed.spawn: 2 module-level workers psum over the
+    coordination service (reference spawn.py semantics)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import os; os.environ['JAX_PLATFORMS']='cpu'; "
+         "os.environ.pop('XLA_FLAGS', None); "
+         "import sys; sys.path.insert(0, 'tests'); "
+         "from paddle_tpu.distributed.launch import spawn; "
+         "from dist_toy_train import spawn_worker; "
+         f"spawn(spawn_worker, args=({str(tmp_path)!r},), nprocs=2)"],
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                 "PYTHONPATH", "")},
+        cwd=REPO, timeout=240, capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    vals = [float(open(tmp_path / f"spawn.{r}.txt").read())
+            for r in range(2)]
+    assert vals == [3.0, 3.0], vals  # 1 + 2 on both ranks
