@@ -151,9 +151,13 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                 "tested), or Ulysses without pp")
         # pp∘sp nests a shard_map (ring attention) inside a manual
         # computation (the pipeline); the Shardy partitioner cannot lower
-        # nested manual axes yet — fall back to GSPMD for this build.
+        # nested manual axes yet — this step compiles under GSPMD instead.
+        # Scoped per-call (not a global flip): a sticky global would break
+        # *other* steps, e.g. plain-sp grads abort under GSPMD on CPU.
         # (Tracked upstream; revisit when sdy supports nesting.)
-        jax.config.update("jax_use_shardy_partitioner", False)
+        use_gspmd = True
+    else:
+        use_gspmd = False
     if use_1f1b:
         if strategy.amp.enable:
             raise NotImplementedError(
@@ -383,7 +387,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
     return CompiledTrainStep(step_fn, optimizer, scaler, mesh, param_specs,
                              state_specs, _data_spec, k_steps, donate,
-                             _prepare)
+                             _prepare, use_gspmd=use_gspmd)
 
 
 class CompiledTrainStep:
@@ -391,7 +395,7 @@ class CompiledTrainStep:
 
     def __init__(self, step_fn, optimizer, scaler, mesh, param_specs,
                  state_specs_fn, data_spec_fn, k_steps, donate,
-                 prepare_model=lambda m: m):
+                 prepare_model=lambda m: m, use_gspmd: bool = False):
         self._step_fn = step_fn
         self._optimizer = optimizer
         self._scaler = scaler
@@ -402,6 +406,7 @@ class CompiledTrainStep:
         self._k_steps = k_steps
         self._donate = donate
         self._prepare_model = prepare_model
+        self._use_gspmd = use_gspmd
         self._jitted = None
 
     @property
@@ -450,7 +455,18 @@ class CompiledTrainStep:
                 out_shardings=(state_shardings, None),
                 donate_argnums=(0,) if self._donate else (),
             )
-        new_state, metrics = self._jitted(state, batch, key)
+        if self._use_gspmd:
+            # scoped partitioner switch: compile (first call) happens under
+            # GSPMD, restore immediately — the cached executable keeps its
+            # partitioning; other steps keep Shardy
+            prev = jax.config.jax_use_shardy_partitioner
+            jax.config.update("jax_use_shardy_partitioner", False)
+            try:
+                new_state, metrics = self._jitted(state, batch, key)
+            finally:
+                jax.config.update("jax_use_shardy_partitioner", prev)
+        else:
+            new_state, metrics = self._jitted(state, batch, key)
         if "check/grads_finite" in metrics:
             bad = [name for name in ("loss", "grads", "params")
                    if not bool(metrics[f"check/{name}_finite"])]
