@@ -28,6 +28,7 @@ import math
 from dataclasses import dataclass
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -212,6 +213,8 @@ class LlamaBlock(Module):
         new_cache = None
         if cache is not None:
             attn_out, new_cache = attn_out
+        # tag for the "save_attn_out" remat policy (no-op otherwise)
+        attn_out = jax.ad_checkpoint.checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
         x = x + self.mlp(self.mlp_norm(x))
         return x if new_cache is None else (x, new_cache)

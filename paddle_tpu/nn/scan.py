@@ -34,6 +34,12 @@ REMAT_POLICIES = {
         jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     # recompute everything (max memory saving, ZeRO-3 friendly)
     "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    # save ONLY the attention outputs (tagged via checkpoint_name in the
+    # blocks): the backward skips recomputing attention — the most
+    # expensive recompute — at one [B, T, E] residual per layer of HBM,
+    # an order less than dots_saveable
+    "save_attn_out":
+        jax.checkpoint_policies.save_only_these_names("attn_out"),
 }
 
 
