@@ -1,0 +1,115 @@
+// C++-level unit tests for the native host runtime (sparse table + data
+// feed), mirroring the reference's colocated *_test.cc files (e.g.
+// async_sparse_param_update_recorder_test.cc). Plain assert-based — no
+// gtest dependency in this image; built and executed by
+// tests/test_ps.py::test_native_cc_unit_tests.
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* pt_sparse_table_create(long long dim, int optimizer, float lr,
+                             float init_scale, unsigned long long seed,
+                             int shards);
+void pt_sparse_table_free(void* t);
+long long pt_sparse_table_size(void* t);
+void pt_sparse_table_pull(void* t, const long long* ids, long long n,
+                          float* out);
+void pt_sparse_table_push_grad(void* t, const long long* ids, long long n,
+                               const float* grads);
+void pt_sparse_table_push_delta(void* t, const long long* ids, long long n,
+                                const float* deltas);
+void* pt_feed_create(const int* slot_types, int n_slots);
+void pt_feed_free(void* h);
+long long pt_feed_load_file(void* h, const char* path);
+long long pt_feed_num_records(void* h);
+long long pt_feed_batch_count(void* h, int slot, long long start,
+                              long long bs);
+long long pt_feed_fill_batch(void* h, int slot, long long start,
+                             long long bs, void* values, long long* offsets);
+}
+
+static void test_table_basic() {
+  void* t = pt_sparse_table_create(4, /*sgd*/ 0, 0.5f, 0.1f, 7, 8);
+  assert(t);
+  long long ids[2] = {3, 9};
+  float rows[8];
+  pt_sparse_table_pull(t, ids, 2, rows);
+  for (int i = 0; i < 8; ++i) assert(std::fabs(rows[i]) <= 0.1f + 1e-6f);
+  assert(pt_sparse_table_size(t) == 2);
+
+  float g[8];
+  for (int i = 0; i < 8; ++i) g[i] = 1.0f;
+  pt_sparse_table_push_grad(t, ids, 2, g);
+  float after[8];
+  pt_sparse_table_pull(t, ids, 2, after);
+  for (int i = 0; i < 8; ++i)
+    assert(std::fabs(after[i] - (rows[i] - 0.5f)) < 1e-6f);
+  pt_sparse_table_free(t);
+  std::puts("table_basic ok");
+}
+
+static void test_table_concurrent_pushes() {
+  // shard locks: concurrent disjoint-id pushes must all land
+  void* t = pt_sparse_table_create(2, 0, 1.0f, 0.0f, 1, 4);
+  const int kThreads = 8, kIters = 100;
+  std::vector<std::thread> ts;
+  for (int w = 0; w < kThreads; ++w) {
+    ts.emplace_back([&, w] {
+      long long id = w;
+      float g[2] = {1.0f, -1.0f};
+      for (int i = 0; i < kIters; ++i)
+        pt_sparse_table_push_grad(t, &id, 1, g);
+    });
+  }
+  for (auto& th : ts) th.join();
+  for (long long w = 0; w < kThreads; ++w) {
+    float row[2];
+    pt_sparse_table_pull(t, &w, 1, row);
+    assert(std::fabs(row[0] + (float)kIters) < 1e-3f);  // 0 - lr*sum(g)
+    assert(std::fabs(row[1] - (float)kIters) < 1e-3f);
+  }
+  pt_sparse_table_free(t);
+  std::puts("table_concurrent ok");
+}
+
+static void test_feed_roundtrip(const char* tmpdir) {
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/feed.txt", tmpdir);
+  FILE* f = std::fopen(path, "w");
+  std::fputs("2 10 20 1 0.5\n1 30 1 1.5\n", f);  // ids slot + float slot
+  std::fclose(f);
+
+  int types[2] = {0, 1};
+  void* h = pt_feed_create(types, 2);
+  assert(pt_feed_load_file(h, path) == 2);
+  assert(pt_feed_num_records(h) == 2);
+  assert(pt_feed_batch_count(h, 0, 0, 2) == 3);
+
+  long long vals[3];
+  long long offsets[3];
+  long long n = pt_feed_fill_batch(h, 0, 0, 2, vals, offsets);
+  assert(n == 2);
+  assert(offsets[0] == 0 && offsets[1] == 2 && offsets[2] == 3);
+  assert(vals[0] == 10 && vals[1] == 20 && vals[2] == 30);
+
+  float fvals[2];
+  long long foff[3];
+  pt_feed_fill_batch(h, 1, 0, 2, fvals, foff);
+  assert(std::fabs(fvals[0] - 0.5f) < 1e-6f);
+  assert(std::fabs(fvals[1] - 1.5f) < 1e-6f);
+  pt_feed_free(h);
+  std::puts("feed_roundtrip ok");
+}
+
+int main(int argc, char** argv) {
+  test_table_basic();
+  test_table_concurrent_pushes();
+  test_feed_roundtrip(argc > 1 ? argv[1] : "/tmp");
+  std::puts("ALL NATIVE TESTS PASSED");
+  return 0;
+}

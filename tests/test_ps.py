@@ -7,6 +7,8 @@ Reference test analogues: ``operators/distributed/communicator_test.cc``,
 workloads (``parallel_dygraph_sparse_embedding.py``).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -388,3 +390,21 @@ def test_fleet_metrics_single_process():
     assert fm.auc(pos, neg) > 0.99
     uniform = np.ones(10)
     assert abs(fm.auc(uniform, uniform) - 0.5) < 1e-6
+
+
+def test_native_cc_unit_tests(tmp_path):
+    """Build and run the C++-level unit tests (the reference's colocated
+    *_test.cc pattern): table math, shard-lock concurrency, feed CSR."""
+    import subprocess
+    import sys
+
+    from paddle_tpu.native.build import _SRC_DIR
+
+    exe = str(tmp_path / "native_test")
+    srcs = [os.path.join(_SRC_DIR, s) for s in
+            ("sparse_table.cc", "data_feed.cc", "native_test.cc")]
+    subprocess.run(["g++", "-O1", "-std=c++17", "-pthread", "-o", exe,
+                    *srcs], check=True, capture_output=True, text=True)
+    out = subprocess.run([exe, str(tmp_path)], check=True,
+                         capture_output=True, text=True, timeout=120)
+    assert "ALL NATIVE TESTS PASSED" in out.stdout, out.stdout
