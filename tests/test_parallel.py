@@ -168,3 +168,16 @@ def test_opt_state_specs_single_param_model(devices8):
     adam_state = ospecs[0]
     assert adam_state.count == P()
     assert adam_state.mu.w == P("fsdp", "tp")
+
+
+def test_create_hybrid_mesh_single_slice_fallback(devices8):
+    """Single-process: dcn degrees fold into the flat mesh so launch
+    scripts work unchanged on one host."""
+    mesh = M.create_hybrid_mesh({"tp": 2, "fsdp": 2}, {"dp": 2})
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["dp"] == 2
+    assert mesh.size == 8
+    # no dcn axes at all → plain create_mesh
+    mesh2 = M.create_hybrid_mesh({"tp": 2})
+    assert mesh2.shape["tp"] == 2 and mesh2.size == 8
