@@ -57,6 +57,11 @@ __all__ = [
     "max_pool1d", "avg_pool1d", "max_pool3d", "avg_pool3d",
     "adaptive_avg_pool1d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "conv1d", "conv3d",
+    "assign", "fc", "upsample", "square_error_cost", "log_loss",
+    "dice_loss", "sigmoid_focal_loss", "npair_loss", "diag_embed",
+    "instance_norm", "data_norm", "bilinear", "bilinear_tensor_product",
+    "row_conv", "spectral_norm", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "affine_grid", "grid_sample", "nce",
 ]
 
 
@@ -916,3 +921,253 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     if bias is not None:
         out = out + bias.reshape(1, -1, 1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Functional parity tail (reference python/paddle/nn/functional/*): aliases
+# for ops that so far existed only as layers, plus the spatial-transformer
+# pair and the remaining loss zoo.
+# ---------------------------------------------------------------------------
+
+def assign(x):
+    """Copy (reference assign op)."""
+    return jnp.array(x)
+
+
+fc = linear            # reference fluid alias for the linear op
+upsample = interpolate
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    p = jnp.clip(input, epsilon, 1.0 - epsilon)
+    return -label * jnp.log(p) - (1.0 - label) * jnp.log(1.0 - p)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """1 - 2|X∩Y| / (|X|+|Y|) over the trailing dims (reference
+    dice_loss for segmentation; input probs, label one-hot/binary)."""
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(label,
+                                                      axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum"):
+    """RetinaNet focal loss (reference sigmoid_focal_loss_op)."""
+    p = jax.nn.sigmoid(logit)
+    ce = (jnp.maximum(logit, 0) - logit * label
+          + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    a_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = a_t * jnp.power(1.0 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """N-pair metric-learning loss (reference npair_loss)."""
+    sim = anchor @ positive.T                                 # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    targets = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1.0)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), -1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), -1))) / 2
+    return ce + reg
+
+
+def diag_embed(x, offset: int = 0):
+    """[..., N] → [..., N, N] diagonal matrices (reference diag_embed)."""
+    n = x.shape[-1]
+    base = jnp.eye(n, dtype=x.dtype)
+    out = x[..., None] * base
+    if offset:
+        pad = abs(offset)
+        z = jnp.zeros(x.shape[:-1] + (n + pad, n + pad), x.dtype)
+        if offset > 0:
+            out = z.at[..., :n, pad:].set(out)
+        else:
+            out = z.at[..., pad:, :n].set(out)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon: float = 1e-5):
+    """Per-(sample, channel) normalization over spatial dims."""
+    return group_norm(x, x.shape[1], weight, bias, epsilon, "NCHW")
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum,
+              epsilon: float = 1e-4):
+    """Normalization from accumulated global statistics (reference
+    data_norm_op — the PS-era scale-invariant input norm: accumulators
+    are updated asynchronously server-side)."""
+    mean = batch_sum / batch_size
+    var = batch_square_sum / batch_size - jnp.square(mean)
+    return (x - mean) * lax.rsqrt(jnp.maximum(var, 0.0) + epsilon)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """out_k = x1 W_k x2 (reference bilinear/bilinear_tensor_product)."""
+    out = jnp.einsum("...i,oij,...j->...o", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+bilinear_tensor_product = bilinear
+
+
+def row_conv(x, weight):
+    """Lookahead temporal conv (see nn.RowConv)."""
+    ctx = weight.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(ctx):
+        out = out + xp[:, i:i + x.shape[1]] * weight[i]
+    return out
+
+
+def spectral_norm(weight, u, n_power_iterations: int = 1,
+                  epsilon: float = 1e-12, dim: int = 0):
+    """W / sigma_max(W) with power iteration; returns (normalized, u)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    w2 = w.reshape(w.shape[0], -1)
+    v = None
+    for _ in range(max(n_power_iterations, 1)):
+        v = w2.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), epsilon)
+        u = w2 @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), epsilon)
+    sigma = u @ w2 @ v
+    return weight / jax.lax.stop_gradient(sigma), jax.lax.stop_gradient(u)
+
+
+def conv1d_transpose(x, weight, bias=None, stride: int = 1,
+                     padding: int = 0):
+    """weight [in, out, k]; output length (L-1)*s - 2p + k."""
+    k = weight.shape[2]
+    w = jnp.flip(weight, axis=(2,)).transpose(1, 0, 2)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1,), padding=[(k - 1 - padding,) * 2],
+        lhs_dilation=(stride,), dimension_numbers=("NCH", "OIH", "NCH"))
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1)
+    return y
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0):
+    s = _pair(stride)
+    p = _pair(padding)
+    k = weight.shape[2:]
+    w = jnp.flip(weight, axis=(2, 3)).transpose(1, 0, 2, 3)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1),
+        padding=[(k[0] - 1 - p[0],) * 2, (k[1] - 1 - p[1],) * 2],
+        lhs_dilation=s, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0):
+    s = _tuple_n(stride, 3)
+    p = _tuple_n(padding, 3)
+    k = weight.shape[2:]
+    w = jnp.flip(weight, axis=(2, 3, 4)).transpose(1, 0, 2, 3, 4)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1),
+        padding=[(ki - 1 - pi,) * 2 for ki, pi in zip(k, p)],
+        lhs_dilation=s, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1, 1)
+    return y
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """Sampling grid from affine matrices theta [N, 2, 3] for
+    ``grid_sample`` (reference affine_grid_op; spatial transformers)."""
+    n, c, h, w = out_shape
+
+    def coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = coords(h)
+    xs = coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)                 # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)     # [H, W, 3]
+    return jnp.einsum("hwk,nok->nhwo", base, theta)  # [N, H, W, 2]
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """Sample [N, C, H, W] at normalized grid [N, Hg, Wg, 2] (reference
+    grid_sample_op; bilinear or nearest, zero/border padding)."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnormalize(grid[..., 0], w)              # [N, Hg, Wg]
+    gy = unnormalize(grid[..., 1], h)
+
+    def gather(yi, xi):
+        inside = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1)
+        xc = jnp.clip(xi, 0, w - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, yc, xc]  # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            vals = vals * inside[..., None]
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(gy).astype(jnp.int32),
+                     jnp.round(gx).astype(jnp.int32))
+        return jnp.moveaxis(out, -1, 1)
+
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+           + gather(y0, x1) * (wx * (1 - wy))[..., None]
+           + gather(y1, x0) * ((1 - wx) * wy)[..., None]
+           + gather(y1, x1) * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1)
+
+
+def nce(x, labels, weight, bias=None, *, num_total_classes: int,
+        num_neg_samples: int = 10, key=None):
+    """Noise-contrastive estimation loss (reference nce_op): binary
+    logistic discrimination of the true class against uniformly sampled
+    noise classes."""
+    if key is None:
+        from paddle_tpu.core import rng as _rng
+        key = _rng.next_key()
+    b = x.shape[0]
+    noise = jax.random.randint(key, (b, num_neg_samples), 0,
+                               num_total_classes)
+    all_ids = jnp.concatenate([labels[:, None], noise], axis=1)  # [B,1+S]
+    w = weight[all_ids]                                          # [B,1+S,D]
+    logits = jnp.einsum("bd,bkd->bk", x, w)
+    if bias is not None:
+        logits = logits + bias[all_ids]
+    # log-odds correction for uniform noise: log(S * 1/V)
+    logits = logits - jnp.log(num_neg_samples / num_total_classes)
+    targets = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    per = (jnp.maximum(logits, 0) - logits * targets
+           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return jnp.mean(jnp.sum(per, axis=1))

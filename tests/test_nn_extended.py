@@ -273,3 +273,114 @@ def test_upsample_and_rowconv():
     xn = np.asarray(seq)[0]
     ref = sum(w[i] * xn[3 + i] for i in range(3))
     np.testing.assert_allclose(np.asarray(out[0, 3]), ref, rtol=1e-5)
+
+
+def test_affine_grid_and_grid_sample_identity():
+    """Identity theta must reproduce the input exactly (bilinear,
+    align_corners) — the spatial-transformer sanity check."""
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 5, 7)
+                    .astype(np.float32))
+    theta = jnp.broadcast_to(
+        jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]), (2, 2, 3))
+    grid = F.affine_grid(theta, (2, 3, 5, 7))
+    assert grid.shape == (2, 5, 7, 2)
+    out = F.grid_sample(x, grid)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+    # nearest mode identity too
+    out_n = F.grid_sample(x, grid, mode="nearest")
+    np.testing.assert_allclose(np.asarray(out_n), np.asarray(x), atol=1e-5)
+
+
+def test_grid_sample_translation_zero_pad():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    # shift right by one pixel (normalized step = 2/(W-1))
+    theta = jnp.asarray([[[1.0, 0.0, -2.0 / 3.0], [0.0, 1.0, 0.0]]])
+    out = F.grid_sample(x, F.affine_grid(theta, (1, 1, 4, 4)))
+    ref = np.zeros((4, 4), np.float32)
+    ref[:, 1:] = np.asarray(x)[0, 0, :, :-1]
+    np.testing.assert_allclose(np.asarray(out[0, 0]), ref, atol=1e-5)
+
+
+def test_loss_zoo_golden():
+    p = jnp.asarray([0.9, 0.1])
+    y = jnp.asarray([1.0, 0.0])
+    np.testing.assert_allclose(
+        np.asarray(F.log_loss(p, y)),
+        [-np.log(0.9), -np.log(0.9)], rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.square_error_cost(p, y)), [0.01, 0.01], rtol=1e-4)
+
+    # dice: perfect prediction → ~0 loss; disjoint → ~1
+    pred = jnp.asarray([[1.0, 0.0, 1.0, 0.0]])
+    assert float(F.dice_loss(pred, pred)) < 1e-4
+    assert float(F.dice_loss(pred, 1.0 - pred)) > 0.99
+
+    # focal loss: well-classified examples are strongly down-weighted
+    logit = jnp.asarray([5.0, -5.0])
+    label = jnp.asarray([1.0, 0.0])
+    easy = float(F.sigmoid_focal_loss(logit, label))
+    hard = float(F.sigmoid_focal_loss(-logit, label))
+    assert easy < hard / 1000
+
+    # npair: matching pairs beat shuffled pairs
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(8, 4).astype(np.float32))
+    labels = jnp.arange(8)
+    good = float(F.npair_loss(a, a, labels, l2_reg=0.0))
+    bad = float(F.npair_loss(a, -a, labels, l2_reg=0.0))
+    assert good < bad
+
+
+def test_diag_embed_and_instance_norm():
+    v = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    d = F.diag_embed(v)
+    assert d.shape == (2, 2, 2)
+    np.testing.assert_allclose(np.asarray(d[0]), [[1, 0], [0, 2]])
+    off = F.diag_embed(jnp.asarray([1.0, 2.0]), offset=1)
+    assert off.shape == (3, 3) and float(off[0, 1]) == 1.0
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 4, 4)
+                    .astype(np.float32))
+    y = F.instance_norm(x)
+    m = np.asarray(y).mean(axis=(2, 3))
+    np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+
+
+def test_functional_conv_transposes_match_layers():
+    paddle_tpu.seed(0)
+    deconv = nn.Conv2DTranspose(3, 2, 3, stride=2, padding=1, bias=False)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 5, 5)
+                    .astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(F.conv2d_transpose(x, deconv.weight, stride=2,
+                                      padding=1)),
+        np.asarray(deconv(x)), rtol=1e-5)
+
+
+def test_nce_minimizable():
+    """NCE loss must be reducible by gradient descent on the features —
+    the sampled-softmax training property (reference nce_op)."""
+    rs = np.random.RandomState(0)
+    V, D, B = 50, 8, 16
+    weight = jnp.asarray(rs.randn(V, D).astype(np.float32) * 0.5)
+    labels = jnp.asarray(rs.randint(0, V, (B,)))
+    x = jnp.asarray(rs.randn(B, D).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    def loss_fn(x):
+        return F.nce(x, labels, weight, num_total_classes=V, key=key)
+
+    l0 = float(loss_fn(x))
+    for _ in range(40):
+        x = x - 0.3 * jax.grad(loss_fn)(x)
+    l1 = float(loss_fn(x))
+    assert l1 < l0 * 0.5, (l0, l1)
+
+
+def test_data_norm_from_accumulators():
+    x = jnp.asarray([[2.0, 4.0]])
+    bs = jnp.asarray(10.0)
+    bsum = jnp.asarray([20.0, 40.0])      # mean = [2, 4]
+    bsq = jnp.asarray([50.0, 170.0])      # var = 5-4=1, 17-16=1
+    y = F.data_norm(x, bs, bsum, bsq, epsilon=0.0)
+    np.testing.assert_allclose(np.asarray(y), [[0.0, 0.0]], atol=1e-5)
