@@ -371,10 +371,12 @@ def test_nce_minimizable():
         return F.nce(x, labels, weight, num_total_classes=V, key=key)
 
     l0 = float(loss_fn(x))
-    for _ in range(40):
-        x = x - 0.3 * jax.grad(loss_fn)(x)
+    step = jax.jit(lambda x: x - 0.5 * jax.grad(loss_fn)(x))
+    for _ in range(150):
+        x = step(x)
     l1 = float(loss_fn(x))
-    assert l1 < l0 * 0.5, (l0, l1)
+    # floor is nonzero (noise-id collisions with labels are irreducible)
+    assert l1 < l0 * 0.45, (l0, l1)
 
 
 def test_data_norm_from_accumulators():
