@@ -40,6 +40,9 @@ class MambaConfig:
     dt_rank: int | None = None        # defaults to ceil(hidden/16)
     dtype: str = "float32"
     remat: bool = False
+    # chunked scan: peak memory drops T/chunk (see selective_scan); None =
+    # one-shot scan (fine for short T, OOMs for T in the thousands)
+    scan_chunk_size: int | None = 128
 
     @property
     def inner_size(self) -> int:
@@ -109,9 +112,12 @@ def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
             x.reshape(Bsz, T // k, k, *x.shape[2:]), 1, 0)   # [nc,B,k,...]
 
     h0 = jnp.zeros((Bsz, Ei, A.shape[-1]), u.dtype)
-    _, ys = jax.lax.scan(chunk_step, h0,
-                         (to_chunks(u), to_chunks(delta),
-                          to_chunks(B), to_chunks(C)))
+    # per-chunk remat: without it the backward saves every chunk's scan
+    # internals ([nc, B, k, Ei, N] — the full unchunked footprint again);
+    # recomputing one chunk in backward keeps live memory at [B, k, Ei, N]
+    _, ys = jax.lax.scan(jax.checkpoint(chunk_step, prevent_cse=False),
+                         h0, (to_chunks(u), to_chunks(delta),
+                              to_chunks(B), to_chunks(C)))
     y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, T, Ei)
     return y + u * D
 
@@ -139,6 +145,7 @@ class MambaBlock(Module):
         self.state_size = N
         self.rank = R
         self.conv_kernel = cfg.conv_kernel
+        self.scan_chunk_size = cfg.scan_chunk_size
 
     def __call__(self, x, training: bool = False):
         residual = x
@@ -158,10 +165,14 @@ class MambaBlock(Module):
                                       self.rank + self.state_size], axis=-1)
         delta = F.softplus(self.dt_proj(dt))                  # [B,T,Ei]
         A = -jnp.exp(self.A_log)                              # [Ei,N]
+        T = u.shape[1]
+        chunk = (self.scan_chunk_size
+                 if self.scan_chunk_size and T % self.scan_chunk_size == 0
+                 else None)
         y = selective_scan(u.astype(jnp.float32),
                            delta.astype(jnp.float32), A,
                            Bc.astype(jnp.float32), Cc.astype(jnp.float32),
-                           self.D)
+                           self.D, chunk_size=chunk)
         y = y.astype(x.dtype) * F.silu(z)
         return residual + self.out_proj(y)
 
