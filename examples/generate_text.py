@@ -1,0 +1,33 @@
+"""Compiled autoregressive decoding: greedy, nucleus, and beam search
+over the static KV cache.
+
+    python examples/generate_text.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import beam_search, generate
+
+
+def main():
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=128, num_layers=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        0, 128, (2, 8)).astype(np.int32))
+
+    greedy = generate(model, prompt, 16)
+    sampled = generate(model, prompt, 16, temperature=0.8, top_p=0.9,
+                       key=jax.random.PRNGKey(7))
+    beam = beam_search(model, prompt, 16, num_beams=4)
+    print("greedy :", np.asarray(greedy[0]))
+    print("sampled:", np.asarray(sampled[0]))
+    print("beam   :", np.asarray(beam[0]))
+
+
+if __name__ == "__main__":
+    main()

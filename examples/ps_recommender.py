@@ -1,0 +1,63 @@
+"""CTR-style recommender on the parameter-server stack: embeddings live
+in host-RAM sparse tables (C++), the dense tower trains on-device.
+
+    python examples/ps_recommender.py [--steps 50] [--mode sync|async|geo]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.distributed.ps import (
+    Communicator, InProcClient, SparseEmbeddingHelper,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--mode", default="sync",
+                    choices=["sync", "async", "geo"])
+    args = ap.parse_args()
+
+    paddle_tpu.seed(0)
+    comm = Communicator(InProcClient(), args.mode)
+    emb = SparseEmbeddingHelper(comm, "user_emb", 16,
+                                optimizer="adagrad", lr=0.5,
+                                init_scale=0.1, seed=1)
+    tower = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+
+    rs = np.random.RandomState(0)
+    n_users = 1000
+    labels_by_user = (rs.rand(n_users) > 0.5).astype(np.float32)
+
+    @jax.jit
+    def train_step(m, rows, inverse, y):
+        def loss_fn(m, rows):
+            logit = m(rows[inverse])[:, 0]
+            return jnp.mean(jnp.maximum(logit, 0) - logit * y
+                            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+        loss, (gm, grows) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(m, rows)
+        m = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g, m, gm)
+        return loss, m, grows
+
+    for it in range(args.steps):
+        ids = rs.randint(0, n_users, (64,))
+        y = jnp.asarray(labels_by_user[ids])
+        rows, inverse, uniq = emb.lookup(ids)
+        loss, tower, grows = train_step(tower, rows, inverse, y)
+        emb.apply_grads(uniq, grows)
+        if it % 10 == 0 or it == args.steps - 1:
+            print(f"step {it}: loss={float(loss):.4f} "
+                  f"table_rows={comm.client.size('user_emb') if args.mode != 'geo' else 'local'}")
+    comm.flush()
+    comm.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Quantization-aware training then int8 freeze on a toy classifier.
+
+    python examples/qat_mnist_style.py [--steps 60]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, quant
+from paddle_tpu import optimizer as optim
+from paddle_tpu.parallel import mesh as M
+from paddle_tpu.vision.datasets import RandomImageDataset
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+
+    paddle_tpu.seed(0)
+    train = RandomImageDataset(256, (784,), num_classes=4, seed=0)
+    x = jnp.asarray(np.stack([train[i][0] for i in range(256)]))
+    y = jnp.asarray(np.asarray([train[i][1] for i in range(256)]))
+
+    model = quant.quantize_model(
+        nn.Sequential(nn.Linear(784, 64), nn.ReLU(), nn.Linear(64, 4)))
+    mesh = M.create_mesh({"dp": 1}, jax.devices()[:1])
+
+    def loss_fn(m, batch, training=True):
+        from paddle_tpu.nn import functional as F
+        logits = m(batch["x"], training=training)
+        return F.cross_entropy(logits.astype(jnp.float32), batch["y"])
+
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.Adam(1e-2), loss_fn=loss_fn, mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch({"x": x, "y": y})
+        for i in range(args.steps):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            if i % 20 == 0 or i == args.steps - 1:
+                print(f"step {i}: loss={float(metrics['loss']):.4f}")
+
+    int8_model = quant.convert_to_int8(state.model)
+    logits = jax.jit(lambda m, v: m(v))(int8_model, x)
+    acc = float(jnp.mean(jnp.argmax(logits, -1) == y))
+    print(f"int8 accuracy: {acc:.3f} "
+          f"(weights {int8_model.layers[0].weight_q.dtype})")
+
+
+if __name__ == "__main__":
+    main()

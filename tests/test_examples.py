@@ -1,0 +1,31 @@
+"""Every example script must run end to end (tiny smoke settings)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(name, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name), *args],
+        env=env, cwd=REPO, timeout=420, capture_output=True, text=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args", [
+    ("train_llama.py", ("--smoke", "--steps", "4")),
+    ("ps_recommender.py", ("--steps", "10")),
+    ("qat_mnist_style.py", ("--steps", "10")),
+    ("generate_text.py", ()),
+])
+def test_example_runs(script, args):
+    proc = run_example(script, *args)
+    assert proc.returncode == 0, (script, proc.stdout[-1500:],
+                                  proc.stderr[-1500:])
+    assert proc.stdout.strip(), script
