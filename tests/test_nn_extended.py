@@ -386,3 +386,55 @@ def test_data_norm_from_accumulators():
     bsq = jnp.asarray([50.0, 170.0])      # var = 5-4=1, 17-16=1
     y = F.data_norm(x, bs, bsum, bsq, epsilon=0.0)
     np.testing.assert_allclose(np.asarray(y), [[0.0, 0.0]], atol=1e-5)
+
+
+def test_fd_gradients_new_ops():
+    """Finite-difference gradient checks (OpTest check_grad pattern) for
+    the round-2 op additions."""
+    from op_test import check_grad
+
+    rs = np.random.RandomState(0)
+
+    # focal loss wrt logits
+    logit = rs.randn(6).astype(np.float64)
+    label = (rs.rand(6) > 0.5).astype(np.float64)
+    check_grad(lambda lg: F.sigmoid_focal_loss(lg, jnp.asarray(label),
+                                               reduction="sum"), [logit])
+
+    # dice loss wrt probabilities (kept away from 0/1 corners)
+    pred = (0.2 + 0.6 * rs.rand(2, 8)).astype(np.float64)
+    lab = (rs.rand(2, 8) > 0.5).astype(np.float64)
+    check_grad(lambda p: F.dice_loss(p, jnp.asarray(lab)), [pred])
+
+    # hsigmoid wrt features and node weights
+    x = rs.randn(4, 6).astype(np.float64)
+    w = rs.randn(7, 6).astype(np.float64)
+    y = rs.randint(0, 8, (4,))
+    check_grad(lambda xx, ww: F.hsigmoid_loss(
+        xx, jnp.asarray(y), ww, num_classes=8, reduction="sum"),
+        [x, w], wrt=(0, 1))
+
+    # grid_sample wrt both input and grid: bilinear grads are piecewise —
+    # FD must not straddle a lattice point, so pick unnormalized coords
+    # with fractional parts well inside (0, 1) and map back to [-1, 1]
+    img = rs.randn(1, 2, 5, 5).astype(np.float64)
+    frac_coords = np.array([0.4, 1.6, 2.5, 3.4, 1.35, 2.65, 0.55, 3.45,
+                            1.5])[:9].reshape(3, 3)
+    gx = (frac_coords / 4.0) * 2.0 - 1.0            # W=5 → denom 4
+    gy = (frac_coords.T / 4.0) * 2.0 - 1.0
+    grid = np.stack([gx, gy], axis=-1)[None].astype(np.float64)
+    check_grad(lambda im, g: F.grid_sample(im, g), [img, grid],
+               wrt=(0, 1))
+
+    # selu / softshrink elementwise (away from kinks)
+    x1 = (rs.randn(16) + np.sign(rs.randn(16)) * 0.6).astype(np.float64)
+    check_grad(F.selu, [x1])
+    check_grad(lambda v: F.softshrink(v, 0.3), [x1])
+
+    # margin ranking
+    a = rs.randn(5).astype(np.float64)
+    b = rs.randn(5).astype(np.float64) + 3.0  # away from the hinge kink
+    lab2 = np.ones(5)
+    check_grad(lambda u, v: F.margin_ranking_loss(
+        u, v, jnp.asarray(lab2), margin=0.1, reduction="sum"),
+        [a, b], wrt=(0, 1))
