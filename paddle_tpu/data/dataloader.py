@@ -31,6 +31,21 @@ __all__ = ["DataLoader", "default_collate"]
 
 _STOP = object()
 
+# Fork-pool worker state: the loader is stashed here by the Pool
+# initializer (fork-inherited, never pickled); tasks then reference it by
+# this global instead of shipping a bound method — which would pickle the
+# DataLoader/dataset/collate_fn on every task.
+_proc_loader = None
+
+
+def _proc_worker_init(loader):
+    global _proc_loader
+    _proc_loader = loader
+
+
+def _proc_worker_load(indices):
+    return _proc_loader._load_batch(indices)
+
 
 def default_collate(samples):
     """Stack a list of samples into a batch (numpy), matching the
@@ -108,15 +123,18 @@ class DataLoader:
 
     def _pool_batches_procs(self):
         """N process workers (reference dataloader_iter.py:469). Fork-based
-        so the dataset needn't pickle; only safe when no accelerator
-        client is live in the parent — use for CPU-bound pure-Python
-        datasets."""
+        so the dataset needn't pickle: the loader is inherited by each
+        worker at fork time via a Pool initializer global, and tasks carry
+        only the index lists — nothing else crosses the process boundary.
+        Only safe when no accelerator client is live in the parent — use
+        for CPU-bound pure-Python datasets."""
         import multiprocessing as mp
 
         ctx = mp.get_context("fork")
-        with ctx.Pool(self.num_workers) as pool:
+        with ctx.Pool(self.num_workers, initializer=_proc_worker_init,
+                      initargs=(self,)) as pool:
             # imap preserves order and streams results as they finish
-            yield from pool.imap(self._load_batch,
+            yield from pool.imap(_proc_worker_load,
                                  iter(self.batch_sampler),
                                  chunksize=1)
 

@@ -71,7 +71,9 @@ class _Conn:
     def request(self, op: str, header: dict, payload: bytes = b""):
         with self.lock:
             send_frame(self.sock, OPS[op], header, payload)
-            code, rheader, rpayload = recv_frame(self.sock)
+            # Replies come from the server this client chose to connect
+            # to — no size cap (a large pull is a legitimate response).
+            code, rheader, rpayload = recv_frame(self.sock, max_payload=None)
         if code != 0:
             raise RuntimeError(f"PS {op} failed: {rheader.get('error')}")
         return rheader, rpayload

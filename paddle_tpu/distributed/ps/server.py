@@ -48,10 +48,25 @@ def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
     sock.sendall(struct.pack("<ii", code, len(hj)) + hj + payload)
 
 
-def recv_frame(sock: socket.socket):
+# Hard cap on request frames arriving at the server.  Header/payload
+# lengths come from the (untrusted) peer; without a bound a single corrupt
+# frame could demand an arbitrarily large allocation.  The cap applies to
+# *requests* only — clients reading replies from the server they chose to
+# connect to pass ``max_payload=None`` (a pull of millions of rows is a
+# legitimate response size).
+MAX_HEADER_BYTES = 1 << 20  # 1 MiB of JSON is already absurd
+MAX_PAYLOAD_BYTES = 1 << 31  # 2 GiB per request frame
+
+
+def recv_frame(sock: socket.socket, max_payload: int | None = MAX_PAYLOAD_BYTES):
     code, hlen = struct.unpack("<ii", _recv_exact(sock, 8))
+    if not 0 <= hlen <= MAX_HEADER_BYTES:
+        raise ConnectionError(f"header length {hlen} out of bounds")
     header = json.loads(_recv_exact(sock, hlen)) if hlen else {}
-    payload = _recv_exact(sock, header.get("nbytes", 0))
+    nbytes = int(header.get("nbytes", 0))
+    if nbytes < 0 or (max_payload is not None and nbytes > max_payload):
+        raise ConnectionError(f"payload length {nbytes} out of bounds")
+    payload = _recv_exact(sock, nbytes)
     return code, header, payload
 
 
@@ -85,8 +100,17 @@ class _TableRegistry:
                 self._barrier_gen += 1
                 self._barrier_cv.notify_all()
             else:
-                self._barrier_cv.wait_for(
+                ok = self._barrier_cv.wait_for(
                     lambda: self._barrier_gen != gen, timeout=120)
+                if not ok:
+                    # Undo our arrival so later barriers aren't skewed by
+                    # the phantom count, then surface the hang to the
+                    # caller (it is returned to the client as an error
+                    # frame by _dispatch).
+                    self._barrier_count = max(0, self._barrier_count - 1)
+                    raise TimeoutError(
+                        "barrier timed out after 120s: a worker is hung "
+                        "or the configured world size is wrong")
 
 
 class ParameterServer:
@@ -163,7 +187,11 @@ class ParameterServer:
                                      "shape": list(rows.shape)},
                            rows.tobytes())
             elif name in ("push_grad", "push_delta"):
-                n = header["n"]
+                n = int(header["n"])
+                if n < 0 or 8 * n + 4 * n * table.dim != len(payload):
+                    raise ValueError(
+                        f"push payload size {len(payload)} does not match "
+                        f"n={n} dim={table.dim}")
                 ids = np.frombuffer(payload[:8 * n], np.int64)
                 vals = np.frombuffer(payload[8 * n:], np.float32)
                 getattr(table, name)(ids, vals.reshape(n, table.dim))

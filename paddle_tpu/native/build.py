@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import platform
 import subprocess
 import threading
 
@@ -32,10 +33,17 @@ def _source_hash() -> str:
     return h.hexdigest()[:16]
 
 
+def _platform_tag() -> str:
+    # Key the cache on arch + libc so a binary built elsewhere (or on a
+    # different libc) is never dlopen'd — it triggers a rebuild instead.
+    libc, ver = platform.libc_ver()
+    return f"{platform.machine()}-{libc or 'unknown'}{ver}"
+
+
 def build_library() -> str:
     """Compile (if stale) and return the path to the shared library."""
     with _lock:
-        tag = _source_hash()
+        tag = f"{_platform_tag()}-{_source_hash()}"
         so_path = os.path.join(_BUILD_DIR, f"libptnative-{tag}.so")
         if os.path.exists(so_path):
             return so_path

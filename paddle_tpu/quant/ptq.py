@@ -76,10 +76,19 @@ def convert_to_int8(qmodel):
 
     def fn(m):
         if isinstance(m, QuantedLinear):
+            if float(m.act_scale) <= 0:
+                raise ValueError(
+                    "convert_to_int8: activation scale is uncalibrated "
+                    "(act_scale <= 0). Run quant.calibrate() or train "
+                    "with QAT before freezing to int8.")
             qmax = QF.quant_max(m.weight_bits)
-            red = (0,)
-            w_scale = jnp.maximum(
-                jnp.max(jnp.abs(m.weight), axis=red), 1e-8)
+            # Freeze on the same grid fake-quant trained on: per-channel
+            # scales only when the QAT config used them.
+            if m.weight_per_channel:
+                w_scale = jnp.maximum(
+                    jnp.max(jnp.abs(m.weight), axis=(0,)), 1e-8)
+            else:
+                w_scale = jnp.maximum(jnp.max(jnp.abs(m.weight)), 1e-8)
             wq = jnp.clip(jnp.round(m.weight / w_scale * qmax),
                           -qmax, qmax).astype(jnp.int8)
             return Int8Linear(wq, w_scale, m.act_scale, m.bias,
