@@ -150,8 +150,10 @@ def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5, axis=-1):
     _pk = _pallas()
     if _pk is not None and axis in (-1, x.ndim - 1):
         from paddle_tpu.ops.pallas import norm as _pn
-        if _pk._support.auto_dispatch() and _pn.supported(x, weight, bias):
-            return _pk.layer_norm(x, weight, bias, epsilon)
+        mode = _pk._support.dispatch_mode()
+        if mode != "off" and _pn.supported(x, weight, bias):
+            return _pk.layer_norm(x, weight, bias, epsilon,
+                                  partitioned=mode == "partitioned")
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
     y = (x - mean) * lax.rsqrt(var + epsilon)
@@ -169,8 +171,10 @@ def rms_norm(x, weight=None, epsilon: float = 1e-6):
     _pk = _pallas()
     if _pk is not None:
         from paddle_tpu.ops.pallas import norm as _pn
-        if _pk._support.auto_dispatch() and _pn.supported(x, weight):
-            return _pk.rms_norm(x, weight, epsilon)
+        mode = _pk._support.dispatch_mode()
+        if mode != "off" and _pn.supported(x, weight):
+            return _pk.rms_norm(x, weight, epsilon,
+                                partitioned=mode == "partitioned")
     dtype = x.dtype
     xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
@@ -295,12 +299,30 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
         v = logits.shape[-1]
         flat = logits.reshape(-1, v)
         lab = label.reshape(-1)
-        if _pk._support.auto_dispatch() and _px.supported(flat, lab):
-            valid = lab != ignore_index
-            safe = jnp.where(valid, lab, 0)
-            loss = _pk.softmax_cross_entropy(flat, safe)
-            loss = jnp.where(valid, loss, 0.0).astype(logits.dtype)
-            return loss.reshape(label.shape)
+        mode = _pk._support.dispatch_mode()
+        # screen on everything but the row count before paying for the
+        # padded copy (v alignment, dtypes)
+        if mode != "off" and v % _px._BLOCK_V == 0 \
+                and logits.dtype in (jnp.float32, jnp.bfloat16):
+            # Row-pad to the kernel block so shifted-label LM losses
+            # ([B, T-1, V] → B·(T-1) rows) still dispatch; padded rows are
+            # ignore-masked so their loss (and hence grad) is zero.
+            n = flat.shape[0]
+            pad = (-n) % (_px._BLOCK_N if n >= _px._BLOCK_N else 8)
+            if pad:
+                flat_p = jnp.concatenate(
+                    [flat, jnp.zeros((pad, v), flat.dtype)])
+                lab_p = jnp.concatenate(
+                    [lab, jnp.full((pad,), ignore_index, lab.dtype)])
+            else:
+                flat_p, lab_p = flat, lab
+            if _px.supported(flat_p, lab_p):
+                valid = lab_p != ignore_index
+                safe = jnp.where(valid, lab_p, 0)
+                loss = _pk.softmax_cross_entropy(
+                    flat_p, safe, partitioned=mode == "partitioned")
+                loss = jnp.where(valid, loss, 0.0).astype(logits.dtype)
+                return loss[:n].reshape(label.shape)
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         return -jnp.sum(label * logp, axis=axis)
@@ -420,9 +442,19 @@ def scaled_dot_product_attention(q, k, v, mask=None, *, causal: bool = False,
     _pk = _pallas()
     if (_pk is not None and use_pallas != "never" and dropout_p == 0.0
             and mask is None):
-        if _pk.flash_attention_supported(q, k, v, causal=causal) and (
-                _pk._support.auto_dispatch() or use_pallas == "always"):
-            return _pk.flash_attention(q, k, v, causal=causal, scale=scale)
+        mode = _pk._support.dispatch_mode()
+        if mode == "off" and use_pallas == "always":
+            # Forced dispatch: inside any manual shard_map only the raw
+            # kernel is safe (custom_partitioning cannot lower there).
+            any_manual, _ = _pk._support._manual_axes()
+            if any_manual or _pk._support.single_device():
+                mode = "raw"
+            else:
+                mode = "partitioned"
+        if _pk.flash_attention_supported(q, k, v, causal=causal) \
+                and mode != "off":
+            return _pk.flash_attention(q, k, v, causal=causal, scale=scale,
+                                       partitioned=mode == "partitioned")
         if use_pallas == "always":
             raise RuntimeError(
                 "use_pallas='always' but the flash kernel does not support "
@@ -464,8 +496,10 @@ def apply_rotary(x, cos, sin):
     _pk = _pallas()
     if _pk is not None and x.ndim == 4 and cos.ndim == 2:
         from paddle_tpu.ops.pallas import rope as _pr
-        if _pk._support.auto_dispatch() and _pr.supported(x, cos, sin):
-            return _pk.apply_rotary(x, cos, sin)
+        mode = _pk._support.dispatch_mode()
+        if mode != "off" and _pr.supported(x, cos, sin):
+            return _pk.apply_rotary(x, cos, sin,
+                                    partitioned=mode == "partitioned")
     x1, x2 = jnp.split(x, 2, axis=-1)
     if cos.ndim == x.ndim - 2:          # [T, D/2] → broadcast over B, H
         cos = cos[None, :, None, :]

@@ -24,12 +24,30 @@ from paddle_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
 from paddle_tpu.ops.pallas.adamw import adamw_update
 
 force_interpret = _support.force_interpret
+force_dispatch = _support.force_dispatch
 on_tpu = _support.on_tpu
+dispatch_mode = _support.dispatch_mode
+
+
+def partition_stats() -> dict:
+    """Lowering decisions taken by the multi-chip (custom_partitioning)
+    kernel wrappers, keyed ``<unit>:<kernel|fallback>`` — recorded in the
+    multichip driver artifact as proof the Pallas path executed under
+    sharding."""
+    from paddle_tpu.ops.pallas import _partition
+    return dict(_partition.stats)
+
+
+def reset_partition_stats() -> None:
+    from paddle_tpu.ops.pallas import _partition
+    _partition.reset_stats()
+
 
 __all__ = [
     "flash_attention", "flash_attention_supported", "rms_norm", "layer_norm",
     "softmax_cross_entropy", "apply_rotary", "adamw_update",
-    "force_interpret", "on_tpu",
+    "force_interpret", "force_dispatch", "on_tpu", "dispatch_mode",
+    "partition_stats", "reset_partition_stats",
 ]
 
 

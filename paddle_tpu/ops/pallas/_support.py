@@ -10,6 +10,7 @@ from __future__ import annotations
 import jax
 
 _FORCE_INTERPRET = False
+_FORCE_DISPATCH = False
 
 
 def on_tpu() -> bool:
@@ -17,22 +18,46 @@ def on_tpu() -> bool:
 
 
 def single_device() -> bool:
-    """True when no multi-device mesh is active. pallas_call carries no
-    GSPMD partitioning rule, so under a >1-device jit the partitioner
-    would replicate operands (or fail to lower) — auto-dispatch must fall
-    back to the jnp path there. Multi-device flash attention instead goes
-    through the shard_map sequence-parallel path
-    (``paddle_tpu/parallel/ring_attention.py``), where per-device shapes
-    make the kernel safe."""
+    """True when no multi-device mesh is active."""
     from paddle_tpu.parallel import mesh as M
 
     mesh = M.current_mesh()
     return mesh is None or mesh.size <= 1
 
 
-def auto_dispatch() -> bool:
-    """Default ('auto') dispatch gate for the kernel set."""
-    return on_tpu() and single_device()
+def _manual_axes():
+    """(any_manual, all_manual) over the ambient abstract mesh axes."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return False, False
+    if am is None or not am.shape:
+        return False, False
+    manual = [t == jax.sharding.AxisType.Manual for t in am.axis_types]
+    return any(manual), all(manual)
+
+
+def dispatch_mode() -> str:
+    """How the kernel set should dispatch at this trace point.
+
+    - ``"off"`` — stay on the jnp path (not on TPU, or inside a
+      partially-manual shard_map where neither raw local shapes nor
+      custom_partitioning are safe).
+    - ``"raw"`` — call pallas directly: single-device jit, or inside a
+      fully-manual shard_map where shapes are already per-device (the
+      Ulysses local-attention case).
+    - ``"partitioned"`` — multi-device mesh under the automatic
+      partitioner: route through the custom_partitioning wrappers
+      (``ops/pallas/_partition.py``) so the kernel runs per shard. This
+      is what the reference gets from launching its fused CUDA kernels
+      per device under ``framework/parallel_executor.cc:504``.
+    """
+    if not (on_tpu() or _FORCE_DISPATCH):
+        return "off"
+    any_manual, all_manual = _manual_axes()
+    if any_manual:
+        return "raw" if all_manual else "off"
+    return "raw" if single_device() else "partitioned"
 
 
 def interpret() -> bool:
@@ -62,4 +87,25 @@ class force_interpret:
     def __exit__(self, *exc):
         global _FORCE_INTERPRET
         _FORCE_INTERPRET = self._prev
+        return False
+
+
+class force_dispatch:
+    """Context manager: dispatch the kernel set even off-TPU (interpreted)
+    — used by the virtual-mesh tests and the multichip dryrun to exercise
+    the partitioned kernel path on CPU devices. Compilation of the jitted
+    caller must happen inside the context (the interpret flag is read at
+    lowering time)."""
+
+    def __enter__(self):
+        global _FORCE_DISPATCH, _FORCE_INTERPRET
+        self._prev = (_FORCE_DISPATCH, _FORCE_INTERPRET)
+        _FORCE_DISPATCH = True
+        if not on_tpu():
+            _FORCE_INTERPRET = True
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_DISPATCH, _FORCE_INTERPRET
+        _FORCE_DISPATCH, _FORCE_INTERPRET = self._prev
         return False

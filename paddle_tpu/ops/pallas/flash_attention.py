@@ -337,19 +337,33 @@ def _bwd_impl(qt, kt, vt, ot, lse, do_t, causal, scale, block_q, block_k):
 # custom_vjp wiring (operates in [B, H, T, D])
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash(causal, scale, block_q, block_k, qt, kt, vt):
-    o, _ = _fwd(qt, kt, vt, causal, scale, block_q, block_k)
+def _fwd_dispatch(qt, kt, vt, causal, scale, block_q, block_k, part):
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        group = qt.shape[1] // kt.shape[1]
+        return _partition.flash_fwd(causal, scale, block_q, block_k,
+                                    group)(qt, kt, vt)
+    return _fwd(qt, kt, vt, causal, scale, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(causal, scale, block_q, block_k, part, qt, kt, vt):
+    o, _ = _fwd_dispatch(qt, kt, vt, causal, scale, block_q, block_k, part)
     return o
 
 
-def _flash_fwd(causal, scale, block_q, block_k, qt, kt, vt):
-    o, lse = _fwd(qt, kt, vt, causal, scale, block_q, block_k)
+def _flash_fwd(causal, scale, block_q, block_k, part, qt, kt, vt):
+    o, lse = _fwd_dispatch(qt, kt, vt, causal, scale, block_q, block_k, part)
     return o, (qt, kt, vt, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, part, res, do):
     qt, kt, vt, o, lse = res
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        group = qt.shape[1] // kt.shape[1]
+        return _partition.flash_bwd(causal, scale, block_q, block_k,
+                                    group)(qt, kt, vt, o, lse, do)
     return _bwd_impl(qt, kt, vt, o, lse, do, causal, scale, block_q, block_k)
 
 
@@ -357,17 +371,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None,
-                    block_q: int | None = None, block_k: int | None = None):
+                    block_q: int | None = None, block_k: int | None = None,
+                    partitioned: bool = False):
     """Flash attention, [B, T, H, D] in/out. Differentiable (custom VJP).
 
     ``supported(q, k, v, causal=...)`` must hold; callers are expected to
     fall back to the dense path otherwise (``nn.functional.
     scaled_dot_product_attention`` does this automatically).
+    ``partitioned`` routes both passes through custom_partitioning so the
+    kernels run per-shard (batch/head sharded, sequence replicated) under
+    a multi-device mesh.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    o = _flash(causal, float(scale), block_q, block_k, qt, kt, vt)
+    o = _flash(causal, float(scale), block_q, block_k, bool(partitioned),
+               qt, kt, vt)
     return jnp.transpose(o, (0, 2, 1, 3))

@@ -99,19 +99,7 @@ def _rms_fwd(x2d, w, eps):
     return y, rstd
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _rms(eps, x2d, w):
-    y, _ = _rms_fwd(x2d, w, eps)
-    return y
-
-
-def _rms_vjp_fwd(eps, x2d, w):
-    y, rstd = _rms_fwd(x2d, w, eps)
-    return y, (x2d, w, rstd)
-
-
-def _rms_vjp_bwd(eps, res, g):
-    x2d, w, rstd = res
+def _rms_bwd_call(x2d, w, rstd, g):
     n, h = x2d.shape
     br = min(_BLOCK_ROWS, n)
     nb = n // br
@@ -136,18 +124,48 @@ def _rms_vjp_bwd(eps, res, g):
             dimension_semantics=("arbitrary",)),
         interpret=_support.interpret(),
     )(x2d, w, rstd, g)
+    return dx, dw
+
+
+def _rms_fwd_dispatch(x2d, w, eps, part):
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        return _partition.rms_fwd(eps)(x2d, w)
+    return _rms_fwd(x2d, w, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _rms(eps, part, x2d, w):
+    y, _ = _rms_fwd_dispatch(x2d, w, eps, part)
+    return y
+
+
+def _rms_vjp_fwd(eps, part, x2d, w):
+    y, rstd = _rms_fwd_dispatch(x2d, w, eps, part)
+    return y, (x2d, w, rstd)
+
+
+def _rms_vjp_bwd(eps, part, res, g):
+    x2d, w, rstd = res
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        dx, dw = _partition.rms_bwd(eps)(x2d, w, rstd, g)
+    else:
+        dx, dw = _rms_bwd_call(x2d, w, rstd, g)
     return dx, dw.astype(w.dtype)
 
 
 _rms.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
 
 
-def rms_norm(x, weight, epsilon: float = 1e-6):
+def rms_norm(x, weight, epsilon: float = 1e-6, *, partitioned: bool = False):
     """Fused RMSNorm over the last axis. ``supported(x, weight)`` must
-    hold. Matches ``nn.functional.rms_norm`` numerics (fp32 statistics)."""
+    hold. Matches ``nn.functional.rms_norm`` numerics (fp32 statistics).
+    ``partitioned`` routes through custom_partitioning so the kernel runs
+    per-shard under a multi-device mesh."""
     n, h = _shape2d(x)
     w = weight if weight is not None else jnp.ones((h,), x.dtype)
-    y = _rms(float(epsilon), x.reshape(n, h), w)
+    y = _rms(float(epsilon), bool(partitioned), x.reshape(n, h), w)
     return y.reshape(x.shape)
 
 
@@ -217,19 +235,25 @@ def _ln_fwd(x2d, w, b, eps):
     )(x2d, w, b)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _ln(eps, b_dtype, x2d, w, b):
-    y, _, _ = _ln_fwd(x2d, w, b, eps)
+def _ln_fwd_dispatch(x2d, w, b, eps, part):
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        return _partition.ln_fwd(eps)(x2d, w, b)
+    return _ln_fwd(x2d, w, b, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ln(eps, b_dtype, part, x2d, w, b):
+    y, _, _ = _ln_fwd_dispatch(x2d, w, b, eps, part)
     return y
 
 
-def _ln_vjp_fwd(eps, b_dtype, x2d, w, b):
-    y, mean, rstd = _ln_fwd(x2d, w, b, eps)
+def _ln_vjp_fwd(eps, b_dtype, part, x2d, w, b):
+    y, mean, rstd = _ln_fwd_dispatch(x2d, w, b, eps, part)
     return y, (x2d, w, mean, rstd)
 
 
-def _ln_vjp_bwd(eps, b_dtype, res, g):
-    x2d, w, mean, rstd = res
+def _ln_bwd_call(x2d, w, mean, rstd, g):
     n, h = x2d.shape
     br = min(_BLOCK_ROWS, n)
     nb = n // br
@@ -257,16 +281,30 @@ def _ln_vjp_bwd(eps, b_dtype, res, g):
             dimension_semantics=("arbitrary",)),
         interpret=_support.interpret(),
     )(x2d, w, mean, rstd, g)
+    return dx, dw, db
+
+
+def _ln_vjp_bwd(eps, b_dtype, part, res, g):
+    x2d, w, mean, rstd = res
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        dx, dw, db = _partition.ln_bwd(eps)(x2d, w, mean, rstd, g)
+    else:
+        dx, dw, db = _ln_bwd_call(x2d, w, mean, rstd, g)
     return dx, dw.astype(w.dtype), db.astype(b_dtype)
 
 
 _ln.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
 
 
-def layer_norm(x, weight, bias, epsilon: float = 1e-5):
-    """Fused LayerNorm over the last axis (``supported`` must hold)."""
+def layer_norm(x, weight, bias, epsilon: float = 1e-5, *,
+               partitioned: bool = False):
+    """Fused LayerNorm over the last axis (``supported`` must hold).
+    ``partitioned`` routes through custom_partitioning so the kernel runs
+    per-shard under a multi-device mesh."""
     n, h = _shape2d(x)
     w = weight if weight is not None else jnp.ones((h,), x.dtype)
     b = bias if bias is not None else jnp.zeros((h,), x.dtype)
-    y = _ln(float(epsilon), jnp.dtype(b.dtype).name, x.reshape(n, h), w, b)
+    y = _ln(float(epsilon), jnp.dtype(b.dtype).name, bool(partitioned),
+            x.reshape(n, h), w, b)
     return y.reshape(x.shape)

@@ -65,20 +65,33 @@ def _rope_call(x, cos, sin, sign):
     return jnp.transpose(ot, (0, 2, 1, 3))
 
 
-@jax.custom_vjp
-def apply_rotary(x, cos, sin):
-    """Fused RoPE for [B, T, H, D] x with [T, D/2] cos/sin tables."""
-    return _rope_call(x, cos, sin, 1.0)
+def _rope_dispatch(x, cos, sin, sign, part):
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        return _partition.rope(sign)(x, cos, sin)
+    return _rope_call(x, cos, sin, sign)
 
 
-def _rope_fwd(x, cos, sin):
-    return _rope_call(x, cos, sin, 1.0), (cos, sin)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _rope(part, x, cos, sin):
+    return _rope_dispatch(x, cos, sin, 1.0, part)
 
 
-def _rope_bwd(res, g):
+def _rope_fwd(part, x, cos, sin):
+    return _rope_dispatch(x, cos, sin, 1.0, part), (cos, sin)
+
+
+def _rope_bwd(part, res, g):
     cos, sin = res
-    dx = _rope_call(g, cos, sin, -1.0)
+    dx = _rope_dispatch(g, cos, sin, -1.0, part)
     return dx, jnp.zeros_like(cos), jnp.zeros_like(sin)
 
 
-apply_rotary.defvjp(_rope_fwd, _rope_bwd)
+_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+def apply_rotary(x, cos, sin, *, partitioned: bool = False):
+    """Fused RoPE for [B, T, H, D] x with [T, D/2] cos/sin tables.
+    ``partitioned`` routes through custom_partitioning (batch/seq/head
+    shardable; the tables shard with the sequence)."""
+    return _rope(bool(partitioned), x, cos, sin)

@@ -77,7 +77,8 @@ def _dx_kernel(x_ref, lse_ref, g_ref, dx_ref):
     dx_ref[...] = (jnp.exp(x - lse) * g).astype(dx_ref.dtype)
 
 
-def _lse(logits):
+def _lse_call(logits):
+    """Raw kernel: lane-replicated [n, 128] log-sum-exp."""
     n, v = logits.shape
     br = _row_block(n)
     nb, nv = n // br, v // _BLOCK_V
@@ -95,33 +96,15 @@ def _lse(logits):
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_support.interpret(),
     )(logits)
-    return lse[:, 0]
+    return lse
 
 
-@jax.custom_vjp
-def softmax_cross_entropy(logits, labels):
-    """Per-row loss ``lse(logits) - logits[labels]`` for [N, V] logits and
-    int [N] labels. ``supported(logits, labels)`` must hold."""
-    lse = _lse(logits)
-    sel = jnp.take_along_axis(
-        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
-    return lse - sel.astype(jnp.float32)
-
-
-def _sce_fwd(logits, labels):
-    lse = _lse(logits)
-    sel = jnp.take_along_axis(
-        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
-    return lse - sel.astype(jnp.float32), (logits, labels, lse)
-
-
-def _sce_bwd(res, g):
-    logits, labels, lse = res
+def _dx_call(logits, lse_b, g_b):
+    """Raw kernel: softmax(logits)·g from lane-replicated lse/g."""
     n, v = logits.shape
     br = _row_block(n)
     nb, nv = n // br, v // _BLOCK_V
-    g = g.astype(jnp.float32)
-    dx = pl.pallas_call(
+    return pl.pallas_call(
         _dx_kernel,
         grid=(nb, nv),
         in_specs=[
@@ -134,11 +117,55 @@ def _sce_bwd(res, g):
         compiler_params=_support.compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=_support.interpret(),
-    )(logits, jnp.broadcast_to(lse[:, None], (n, 128)),
-      jnp.broadcast_to(g[:, None], (n, 128)))
+    )(logits, lse_b, g_b)
+
+
+def _lse_dispatch(logits, part):
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        return _partition.xent_lse()(logits)[:, 0]
+    return _lse_call(logits)[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _sce(part, logits, labels):
+    lse = _lse_dispatch(logits, part)
+    sel = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return lse - sel.astype(jnp.float32)
+
+
+def _sce_fwd(part, logits, labels):
+    lse = _lse_dispatch(logits, part)
+    sel = jnp.take_along_axis(
+        logits, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return lse - sel.astype(jnp.float32), (logits, labels, lse)
+
+
+def _sce_bwd(part, res, g):
+    logits, labels, lse = res
+    n, v = logits.shape
+    g = g.astype(jnp.float32)
+    lse_b = jnp.broadcast_to(lse[:, None], (n, 128))
+    g_b = jnp.broadcast_to(g[:, None], (n, 128))
+    if part:
+        from paddle_tpu.ops.pallas import _partition
+        dx = _partition.xent_dx()(logits, lse_b, g_b)
+    else:
+        dx = _dx_call(logits, lse_b, g_b)
     # one-hot subtraction: dx[i, labels[i]] -= g[i]
     dx = dx.at[jnp.arange(n), labels].add((-g).astype(dx.dtype))
     return dx, jnp.zeros(labels.shape, dtype=jax.dtypes.float0)
 
 
-softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)
+_sce.defvjp(_sce_fwd, _sce_bwd)
+
+
+def softmax_cross_entropy(logits, labels, *, partitioned: bool = False):
+    """Per-row loss ``lse(logits) - logits[labels]`` for [N, V] logits and
+    int [N] labels. ``supported(logits, labels)`` must hold.
+    ``partitioned`` routes the kernels through custom_partitioning so they
+    run per-shard under a multi-device mesh (including a Megatron-style
+    vocab-sharded lm head: local lse + log-sum-exp combine over the vocab
+    axes)."""
+    return _sce(bool(partitioned), logits, labels)

@@ -111,9 +111,12 @@ def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    # Inside the fully-manual shard_map the dispatch gate resolves to the
+    # *raw* kernel on the local [B, T, H/S, D] shapes (mode "raw"), so the
+    # head-sharded local attention runs the flash kernel on TPU; under a
+    # partially-manual context it stays on the dense path.
     out = scaled_dot_product_attention(fwd(q), fwd(k), fwd(v),
-                                       causal=causal, scale=scale,
-                                       use_pallas="never")
+                                       causal=causal, scale=scale)
     return bwd(out)
 
 

@@ -216,7 +216,7 @@ def test_dispatch_wrappers_forced(monkeypatch):
     the gate open (kernels run interpreted) — covers the reshape /
     ignore_index / fallback glue that on_tpu() normally hides from CI."""
     support = importlib.import_module("paddle_tpu.ops.pallas._support")
-    monkeypatch.setattr(support, "auto_dispatch", lambda: True)
+    monkeypatch.setattr(support, "dispatch_mode", lambda: "raw")
     rs = np.random.RandomState(11)
 
     # rms_norm + layer_norm via the wrapper (3D input → reshape round-trip)
@@ -240,34 +240,40 @@ def test_dispatch_wrappers_forced(monkeypatch):
     labels[0, :5] = -100
     labels = jnp.asarray(labels)
     got = F.softmax_with_cross_entropy(logits, labels)
-    monkeypatch.setattr(support, "auto_dispatch", lambda: False)
+    monkeypatch.setattr(support, "dispatch_mode", lambda: "off")
     ref = F.softmax_with_cross_entropy(logits, labels)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
     assert float(jnp.max(jnp.abs(got[0, :5]))) == 0.0
 
     # apply_rotary wrapper
-    monkeypatch.setattr(support, "auto_dispatch", lambda: True)
+    monkeypatch.setattr(support, "dispatch_mode", lambda: "raw")
     x4 = jnp.asarray(rs.randn(2, 128, 4, 64).astype(np.float32))
     cos, sin = F.rotary_embedding(jnp.arange(128), 64)
     got = F.apply_rotary(x4, cos, sin)
-    monkeypatch.setattr(support, "auto_dispatch", lambda: False)
+    monkeypatch.setattr(support, "dispatch_mode", lambda: "off")
     ref = F.apply_rotary(x4, cos, sin)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
 
-def test_auto_dispatch_off_under_multidevice_mesh(devices8):
-    """pallas_call has no GSPMD partitioning rule — the auto gate must
-    close when a >1-device mesh is ambient."""
+def test_dispatch_mode_under_multidevice_mesh(devices8):
+    """Under a >1-device mesh the kernel set dispatches through the
+    custom_partitioning wrappers (mode 'partitioned'); single device goes
+    straight to pallas ('raw'); off-TPU without the force flag stays on
+    the jnp path ('off')."""
     from paddle_tpu.parallel import mesh as M
     support = importlib.import_module("paddle_tpu.ops.pallas._support")
     mesh = M.create_mesh({"dp": 8}, devices8)
     assert support.single_device()
-    with M.MeshContext(mesh):
-        assert not support.single_device()
-        assert not support.auto_dispatch()
-    assert support.single_device()
+    assert support.dispatch_mode() == "off"  # CPU, no force
+    with support.force_dispatch():
+        assert support.dispatch_mode() == "raw"
+        with M.MeshContext(mesh):
+            assert not support.single_device()
+            assert support.dispatch_mode() == "partitioned"
+        assert support.dispatch_mode() == "raw"
+    assert support.dispatch_mode() == "off"
 
 
 def test_flash_attention_in_jit_and_remat():
