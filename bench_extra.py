@@ -73,7 +73,7 @@ def main():
     n = 50304 * 2048 * 2 + 12 * 12 * 2048 * 2048
     lm_bench("gpt-0.7B", GPTForCausalLM(cfg), 50304, 8, 2048, n)
 
-    # Mamba (chunked selective-scan path; per-layer + per-chunk remat)
+    # Mamba (Pallas selective-scan kernel; per-layer remat)
     mcfg = MambaConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                        dtype="bfloat16", remat=True)
     n = 50304 * 1024 * 2 + 24 * 6 * 1024 * 2048

@@ -169,10 +169,19 @@ class MambaBlock(Module):
         chunk = (self.scan_chunk_size
                  if self.scan_chunk_size and T % self.scan_chunk_size == 0
                  else None)
-        y = selective_scan(u.astype(jnp.float32),
-                           delta.astype(jnp.float32), A,
-                           Bc.astype(jnp.float32), Cc.astype(jnp.float32),
-                           self.D, chunk_size=chunk)
+        uf, df = u.astype(jnp.float32), delta.astype(jnp.float32)
+        bf, cf = Bc.astype(jnp.float32), Cc.astype(jnp.float32)
+        y = None
+        _pk = F._pallas()
+        if _pk is not None:
+            mode = _pk.dispatch_mode()
+            if mode != "off" and _pk.selective_scan_supported(
+                    uf, df, A, bf, cf, self.D, chunk=chunk):
+                y = _pk.selective_scan(
+                    uf, df, A, bf, cf, self.D, chunk=chunk,
+                    partitioned=mode == "partitioned")
+        if y is None:
+            y = selective_scan(uf, df, A, bf, cf, self.D, chunk_size=chunk)
         y = y.astype(x.dtype) * F.silu(z)
         return residual + self.out_proj(y)
 

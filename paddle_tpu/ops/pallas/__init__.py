@@ -22,6 +22,9 @@ from paddle_tpu.ops.pallas.norm import layer_norm, rms_norm
 from paddle_tpu.ops.pallas.rope import apply_rotary
 from paddle_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
 from paddle_tpu.ops.pallas.adamw import adamw_update
+from paddle_tpu.ops.pallas.selective_scan import (
+    selective_scan, supported as selective_scan_supported,
+)
 
 force_interpret = _support.force_interpret
 force_dispatch = _support.force_dispatch
@@ -46,6 +49,7 @@ def reset_partition_stats() -> None:
 __all__ = [
     "flash_attention", "flash_attention_supported", "rms_norm", "layer_norm",
     "softmax_cross_entropy", "apply_rotary", "adamw_update",
+    "selective_scan", "selective_scan_supported",
     "force_interpret", "force_dispatch", "on_tpu", "dispatch_mode",
     "partition_stats", "reset_partition_stats",
 ]

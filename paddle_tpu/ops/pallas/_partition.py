@@ -489,3 +489,77 @@ def rope(sign: float):
 
     return _build(fn, plan, "b t h d, t e, t e -> b t h d",
                   need_replication=("d", "e"))
+
+
+# ---------------------------------------------------------------------------
+# selective scan (Mamba) — [B, T, Ei] with [N, Ei] state matrix
+# ---------------------------------------------------------------------------
+
+def _ss_plan(mesh, arg_shapes):
+    """Batch and channel (lane) dims shard; time is sequential and the
+    state dim lives on sublanes — both replicated. Channel shardings must
+    keep each shard lane-tiled (Ei_local % 128), else they are dropped
+    (the kernel then runs on the full channel width per batch shard)."""
+    Bsz, T, Ei = arg_shapes[0].shape
+    spec = _spec_entries(_sharding_of(arg_shapes[0]), 3)
+    used: set = set()
+    b = _valid_dim(mesh, spec[0], Bsz, used)
+    e = spec[2]
+    if _size(mesh, e) > 1 and (Ei // _size(mesh, e)) % LANES:
+        e = None
+    e = _valid_dim(mesh, e, Ei, used)
+    return b, e
+
+
+@functools.lru_cache(maxsize=None)
+def selective_scan_fwd(k: int):
+    SS = _mod("selective_scan")
+
+    def fn(ctx, u, delta, At, B, C, D2):
+        stats["selective_scan_fwd:kernel"] += 1
+        return SS._fwd_call(u, delta, At, B, C, D2, k)
+
+    def plan(mesh, arg_shapes):
+        b, e = _ss_plan(mesh, arg_shapes)
+        te = P(b, None, e)
+        tn = P(b, None, None)
+        args = (te, te, P(None, e), tn, tn, P(None, e))
+        outs = (te, P(b, None, None, e))
+        return args, outs, None
+
+    # factors: b t e (u) | n (A.T) | o (the D row dim) | c (chunk count,
+    # result-only); t/n sequential/sublane -> replicated
+    return _build(fn, plan,
+                  "b t e, b t e, n e, b t n, b t n, o e "
+                  "-> b t e, b c n e",
+                  need_replication=("t", "n", "o", "c"))
+
+
+@functools.lru_cache(maxsize=None)
+def selective_scan_bwd(k: int):
+    SS = _mod("selective_scan")
+
+    def fn(ctx, u, delta, At, B, C, h0, dy):
+        stats["selective_scan_bwd:kernel"] += 1
+        du, ddt, dB, dC, dA_part = SS._bwd_call(u, delta, At, B, C, h0,
+                                                dy, k)
+        caxes = ctx if ctx is not None else ()
+        if caxes:
+            # dB/dC reduce over channels; with channels sharded each
+            # shard holds a partial sum
+            dB = jax.lax.psum(dB, caxes)
+            dC = jax.lax.psum(dC, caxes)
+        return du, ddt, dB, dC, dA_part
+
+    def plan(mesh, arg_shapes):
+        b, e = _ss_plan(mesh, arg_shapes)
+        te = P(b, None, e)
+        tn = P(b, None, None)
+        args = (te, te, P(None, e), tn, tn, P(b, None, None, e), te)
+        outs = (te, te, tn, tn, P(b, None, e))
+        return args, outs, _axes(e)
+
+    return _build(fn, plan,
+                  "b t e, b t e, n e, b t n, b t n, b c n e, b t e "
+                  "-> b t e, b t e, b t n, b t n, b n e",
+                  need_replication=("t", "n", "c"))
