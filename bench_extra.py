@@ -142,6 +142,37 @@ def main():
                       "images_per_sec": round(16 / sec, 1),
                       "loss": round(loss, 3)}), flush=True)
 
+    # PP-YOLOE-s detection training (TAL + VFL/DFL/GIoU), 640x640
+    from paddle_tpu.vision.models import ppyoloe_s
+
+    det = ppyoloe_s(num_classes=80)
+    db = 8
+    dimgs = jnp.asarray(rs.randn(db, 3, 640, 640).astype(np.float32) * 0.1)
+    gtb = np.zeros((db, 8, 4), np.float32)
+    gtl = np.full((db, 8), -1, np.int32)
+    for i in range(db):
+        for g in range(rs.randint(1, 9)):
+            cx, cy = rs.rand(2) * 560 + 40
+            w, h = rs.rand(2) * 120 + 30
+            gtb[i, g] = [max(cx - w, 0), max(cy - h, 0),
+                         min(cx + w, 640), min(cy + h, 640)]
+            gtl[i, g] = rs.randint(0, 80)
+
+    def det_loss(m, batch, training=True):
+        return m.loss(batch["x"], batch["boxes"], batch["labels"],
+                      training=training)
+
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            det, optimizer=optim.AdamW(1e-4), loss_fn=det_loss, mesh=mesh)
+        state = step.init_state(det)
+        data = step.shard_batch({"x": dimgs, "boxes": jnp.asarray(gtb),
+                                 "labels": jnp.asarray(gtl)})
+        sec, loss = measure(step, state, data)
+    print(json.dumps({"model": "ppyoloe-s-640", "params_m": 6.7,
+                      "images_per_sec": round(db / sec, 1),
+                      "loss": round(loss, 3)}), flush=True)
+
 
 if __name__ == "__main__":
     main()

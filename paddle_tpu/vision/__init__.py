@@ -4,7 +4,7 @@ Reference: ``python/paddle/vision`` (models: lenet/vgg/resnet/mobilenet,
 datasets: MNIST/CIFAR/..., transforms).
 """
 
-from paddle_tpu.vision import models, transforms
+from paddle_tpu.vision import models, ops, transforms
 from paddle_tpu.vision.datasets import (
     Cifar10, Cifar100, DatasetFolder, FashionMNIST, Flowers, ImageFolder,
     MNIST, RandomImageDataset, VOC2012,

@@ -5,3 +5,6 @@ from paddle_tpu.vision.models.resnet import (
 from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg16
 from paddle_tpu.vision.models.mobilenet import MobileNetV1, MobileNetV2
 from paddle_tpu.vision.models.vit import ViT, vit_b_16, vit_l_16
+from paddle_tpu.vision.models.ppyoloe import (
+    PPYOLOE, PPYOLOEConfig, ppyoloe_s, ppyoloe_tiny,
+)

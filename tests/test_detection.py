@@ -1,0 +1,303 @@
+"""Detection op family + PP-YOLOE model tests.
+
+Golden outputs against independent numpy ports of the reference kernels
+(``paddle/fluid/operators/detection/*``) via the OpTest pattern
+(``tests/op_test.py``), FD gradients for the differentiable ops, and a
+train-to-falling-loss smoke for the PP-YOLOE-class model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.vision import ops as V
+from tests.op_test import check_grad, check_output
+
+
+def np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((a.shape[0], b.shape[0]), np.float64)
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            iw = min(a[i, 2], b[j, 2]) - max(a[i, 0], b[j, 0]) + off
+            ih = min(a[i, 3], b[j, 3]) - max(a[i, 1], b[j, 1]) + off
+            inter = max(iw, 0.0) * max(ih, 0.0)
+            aa = max(a[i, 2] - a[i, 0] + off, 0) * \
+                max(a[i, 3] - a[i, 1] + off, 0)
+            ab = max(b[j, 2] - b[j, 0] + off, 0) * \
+                max(b[j, 3] - b[j, 1] + off, 0)
+            u = aa + ab - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def test_box_iou_golden():
+    rs = np.random.RandomState(0)
+    a = np.sort(rs.rand(5, 4).astype(np.float32) * 50, axis=-1)[:, [0, 1, 2, 3]]
+    a = np.stack([a[:, 0], a[:, 1], a[:, 2], a[:, 3]], -1)
+    b = np.sort(rs.rand(7, 4).astype(np.float32) * 50, axis=-1)
+    for norm in (True, False):
+        got = V.box_iou_xyxy(jnp.asarray(a), jnp.asarray(b), normalized=norm)
+        np.testing.assert_allclose(np.asarray(got), np_iou(a, b, norm),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_yolo_box_golden():
+    """Against a direct numpy port of GetYoloBox/CalcDetectionBox
+    (reference detection/yolo_box_op.h)."""
+    rs = np.random.RandomState(1)
+    N, A, C, H, W = 2, 2, 3, 4, 5
+    anchors = [10, 13, 16, 30]
+    down = 32
+    x = rs.randn(N, A * (5 + C), H, W).astype(np.float32)
+    img = np.array([[320, 480], [256, 256]], np.int32)
+    conf_t = 0.3
+
+    boxes, scores = V.yolo_box(jnp.asarray(x), jnp.asarray(img), anchors, C,
+                               conf_t, down, clip_bbox=True, scale_x_y=1.2)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    xr = x.reshape(N, A, 5 + C, H, W)
+    ref_boxes = np.zeros((N, H * W * A, 4), np.float64)
+    ref_scores = np.zeros((N, H * W * A, C), np.float64)
+    bias = -0.5 * (1.2 - 1.0)
+    for n in range(N):
+        ih, iw = img[n]
+        for a in range(A):
+            for i in range(H):
+                for j in range(W):
+                    conf = sig(xr[n, a, 4, i, j])
+                    idx = (i * W + j) * A + a
+                    if conf < conf_t:
+                        continue
+                    cx = (j + sig(xr[n, a, 0, i, j]) * 1.2 + bias) * iw / W
+                    cy = (i + sig(xr[n, a, 1, i, j]) * 1.2 + bias) * ih / H
+                    bw = np.exp(xr[n, a, 2, i, j]) * anchors[2 * a] * iw \
+                        / (down * W)
+                    bh = np.exp(xr[n, a, 3, i, j]) * anchors[2 * a + 1] \
+                        * ih / (down * H)
+                    b = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                    b[0] = max(b[0], 0)
+                    b[1] = max(b[1], 0)
+                    b[2] = min(b[2], iw - 1)
+                    b[3] = min(b[3], ih - 1)
+                    ref_boxes[n, idx] = b
+                    ref_scores[n, idx] = conf * sig(xr[n, a, 5:, i, j])
+    np.testing.assert_allclose(np.asarray(boxes), ref_boxes, rtol=1e-4,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(scores), ref_scores, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_box_coder_roundtrip_and_golden():
+    rs = np.random.RandomState(2)
+    priors = np.abs(rs.rand(6, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 0.5 + priors[:, 2:]
+    targets = np.abs(rs.rand(6, 4).astype(np.float32))
+    targets[:, 2:] = targets[:, :2] + 0.3 + targets[:, 2:]
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+
+    enc = V.box_coder(jnp.asarray(priors), jnp.asarray(var),
+                      jnp.asarray(targets), "encode_center_size")
+    # decode the diagonal back: each target encoded against its own prior
+    diag = jnp.stack([enc[i, i] for i in range(6)])
+    dec = V.box_coder(jnp.asarray(priors), jnp.asarray(var), diag[:, None, :]
+                      .repeat(6, 1), "decode_center_size")
+    rec = np.stack([np.asarray(dec)[i, i] for i in range(6)])
+    np.testing.assert_allclose(rec, targets, rtol=1e-4, atol=1e-4)
+
+
+def test_bipartite_match_golden():
+    """Reference bipartite_match_op.cc greedy global-argmax semantics."""
+    sim = np.array([
+        [0.8, 0.1, 0.3],
+        [0.7, 0.9, 0.2],
+    ], np.float32)
+    idx, dist = V.bipartite_match(jnp.asarray(sim))
+    # best global: (1,1)=0.9 -> then (0,0)=0.8; col 2 unmatched
+    np.testing.assert_array_equal(np.asarray(idx), [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(dist), [0.8, 0.9, 0.0], rtol=1e-6)
+
+
+def np_greedy_nms(boxes, scores, thr, top_k):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if scores[i] <= 0:
+            continue
+        ok = True
+        for j in keep:
+            if np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > thr:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+            if len(keep) >= top_k:
+                break
+    return keep
+
+
+def test_multiclass_nms_matches_numpy_reference():
+    rs = np.random.RandomState(3)
+    M, C = 40, 3
+    ctr = rs.rand(M, 2) * 80
+    wh = rs.rand(M, 2) * 20 + 4
+    boxes = np.concatenate([ctr - wh / 2, ctr + wh / 2], -1).astype(np.float32)
+    scores = rs.rand(C, M).astype(np.float32)
+    scores[scores < 0.2] = 0.0
+
+    out, nvalid = V.multiclass_nms(jnp.asarray(boxes), jnp.asarray(scores),
+                                   score_threshold=0.3, nms_top_k=20,
+                                   keep_top_k=10, nms_threshold=0.45)
+    out = np.asarray(out)
+    # numpy reference: per-class greedy NMS then global top-k by score
+    cand = []
+    for c in range(C):
+        s = scores[c].copy()
+        s[s < 0.3] = 0.0
+        for i in np_greedy_nms(boxes, s, 0.45, 20):
+            cand.append((c, s[i], *boxes[i]))
+    cand.sort(key=lambda t: -t[1])
+    cand = cand[:10]
+    assert int(nvalid) == len(cand)
+    got_valid = out[out[:, 0] >= 0]
+    np.testing.assert_allclose(
+        got_valid[:, 1], [t[1] for t in cand], rtol=1e-5)
+    np.testing.assert_array_equal(
+        got_valid[:, 0].astype(int), [t[0] for t in cand])
+    np.testing.assert_allclose(got_valid[:, 2:],
+                               np.asarray([t[2:] for t in cand]), rtol=1e-5)
+
+
+def test_matrix_nms_decay_semantics():
+    """Two heavily-overlapping boxes + one far box: the overlapped
+    lower-scored box is decayed by (1-iou)/(1-0), the far box untouched
+    (reference matrix_nms_op.cc NMSMatrix)."""
+    boxes = np.array([[0, 0, 10, 10], [1, 0, 11, 10], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+    out, nvalid = V.matrix_nms(jnp.asarray(boxes), jnp.asarray(scores),
+                               score_threshold=0.1, post_threshold=0.0,
+                               nms_top_k=3, keep_top_k=3)
+    out = np.asarray(out)
+    iou = np_iou(boxes[:1], boxes[1:2])[0, 0]
+    assert int(nvalid) == 3
+    np.testing.assert_allclose(
+        sorted(out[:, 1], reverse=True),
+        sorted([0.9, 0.8 * (1 - iou), 0.7], reverse=True), rtol=1e-5)
+
+
+def test_roi_align_golden_and_grad():
+    """Constant feature map → every bin equals the constant; plus FD
+    gradient through the bilinear sampling."""
+    feat = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    bidx = np.array([0], np.int32)
+    out = V.roi_align(jnp.asarray(feat), jnp.asarray(rois),
+                      jnp.asarray(bidx), 4, spatial_scale=1.0,
+                      sampling_ratio=2)
+    assert out.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(np.asarray(out), 3.5, rtol=1e-6)
+
+    rs = np.random.RandomState(4)
+    feat = rs.randn(1, 2, 8, 8).astype(np.float32)
+    with jax.enable_x64(True):
+        check_grad(
+            lambda f: V.roi_align(f, jnp.asarray(rois, jnp.float64),
+                                  jnp.asarray(bidx), 3, sampling_ratio=2),
+            [jnp.asarray(feat, jnp.float64)], wrt=(0,))
+
+
+def test_anchor_generator_and_prior_box_shapes():
+    anchors, var = V.anchor_generator((4, 6), [32, 64], [0.5, 1.0, 2.0],
+                                      (16, 16))
+    assert anchors.shape == (4, 6, 6, 4) and var.shape == anchors.shape
+    # center of cell (0,0) is offset*stride
+    ctr = np.asarray((anchors[0, 0, 0, :2] + anchors[0, 0, 0, 2:]) / 2)
+    np.testing.assert_allclose(ctr, [8.0, 8.0], atol=1e-5)
+
+    boxes, pvar = V.prior_box((3, 3), (300, 300), min_sizes=[30.0],
+                              max_sizes=[60.0], aspect_ratios=[2.0])
+    assert boxes.shape[-1] == 4 and boxes.shape[:2] == (3, 3)
+    # priors: min, sqrt ratios (2, 1/2), sqrt(min*max) → 4 per cell
+    assert boxes.shape[2] == 4
+
+
+def test_distance_bbox_roundtrip():
+    rs = np.random.RandomState(5)
+    pts = rs.rand(10, 2).astype(np.float32) * 100
+    dist = np.abs(rs.rand(10, 4)).astype(np.float32) * 20
+    boxes = V.distance2bbox(jnp.asarray(pts), jnp.asarray(dist))
+    back = V.bbox2distance(jnp.asarray(pts), boxes)
+    np.testing.assert_allclose(np.asarray(back), dist, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# PP-YOLOE model
+# ---------------------------------------------------------------------------
+
+def _toy_batch(rs, n=2, size=64, n_cls=4, n_gt=3):
+    imgs = rs.randn(n, 3, size, size).astype(np.float32) * 0.1
+    gt_boxes = np.zeros((n, n_gt, 4), np.float32)
+    gt_labels = np.full((n, n_gt), -1, np.int32)
+    for i in range(n):
+        k = rs.randint(1, n_gt + 1)
+        for g in range(k):
+            cx, cy = rs.rand(2) * (size - 24) + 12
+            w, h = rs.rand(2) * 20 + 10
+            gt_boxes[i, g] = [max(cx - w, 0), max(cy - h, 0),
+                              min(cx + w, size), min(cy + h, size)]
+            gt_labels[i, g] = rs.randint(0, n_cls)
+    return (jnp.asarray(imgs), jnp.asarray(gt_boxes),
+            jnp.asarray(gt_labels))
+
+
+def test_ppyoloe_trains_loss_falls():
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.nn.stateful import state_tape, merge_state
+    from paddle_tpu.vision.models import ppyoloe_tiny
+
+    paddle_tpu.seed(0)
+    rs = np.random.RandomState(0)
+    model = ppyoloe_tiny(num_classes=4)
+    imgs, gtb, gtl = _toy_batch(rs)
+    opt = optim.Momentum(5e-4, momentum=0.9)
+    opt_state = opt.init(model)
+
+    @jax.jit
+    def step(model, opt_state):
+        def lf(m):
+            with state_tape() as tape:
+                loss = m.loss(imgs, gtb, gtl, training=True)
+            return loss, dict(tape)
+        (loss, tape), grads = jax.value_and_grad(lf, has_aux=True)(model)
+        model, opt_state = opt.apply_gradients(model, grads, opt_state)
+        model = merge_state(model, tape)
+        return model, opt_state, loss
+
+    losses = []
+    for _ in range(8):
+        model, opt_state, loss = step(model, opt_state)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_ppyoloe_predict_fixed_shape():
+    from paddle_tpu.vision.models import ppyoloe_tiny
+
+    paddle_tpu.seed(0)
+    model = ppyoloe_tiny(num_classes=4)
+    imgs = jnp.asarray(np.random.RandomState(1).randn(2, 3, 64, 64),
+                       jnp.float32)
+    out, nvalid = jax.jit(lambda m, x: m.predict(x))(model, imgs)
+    assert out.shape == (2, model.config.keep_top_k, 6)
+    assert nvalid.shape == (2,)
+    out = np.asarray(out)
+    valid_rows = out[out[:, :, 0].astype(int) >= 0]
+    # scores in [0, 1], labels in range
+    assert (valid_rows[:, 1] >= 0).all() and (valid_rows[:, 1] <= 1).all()
+    assert (valid_rows[:, 0] < 4).all()
