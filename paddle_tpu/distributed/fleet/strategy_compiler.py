@@ -156,14 +156,14 @@ def build_train_step(model, optimizer, loss_fn=None, *,
         # *other* steps, e.g. plain-sp grads abort under GSPMD on CPU.
         # (Tracked upstream; revisit when sdy supports nesting.)
         use_gspmd = True
+    elif (use_pp and pp_cfg.schedule == "1f1b" and strategy.amp.enable):
+        # amp casts inside the 1F1B shard_map trip a Shardy lowering crash
+        # ("Invalid binary instruction opcode copy") — same scoped GSPMD
+        # fallback
+        use_gspmd = True
     else:
         use_gspmd = False
     if use_1f1b:
-        if strategy.amp.enable:
-            raise NotImplementedError(
-                "1f1b + amp autocast: build the model in the target dtype "
-                "instead (the manual pipeline backward does not re-derive "
-                "the cast chain)")
         if loss_fn is not None:
             raise ValueError(
                 "1f1b computes the loss per-microbatch on the last stage "
@@ -268,18 +268,49 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
         if use_1f1b:
             # manual 1F1B schedule: loss computed per-microbatch on the
-            # last stage, backward interleaved (pipeline_1f1b.py); no
-            # state tape / loss scaling on this path (validated above).
-            # Deliberately NO rng.stream here: the backward recomputes the
-            # stage forward in a separate trace, so dropout would draw
-            # different masks — without a stream, F.dropout fails fast
-            # instead of silently corrupting gradients.
+            # last stage, backward interleaved (pipeline_1f1b.py). The
+            # schedule derives per-(stage, microbatch, layer) dropout
+            # streams from `key` so the backward's recompute replays the
+            # forward's masks; AMP rides a jax.vjp through cast_model
+            # (grads land on the fp32 masters) and fp16 loss scaling
+            # multiplies the backward seed. No state tape on this path
+            # (stateful layers inside pipelined blocks are not supported
+            # by the manual schedule).
             from paddle_tpu.parallel import pipeline_1f1b
+
+            cot_scale = (state.scaler.loss_scaling if use_scaler else None)
+
+            def pipe_loss_grads(m):
+                # fp32 grads whenever masters are fp32 (the amp path
+                # re-casts onto them; a downcast round-trip would discard
+                # the fp32 accumulation and could overflow scaled fp16)
+                return pipeline_1f1b.loss_and_grads(
+                    m, batch, mesh, key=key, cotangent_scale=cot_scale,
+                    keep_fp32_grads=amp_enabled)
+
             with RecordEvent("forward_backward"):
-                loss, grads = pipeline_1f1b.loss_and_grads(model, batch,
-                                                           mesh)
+                if amp_enabled:
+                    # the VJP of cast_model is just the reverse cast
+                    # (transpose of convert), applied by hand: grads land
+                    # on the fp32 masters. (An actual jax.vjp over
+                    # cast_model trips an XLA CPU crash inside the
+                    # pipeline shard_map graph.)
+                    with amp_mod.auto_cast(
+                            enable=True, dtype=str(amp_dtype),
+                            custom_white_list=amp_cfg.custom_white_list,
+                            custom_black_list=amp_cfg.custom_black_list):
+                        loss, grads_c = pipe_loss_grads(
+                            amp_mod.cast_model(model, amp_dtype))
+                    grads = jax.tree_util.tree_map(
+                        lambda g, p: (g.astype(p.dtype)
+                                      if hasattr(p, "dtype") else g),
+                        grads_c, model)
+                else:
+                    loss, grads = pipe_loss_grads(model)
             tape = {}
-            all_finite = jnp.asarray(True)
+            grads, all_finite = (scaler.unscale(grads, state.scaler)
+                                 if use_scaler else
+                                 (grads, jnp.asarray(True)))
         elif use_fp16_ar:
             # fp16/bf16-compressed gradient all-reduce: compute per-shard
             # grads inside a shard_map over the data axes and psum them in

@@ -120,3 +120,47 @@ class GPTForCausalLM(Module):
         return F.cross_entropy(
             logits[:, :-1].astype(jnp.float32), labels[:, 1:],
             ignore_index=ignore_index)
+
+    def pipeline_parts(self):
+        """1F1B decomposition (``parallel/pipeline_1f1b.py``): token+pos
+        embedding (+ input dropout) on stage 0, blocks pipelined, final
+        LN + lm head on the last stage."""
+        embed = _GPTEmbed(self.embed, self.pos_embed, self.drop)
+        head = (self.ln_f, self.lm_head)
+
+        def head_loss_sum(head, h, labels):
+            ln_f, lm_head = head
+            logits = lm_head(ln_f(h)).astype(jnp.float32)
+            return F.cross_entropy(logits[:, :-1], labels[:, 1:],
+                                   reduction="sum")
+
+        from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom \
+            as loss_denom
+
+        model = self
+
+        def assemble(dembed, dblocks_stacked, dhead):
+            import jax
+
+            g = jax.tree_util.tree_map(jnp.zeros_like, model)
+            return g.replace(
+                embed=dembed.embed, pos_embed=dembed.pos_embed,
+                ln_f=dhead[0], lm_head=dhead[1],
+                blocks=g.blocks.replace(block=dblocks_stacked))
+
+        return (embed, self.blocks, head, head_loss_sum, loss_denom,
+                assemble)
+
+
+class _GPTEmbed(Module):
+    """Stage-0 piece for the 1F1B pipeline: token + learned-position
+    embedding with the input dropout."""
+
+    def __init__(self, embed, pos_embed, drop):
+        self.embed = embed
+        self.pos_embed = pos_embed
+        self.drop = drop
+
+    def __call__(self, ids, training: bool = False):
+        x = self.embed(ids) + self.pos_embed(jnp.arange(ids.shape[1]))
+        return self.drop(x, training=training)

@@ -252,34 +252,41 @@ class LlamaForCausalLM(Module):
     def pipeline_parts(self):
         """Decomposition for schedule-managed pipelines (1F1B,
         ``paddle_tpu/parallel/pipeline_1f1b.py``): (embed, blocks, head,
-        head_loss_fn, assemble). The head (final norm + lm_head + loss)
-        must be self-contained on the last stage, so tied embeddings are
-        unsupported here."""
-        if self.lm_head is None:
-            raise NotImplementedError(
-                "1f1b pipeline needs an untied lm_head (the head runs on "
-                "the last stage; tied embeddings would couple it to the "
-                "first stage's embedding table)")
-        head = (self.norm, self.lm_head)
+        head_loss_fn, loss_denom, assemble). Tied embeddings are
+        supported: the head then carries the embedding table and
+        ``assemble`` sums its head-side gradient into the embedding
+        gradient (the grad-contribution hop back to stage 0)."""
+        tied = self.lm_head is None
+        head = ((self.norm, self.embed.weight) if tied
+                else (self.norm, self.lm_head))
 
         def head_loss_sum(head, h, labels):
             """SUM of per-token losses for one microbatch (the pipeline
             divides by the global valid count, so uneven ignore_index
             distributions across microbatches stay exactly equivalent to
             the full-batch mean of ``model.loss``)."""
-            norm, lm_head = head
-            logits = lm_head(norm(h)).astype(jnp.float32)
+            norm, out = head
+            if tied:
+                logits = (norm(h) @ out.T).astype(jnp.float32)
+            else:
+                logits = out(norm(h)).astype(jnp.float32)
             return F.cross_entropy(logits[:, :-1], labels[:, 1:],
                                    reduction="sum")
 
-        def loss_denom(labels):
-            return jnp.maximum(
-                jnp.sum((labels[:, 1:] != -100).astype(jnp.float32)), 1.0)
+        from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom \
+            as loss_denom
 
         model = self
 
         def assemble(dembed, dblocks_stacked, dhead):
             g = jax.tree_util.tree_map(jnp.zeros_like, model)
+            if tied:
+                demb = dembed.replace(
+                    weight=dembed.weight + dhead[1].astype(
+                        dembed.weight.dtype))
+                return g.replace(
+                    embed=demb, norm=dhead[0],
+                    blocks=g.blocks.replace(block=dblocks_stacked))
             return g.replace(
                 embed=dembed, norm=dhead[0], lm_head=dhead[1],
                 blocks=g.blocks.replace(block=dblocks_stacked))

@@ -32,10 +32,19 @@ interleave possible: cotangents exist the moment a microbatch's forward
 finishes. Models opt in via ``pipeline_parts()`` (embed / blocks / head
 decomposition + gradient reassembly).
 
-Limitations (explicit): no dropout inside pipelined blocks (the manual
-backward recompute would need replayed RNG streams), no fp16 dynamic
-loss scaling, no tied embeddings (head must be self-contained on the
-last stage).
+Dropout works: layer keys are derived from (stage rank, microbatch
+index, layer) — NOT the tick — so the backward sub-tick's recompute of
+microbatch ``b`` replays exactly the masks its forward sub-tick drew
+(the SectionWorker runs arbitrary section programs per microbatch,
+dropout included; this is the functional equivalent). AMP and fp16
+dynamic loss scaling compose from the strategy compiler: the model is
+cast through a ``jax.vjp`` of ``cast_model`` (grads land on the fp32
+masters) and the loss-scale multiplies the backward seed
+(``cotangent_scale``). Tied embeddings work through
+``pipeline_parts()``: the head may carry the embedding table and
+``assemble`` sums its head-side gradient into the embedding gradient —
+the grad-contribution hop back to stage 0 is just an add in the
+assembled tree.
 """
 
 from __future__ import annotations
@@ -45,6 +54,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.core import rng as _rng
 from paddle_tpu.nn.scan import REMAT_POLICIES
 from paddle_tpu.parallel import collective as C
 
@@ -57,10 +67,23 @@ def ring_buffer_slots(num_stages: int, num_microbatches: int) -> int:
     return min(num_microbatches, 2 * num_stages - 1)
 
 
-def loss_and_grads(model, batch, mesh, *, training: bool = True):
+def loss_and_grads(model, batch, mesh, *, training: bool = True,
+                   key=None, cotangent_scale=None,
+                   keep_fp32_grads: bool = False):
     """Compute (loss, grads) for a pipeline-decomposable model under the
     1F1B schedule. ``model.blocks`` must already be the pipelined
-    executor (strategy compiler applies the override first)."""
+    executor (strategy compiler applies the override first).
+
+    ``key``: dropout RNG; per-layer streams are derived from
+    (stage, microbatch, layer) so the backward recompute replays the
+    forward's masks exactly. ``cotangent_scale``: optional loss-scale
+    multiplier on the backward seed (fp16 dynamic scaling) — the
+    returned loss stays unscaled. ``keep_fp32_grads``: return the fp32
+    accumulators instead of downcasting to the parameter dtype — set it
+    when the caller maintains fp32 master weights (the AMP path), so the
+    accumulated precision isn't rounded away (and a scaled-fp16 sum
+    can't overflow on the way out).
+    """
     (embed, pblocks, head, head_loss_fn, loss_denom,
      assemble) = model.pipeline_parts()
     S = pblocks.num_stages
@@ -71,8 +94,17 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
     # even when ignore_index tokens are distributed unevenly across
     # microbatches
     inv_denom = 1.0 / loss_denom(labels)
+    if cotangent_scale is None:
+        cotangent_scale = jnp.ones((), jnp.float32)
 
-    x, embed_vjp = jax.vjp(lambda e: e(ids), embed)
+    def embed_call(e):
+        if key is not None:
+            with _rng.stream(jax.random.fold_in(key, 0x0E0B)):
+                return e(ids, training=training) if _wants_training(e) \
+                    else e(ids)
+        return e(ids, training=training) if _wants_training(e) else e(ids)
+
+    x, embed_vjp = jax.vjp(embed_call, embed)
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
@@ -82,26 +114,48 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
     block = pblocks.block
     remat = pblocks.remat
     policy = REMAT_POLICIES[pblocks.remat_policy]
-
-    def stage_fwd(blk, h):
-        def bstep(c, layer):
-            return layer(c, training=training), None
-        if remat:
-            bstep = jax.checkpoint(bstep, policy=policy, prevent_cse=False)
-        h, _ = lax.scan(bstep, h, blk)
-        return h
+    L_local = pblocks.n_layers // S
 
     N = M + 2 * (S - 1)          # total ticks
     K = ring_buffer_slots(S, M)  # saved-input ring buffer
 
-    def pp_body(blk, head_p, x_mb, labels_mb, inv_denom):
+    def pp_body(blk, head_p, x_mb, labels_mb, inv_denom, cot_scale):
         r = lax.axis_index("pp")
+        # dropout streams keyed by (stage, microbatch, layer): identical
+        # in the forward sub-tick and the backward recompute of the same
+        # microbatch — tick-keyed streams would NOT replay
+        stage_key = (jax.random.fold_in(key, r) if key is not None
+                     else None)
+
+        def stage_fwd(blk, h, mb_idx):
+            keys = (jax.random.split(
+                jax.random.fold_in(stage_key, mb_idx), L_local)
+                if stage_key is not None else None)
+
+            def bstep(c, layer_and_key):
+                if keys is not None:
+                    layer, lk = layer_and_key
+                    with _rng.stream(lk):
+                        return layer(c, training=training), None
+                return layer_and_key(c, training=training), None
+
+            if remat:
+                bstep = jax.checkpoint(bstep, policy=policy,
+                                       prevent_cse=False)
+            xs = (blk, keys) if keys is not None else blk
+            h, _ = lax.scan(bstep, h, xs)
+            return h
+
         mb_shape = x_mb.shape[1:]
+        # gradient accumulators are fp32 regardless of the compute dtype:
+        # summing M microbatch grads in bf16 loses precision, and bf16
+        # accumulator carries trip an XLA CPU crash ("Invalid binary
+        # instruction opcode copy") in vjp-in-scan-in-shard_map graphs
         init = (
             jnp.zeros((K,) + mb_shape, x_mb.dtype),             # h_saved
-            jax.tree_util.tree_map(jnp.zeros_like, blk),        # gblk
-            jax.tree_util.tree_map(jnp.zeros_like, head_p),     # ghead
-            jnp.zeros_like(x_mb),                               # dx_mb
+            jax.tree_util.tree_map(_acc_zeros, blk),            # gblk
+            jax.tree_util.tree_map(_acc_zeros, head_p),         # ghead
+            jnp.zeros(x_mb.shape, jnp.float32),                 # dx_mb
             jnp.zeros(mb_shape, x_mb.dtype),                    # state_f
             jnp.zeros(mb_shape, x_mb.dtype),                    # state_b
             jnp.zeros((), jnp.float32),                         # loss_acc
@@ -119,7 +173,7 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
             # ---- forward sub-tick: microbatch f ----
             feed = lax.dynamic_index_in_dim(x_mb, fc, 0, keepdims=False)
             h_in = jnp.where(r == 0, feed, state_f)
-            y = stage_fwd(blk, h_in)
+            y = stage_fwd(blk, h_in, fc)
             slot_prev = lax.dynamic_index_in_dim(h_saved, fc % K, 0,
                                                  keepdims=False)
             h_saved = lax.dynamic_update_index_in_dim(
@@ -128,10 +182,19 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
             # ---- last stage: per-microbatch head loss + its VJP ----
             lab = lax.dynamic_index_in_dim(labels_mb, fc, 0, keepdims=False)
 
+            def head_loss_with_rng(hp, h):
+                if stage_key is not None:
+                    with _rng.stream(jax.random.fold_in(
+                            jax.random.fold_in(stage_key, 0x4EAD), fc)):
+                        return head_loss_fn(hp, h, lab)
+                return head_loss_fn(hp, h, lab)
+
             def head_branch(y):
-                loss_m, vjp = jax.vjp(
-                    lambda hp, h: head_loss_fn(hp, h, lab), head_p, y)
-                dhead_m, dy = vjp(inv_denom.astype(loss_m.dtype))
+                loss_m, vjp = jax.vjp(head_loss_with_rng, head_p, y)
+                # fp16 loss scaling rides the backward seed only — loss_m
+                # stays unscaled for metrics
+                seed = (inv_denom * cot_scale).astype(loss_m.dtype)
+                dhead_m, dy = vjp(seed)
                 return loss_m.astype(jnp.float32), dhead_m, dy
 
             def skip_branch(y):
@@ -142,22 +205,26 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
             loss_m, dhead_m, dy_own = lax.cond(
                 jnp.logical_and(r == S - 1, do_f), head_branch, skip_branch,
                 y)
-            ghead = jax.tree_util.tree_map(jnp.add, ghead, dhead_m)
+            ghead = jax.tree_util.tree_map(
+                lambda a, g: a + _acc_cast(g), ghead, dhead_m)
             loss_acc = loss_acc + loss_m * inv_denom
 
-            # ---- backward sub-tick: microbatch b ----
+            # ---- backward sub-tick: microbatch b (recompute replays the
+            # microbatch's own dropout keys via bc) ----
             dy = jnp.where(r == S - 1, dy_own, state_b)
             h_b = lax.dynamic_index_in_dim(h_saved, bc % K, 0,
                                            keepdims=False)
-            _, svjp = jax.vjp(stage_fwd, blk, h_b)
+            _, svjp = jax.vjp(lambda bl, h: stage_fwd(bl, h, bc), blk, h_b)
             gb, dh_in = svjp(dy.astype(x_mb.dtype))
             gblk = jax.tree_util.tree_map(
-                lambda a, g: a + jnp.where(do_b, g, jnp.zeros_like(g)),
+                lambda a, g: a + jnp.where(do_b, _acc_cast(g),
+                                           jnp.zeros_like(a)),
                 gblk, gb)
             dx_prev = lax.dynamic_index_in_dim(dx_mb, bc, 0, keepdims=False)
             dx_mb = lax.dynamic_update_index_in_dim(
                 dx_mb,
-                jnp.where(jnp.logical_and(r == 0, do_b), dh_in, dx_prev),
+                jnp.where(jnp.logical_and(r == 0, do_b),
+                          dh_in.astype(jnp.float32), dx_prev),
                 bc, 0)
 
             # ---- wire hops: activations →, cotangents ← ----
@@ -177,11 +244,51 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True):
 
     loss, gblk, ghead, dx_mb = jax.shard_map(
         pp_body, mesh=mesh, axis_names={"pp"},
-        in_specs=(P("pp"), P(), P(), P(), P()),
+        in_specs=(P("pp"), P(), P(), P(), P(), P()),
         out_specs=(P(), P("pp"), P(), P()),
         check_vma=False,
-    )(block, head, x_mb, labels_mb, jnp.asarray(inv_denom, jnp.float32))
+    )(block, head, x_mb, labels_mb, jnp.asarray(inv_denom, jnp.float32),
+      jnp.asarray(cotangent_scale, jnp.float32))
 
-    (dembed,) = embed_vjp(dx_mb.reshape(x.shape))
+    if not keep_fp32_grads:
+        # cast the fp32 accumulators back to the parameter dtypes
+        gblk = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
+            gblk, block)
+        ghead = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
+            ghead, head)
+    (dembed,) = embed_vjp(dx_mb.reshape(x.shape).astype(x.dtype))
     grads = assemble(dembed, gblk, ghead)
     return loss, grads
+
+
+def default_loss_denom(labels, ignore_index: int = -100):
+    """Global valid-next-token count for shifted-label LM losses — the
+    shared denominator every ``pipeline_parts`` head uses so uneven
+    ignore_index distributions across microbatches stay exactly
+    equivalent to the full-batch mean loss."""
+    return jnp.maximum(
+        jnp.sum((labels[:, 1:] != ignore_index).astype(jnp.float32)), 1.0)
+
+
+def _acc_zeros(p):
+    if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.inexact):
+        return jnp.zeros(p.shape, jnp.float32)
+    return jnp.zeros_like(p)
+
+
+def _acc_cast(g):
+    if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact):
+        return g.astype(jnp.float32)
+    return g
+
+
+def _wants_training(e) -> bool:
+    import inspect
+
+    try:
+        return "training" in inspect.signature(
+            type(e).__call__).parameters
+    except (TypeError, ValueError):
+        return False
