@@ -17,4 +17,4 @@ from paddle_tpu.data.sampler import (
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
     SequenceSampler,
 )
-from paddle_tpu.data.dataloader import DataLoader, default_collate
+from paddle_tpu.data.dataloader import DataLoader, default_collate, ragged_collate

@@ -59,6 +59,50 @@ def default_collate(samples):
     return np.stack([np.asarray(s) for s in samples])
 
 
+def ragged_collate(pad_value=0, bucket: int = 64, max_len: int | None = None):
+    """Collate for variable-length samples (the LoD feed of the
+    reference's sequence workloads, e.g. Imdb/Conll05st token ids).
+
+    Returns a collate_fn: every field whose elements are arrays of rank
+    ≥ 1 is treated as a sequence field — padded along dim 0 to the batch
+    max rounded up to a multiple of ``bucket`` (bounds the number of
+    distinct shapes XLA ever compiles, and keeps the batch structure
+    identical whether or not a particular batch happens to have equal
+    lengths) and replaced by a ``(padded [B, T, ...], lengths [B])``
+    pair — the static (dense, lengths) encoding every op in
+    ``paddle_tpu.ops.sequence`` consumes. Scalar fields (labels) stack.
+    ``max_len`` is a hard cap: longer sequences are truncated and the
+    padded width never exceeds it. All vectorized numpy — no per-token
+    Python loops.
+    """
+
+    def pad_field(arrs):
+        lengths = np.asarray([a.shape[0] for a in arrs], np.int32)
+        t = max(-(-int(lengths.max()) // bucket) * bucket, bucket)
+        if max_len is not None:
+            t = min(t, max_len)
+        out = np.full((len(arrs), t) + arrs[0].shape[1:], pad_value,
+                      arrs[0].dtype)
+        for i, a in enumerate(arrs):                 # per-sample memcpy
+            n = min(a.shape[0], t)
+            out[i, :n] = a[:n]
+        return out, np.minimum(lengths, t)
+
+    def collate(samples):
+        first = samples[0]
+        if isinstance(first, dict):
+            return {k: collate([s[k] for s in samples]) for k in first}
+        if isinstance(first, (tuple, list)):
+            return type(first)(collate([s[i] for s in samples])
+                               for i in range(len(first)))
+        arrs = [np.asarray(s) for s in samples]
+        if arrs[0].ndim >= 1:
+            return pad_field(arrs)
+        return np.stack(arrs)
+
+    return collate
+
+
 class DataLoader:
     def __init__(self, dataset, *, batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, collate_fn: Callable | None = None,

@@ -281,9 +281,15 @@ class LlamaForCausalLM(Module):
         def assemble(dembed, dblocks_stacked, dhead):
             g = jax.tree_util.tree_map(jnp.zeros_like, model)
             if tied:
+                # sum in the promoted dtype: under keep_fp32_grads the
+                # head-side grad is fp32 and must stay fp32 (a downcast
+                # to a cast fp16 embed dtype could overflow the scaled
+                # gradient and always discards the fp32 accumulation)
+                pt = jnp.promote_types(dembed.weight.dtype,
+                                       dhead[1].dtype)
                 demb = dembed.replace(
-                    weight=dembed.weight + dhead[1].astype(
-                        dembed.weight.dtype))
+                    weight=dembed.weight.astype(pt)
+                    + dhead[1].astype(pt))
                 return g.replace(
                     embed=demb, norm=dhead[0],
                     blocks=g.blocks.replace(block=dblocks_stacked))

@@ -9,5 +9,6 @@ XLA fuse.
 """
 
 from paddle_tpu.ops import pallas  # noqa: F401
+from paddle_tpu.ops import sequence  # noqa: F401
 
-__all__ = ["pallas"]
+__all__ = ["pallas", "sequence"]

@@ -1,0 +1,255 @@
+"""Sequence / ragged operators — the LoD op family, TPU-native.
+
+The reference handles variable-length data with LoD tensors and ~20
+per-sequence CPU/CUDA loops under
+``paddle/fluid/operators/sequence_ops/`` (``sequence_pool_op.cc``,
+``sequence_conv_op.cc``, ``sequence_pad_op.cc``, …, plus
+``operators/math/sequence_pooling.cu``). LoD — a host-side list of
+offsets changing per batch — cannot exist under XLA's static shapes, so
+the TPU representation is **(padded dense, lengths)** for batched data
+and **(flat values, segment_ids)** for fully ragged data; every op here
+is a masked static-shape computation over one of those two encodings.
+
+Segment reductions are the ``SelectedRows``/sequence-pooling analogue
+and vectorize onto the VPU via one-hot matmuls or sort-free scatters
+(``jax.ops.segment_sum``); everything jits, vmaps and shards.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_softmax", "sequence_reverse", "sequence_concat",
+    "sequence_expand_as", "sequence_conv", "sequence_enumerate",
+    "sequence_erase", "sequence_first_step", "sequence_last_step",
+]
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (flat values + segment ids)
+# ---------------------------------------------------------------------------
+
+def segment_sum(data, segment_ids, num_segments: int):
+    """Sum rows of ``data`` by segment (the LoD-free pooling substrate;
+    reference ``operators/math/sequence_pooling.cu`` SumPool)."""
+    return jax.ops.segment_sum(data, segment_ids,
+                               num_segments=num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments: int):
+    s = segment_sum(data, segment_ids, num_segments)
+    n = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                            segment_ids, num_segments=num_segments)
+    shape = (num_segments,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(n.reshape(shape), 1)
+
+
+def segment_max(data, segment_ids, num_segments: int):
+    return jax.ops.segment_max(data, segment_ids,
+                               num_segments=num_segments)
+
+
+def segment_min(data, segment_ids, num_segments: int):
+    return jax.ops.segment_min(data, segment_ids,
+                               num_segments=num_segments)
+
+
+# ---------------------------------------------------------------------------
+# padded-batch ops (dense [B, T, ...] + lengths [B])
+# ---------------------------------------------------------------------------
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.bool_):
+    """[B] lengths → [B, maxlen] validity (reference
+    ``sequence_ops/sequence_mask_op.h``)."""
+    t = jnp.arange(maxlen, dtype=lengths.dtype)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_pad(flat, lengths, maxlen: int, pad_value=0.0):
+    """Pack flat ragged rows ([total, ...] concatenated sequences with
+    [B] lengths) into padded [B, maxlen, ...] (reference
+    ``sequence_pad_op.cc``). ``total`` must equal ``sum(lengths)``; rows
+    beyond each length take ``pad_value``."""
+    B = lengths.shape[0]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), lengths.dtype), jnp.cumsum(lengths)[:-1]])
+    pos = offsets[:, None] + jnp.arange(maxlen, dtype=lengths.dtype)[None]
+    valid = sequence_mask(lengths, maxlen)
+    safe = jnp.clip(pos, 0, flat.shape[0] - 1)
+    out = flat[safe]                                   # [B, maxlen, ...]
+    pad = jnp.asarray(pad_value, flat.dtype)
+    return jnp.where(valid.reshape(valid.shape + (1,) * (flat.ndim - 1)),
+                     out, pad)
+
+
+def sequence_unpad(padded, lengths):
+    """Padded [B, T, ...] → (flat [B*T, ...], flat_valid [B*T] bool,
+    positions [B*T] int32) — the static-shape unpad (reference
+    ``sequence_unpad_op.cc`` emits a dynamic [total] tensor; on TPU the
+    capacity stays B*T and validity is explicit). ``positions`` maps each
+    valid row to its index in the packed order (invalid rows map to the
+    end), so ``flat[argsort(positions)]`` is packed order when needed."""
+    B, T = padded.shape[:2]
+    valid = sequence_mask(lengths, T).reshape(-1)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), lengths.dtype), jnp.cumsum(lengths)[:-1]])
+    pos_in_seq = jnp.broadcast_to(jnp.arange(T, dtype=lengths.dtype),
+                                  (B, T))
+    packed = (offsets[:, None] + pos_in_seq).reshape(-1)
+    packed = jnp.where(valid, packed, B * T - 1).astype(jnp.int32)
+    return padded.reshape((B * T,) + padded.shape[2:]), valid, packed
+
+
+def sequence_pool(padded, lengths, pool_type: str = "sum"):
+    """Pool valid timesteps per sequence: sum/mean/sqrt/max/min/first/
+    last (reference ``sequence_pool_op.h`` + ``math/sequence_pooling``;
+    ``sqrt`` divides the sum by sqrt(len), the reference's SSA pooling)."""
+    B, T = padded.shape[:2]
+    mask = sequence_mask(lengths, T)
+    m = mask.reshape((B, T) + (1,) * (padded.ndim - 2))
+    if pool_type == "sum":
+        return jnp.sum(jnp.where(m, padded, 0), axis=1)
+    if pool_type == "average" or pool_type == "mean":
+        s = jnp.sum(jnp.where(m, padded, 0), axis=1)
+        n = jnp.maximum(lengths, 1).astype(padded.dtype)
+        return s / n.reshape((B,) + (1,) * (padded.ndim - 2))
+    if pool_type == "sqrt":
+        s = jnp.sum(jnp.where(m, padded, 0), axis=1)
+        n = jnp.sqrt(jnp.maximum(lengths, 1).astype(padded.dtype))
+        return s / n.reshape((B,) + (1,) * (padded.ndim - 2))
+    if pool_type == "max":
+        neg = jnp.finfo(padded.dtype).min if jnp.issubdtype(
+            padded.dtype, jnp.floating) else jnp.iinfo(padded.dtype).min
+        return jnp.max(jnp.where(m, padded, neg), axis=1)
+    if pool_type == "min":
+        pos = jnp.finfo(padded.dtype).max if jnp.issubdtype(
+            padded.dtype, jnp.floating) else jnp.iinfo(padded.dtype).max
+        return jnp.min(jnp.where(m, padded, pos), axis=1)
+    if pool_type == "first":
+        return padded[:, 0]
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            padded, idx.reshape((B, 1) + (1,) * (padded.ndim - 2)),
+            axis=1)[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(padded, lengths):
+    return sequence_pool(padded, lengths, "first")
+
+
+def sequence_last_step(padded, lengths):
+    return sequence_pool(padded, lengths, "last")
+
+
+def sequence_softmax(x, lengths):
+    """Per-sequence masked softmax over the time axis of [B, T]
+    (reference ``sequence_softmax_op.h``); padded positions get 0."""
+    mask = sequence_mask(lengths, x.shape[1])
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(mask, x, neg)
+    p = jax.nn.softmax(z, axis=1)
+    return jnp.where(mask, p, 0.0)
+
+
+def sequence_reverse(padded, lengths):
+    """Reverse each sequence's valid prefix, padding stays in place
+    (reference ``sequence_reverse_op.h``)."""
+    B, T = padded.shape[:2]
+    t = jnp.arange(T)
+    idx = jnp.where(t[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - t[None, :], t[None, :])
+    return jnp.take_along_axis(
+        padded, idx.reshape((B, T) + (1,) * (padded.ndim - 2)), axis=1)
+
+
+def sequence_concat(a, a_len, b, b_len):
+    """Concatenate two padded batches per-sequence (reference
+    ``sequence_concat_op.h``): output [B, Ta+Tb, ...] with lengths
+    a_len + b_len."""
+    B, Ta = a.shape[:2]
+    Tb = b.shape[1]
+    T = Ta + Tb
+    t = jnp.arange(T)
+    from_a = t[None, :] < a_len[:, None]
+    ia = jnp.broadcast_to(jnp.clip(t[None, :], 0, Ta - 1), (B, T))
+    ib = jnp.clip(t[None, :] - a_len[:, None], 0, Tb - 1)
+    ga = jnp.take_along_axis(
+        a, ia.reshape((B, T) + (1,) * (a.ndim - 2)), axis=1)
+    gb = jnp.take_along_axis(
+        b, ib.reshape((B, T) + (1,) * (b.ndim - 2)), axis=1)
+    out = jnp.where(from_a.reshape((B, T) + (1,) * (a.ndim - 2)), ga, gb)
+    new_len = a_len + b_len
+    mask = sequence_mask(new_len, T)
+    return jnp.where(mask.reshape((B, T) + (1,) * (a.ndim - 2)), out,
+                     jnp.zeros((), a.dtype)), new_len
+
+
+def sequence_expand_as(x, lengths, maxlen: int):
+    """Broadcast one row per sequence across its timesteps (reference
+    ``sequence_expand_as_op.h``): x [B, ...] → [B, maxlen, ...] masked to
+    the lengths."""
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], maxlen) + x.shape[1:])
+    mask = sequence_mask(lengths, maxlen)
+    return jnp.where(
+        mask.reshape(mask.shape + (1,) * (x.ndim - 1)), out,
+        jnp.zeros((), x.dtype))
+
+
+def sequence_conv(padded, lengths, filter_w, context_start: int = -1,
+                  context_length: int = 3):
+    """Contextual (time-window) projection (reference
+    ``sequence_conv_op.h``: im2col over the context window then GEMM).
+    padded [B, T, E]; filter_w [context_length*E, O]; out [B, T, O];
+    positions outside the sequence contribute zeros."""
+    B, T, E = padded.shape
+    mask = sequence_mask(lengths, T)
+    x = jnp.where(mask[..., None], padded, 0)
+    cols = []
+    for j in range(context_length):
+        off = context_start + j
+        shifted = jnp.roll(x, -off, axis=1)
+        t = jnp.arange(T)
+        ok = (t[None, :] + off >= 0) & (t[None, :] + off < lengths[:, None])
+        cols.append(jnp.where(ok[..., None], shifted, 0))
+    ctx = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*E]
+    out = ctx @ filter_w
+    return jnp.where(mask[..., None], out, 0)
+
+
+def sequence_enumerate(ids, lengths, win_size: int, pad_value: int = 0):
+    """Sliding windows of ids per sequence (reference
+    ``sequence_enumerate_op.h``): [B, T] → [B, T, win_size]; positions
+    past the sequence end take ``pad_value``."""
+    B, T = ids.shape
+    t = jnp.arange(T)
+    out = []
+    for j in range(win_size):
+        shifted = jnp.roll(ids, -j, axis=1)
+        ok = t[None, :] + j < lengths[:, None]
+        out.append(jnp.where(ok, shifted, pad_value))
+    return jnp.stack(out, axis=-1)
+
+
+def sequence_erase(ids, lengths, tokens):
+    """Remove every occurrence of ``tokens`` and left-compact each
+    sequence (reference ``sequence_erase_op.h``). Static shapes: output
+    [B, T] with ``pad`` (0) tail and the new lengths."""
+    B, T = ids.shape
+    tokens = jnp.asarray(tokens)
+    valid = sequence_mask(lengths, T)
+    keep = valid & ~jnp.isin(ids, tokens)
+    # left-compact: stable order of kept tokens via cumsum positions
+    new_pos = jnp.cumsum(keep, axis=1) - 1                 # [B, T]
+    new_len = jnp.sum(keep, axis=1).astype(lengths.dtype)
+    b = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # dropped tokens target index T → out-of-bounds → mode="drop" skips
+    # the write; only kept ids land, at their compacted slots
+    tgt = jnp.where(keep, new_pos, T)
+    out = jnp.zeros_like(ids).at[b, tgt].set(ids, mode="drop")
+    return out, new_len

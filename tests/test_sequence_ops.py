@@ -1,0 +1,207 @@
+"""Sequence/ragged op family (reference
+``paddle/fluid/operators/sequence_ops/*``): golden outputs vs per-sequence
+numpy loops, FD gradients for the pooling family (the OpTest pattern),
+and the ragged DataLoader collate path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import sequence as S
+from tests.op_test import check_grad
+
+
+def ragged(rs, B=4, T=10, E=3):
+    lengths = rs.randint(1, T + 1, (B,)).astype(np.int32)
+    x = rs.randn(B, T, E).astype(np.float32)
+    for i, n in enumerate(lengths):
+        x[i, n:] = 0.0
+    return jnp.asarray(x), jnp.asarray(lengths)
+
+
+def test_sequence_mask():
+    m = S.sequence_mask(jnp.asarray([0, 2, 4]), 4)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[0, 0, 0, 0], [1, 1, 0, 0], [1, 1, 1, 1]])
+
+
+def test_pad_unpad_roundtrip():
+    rs = np.random.RandomState(0)
+    lengths = np.array([3, 1, 4], np.int32)
+    flat = rs.randn(int(lengths.sum()), 2).astype(np.float32)
+    padded = S.sequence_pad(jnp.asarray(flat), jnp.asarray(lengths), 5,
+                            pad_value=-1.0)
+    assert padded.shape == (3, 5, 2)
+    # valid rows match the packed input, padding is the pad value
+    off = 0
+    for i, n in enumerate(lengths):
+        np.testing.assert_allclose(np.asarray(padded)[i, :n],
+                                   flat[off:off + n])
+        np.testing.assert_allclose(np.asarray(padded)[i, n:], -1.0)
+        off += n
+    fl, valid, packed = S.sequence_unpad(padded, jnp.asarray(lengths))
+    got = np.zeros_like(flat)
+    got[np.asarray(packed)[np.asarray(valid)]] = \
+        np.asarray(fl)[np.asarray(valid)]
+    np.testing.assert_allclose(got, flat)
+
+
+@pytest.mark.parametrize("pool", ["sum", "mean", "sqrt", "max", "min",
+                                  "first", "last"])
+def test_sequence_pool_golden(pool):
+    rs = np.random.RandomState(1)
+    x, lengths = ragged(rs)
+    got = np.asarray(S.sequence_pool(x, lengths, pool))
+    xn, ln = np.asarray(x), np.asarray(lengths)
+    for i, n in enumerate(ln):
+        seq = xn[i, :n]
+        ref = {"sum": seq.sum(0), "mean": seq.mean(0),
+               "sqrt": seq.sum(0) / np.sqrt(n), "max": seq.max(0),
+               "min": seq.min(0), "first": seq[0], "last": seq[n - 1]}[pool]
+        np.testing.assert_allclose(got[i], ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("pool", ["sum", "mean", "sqrt", "max"])
+def test_sequence_pool_fd_grad(pool):
+    rs = np.random.RandomState(2)
+    lengths = jnp.asarray([2, 3], jnp.int32)
+    x = jnp.asarray(rs.randn(2, 4, 3).astype(np.float32))
+    with jax.enable_x64(True):
+        check_grad(
+            lambda x: S.sequence_pool(x, lengths, pool),
+            [jnp.asarray(np.asarray(x), jnp.float64)], wrt=(0,))
+
+
+def test_segment_reductions_golden_and_grad():
+    rs = np.random.RandomState(3)
+    data = rs.randn(10, 4).astype(np.float32)
+    seg = np.array([0, 0, 1, 1, 1, 3, 3, 0, 2, 2], np.int32)
+    for name, fn, ref in [
+        ("sum", S.segment_sum, lambda d, m: d[m].sum(0)),
+        ("mean", S.segment_mean, lambda d, m: d[m].mean(0) if m.any()
+         else np.zeros(4)),
+        ("max", S.segment_max, lambda d, m: d[m].max(0) if m.any()
+         else None),
+    ]:
+        got = np.asarray(fn(jnp.asarray(data), jnp.asarray(seg), 4))
+        for s in range(4):
+            m = seg == s
+            expect = ref(data, m)
+            if expect is None:
+                continue
+            np.testing.assert_allclose(got[s], expect, rtol=1e-5,
+                                       atol=1e-6, err_msg=name)
+    with jax.enable_x64(True):
+        check_grad(
+            lambda d: S.segment_sum(d, jnp.asarray(seg), 4),
+            [jnp.asarray(data, jnp.float64)], wrt=(0,))
+        check_grad(
+            lambda d: S.segment_mean(d, jnp.asarray(seg), 4),
+            [jnp.asarray(data, jnp.float64)], wrt=(0,))
+
+
+def test_sequence_softmax_masks_padding():
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(3, 6).astype(np.float32))
+    lengths = jnp.asarray([6, 2, 4], jnp.int32)
+    p = np.asarray(S.sequence_softmax(x, lengths))
+    for i, n in enumerate([6, 2, 4]):
+        np.testing.assert_allclose(p[i, :n].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(p[i, n:], 0.0)
+
+
+def test_sequence_reverse_golden():
+    x = jnp.asarray(np.arange(12).reshape(2, 6).astype(np.float32))
+    lengths = jnp.asarray([4, 6], jnp.int32)
+    got = np.asarray(S.sequence_reverse(x, lengths))
+    np.testing.assert_allclose(got[0], [3, 2, 1, 0, 4, 5])
+    np.testing.assert_allclose(got[1], [11, 10, 9, 8, 7, 6])
+
+
+def test_sequence_concat_golden():
+    a = jnp.asarray(np.arange(6).reshape(2, 3).astype(np.float32))
+    b = jnp.asarray((10 + np.arange(4)).reshape(2, 2).astype(np.float32))
+    out, nl = S.sequence_concat(a, jnp.asarray([2, 3]), b,
+                                jnp.asarray([1, 2]))
+    np.testing.assert_array_equal(np.asarray(nl), [3, 5])
+    np.testing.assert_allclose(np.asarray(out)[0], [0, 1, 10, 0, 0])
+    np.testing.assert_allclose(np.asarray(out)[1], [3, 4, 5, 12, 13])
+
+
+def test_sequence_conv_matches_loop():
+    """Window projection vs an explicit per-position numpy loop
+    (reference sequence_conv_op.h im2col semantics)."""
+    rs = np.random.RandomState(5)
+    B, T, E, O, ctx = 2, 6, 3, 4, 3
+    x, lengths = ragged(rs, B=B, T=T, E=E)
+    w = rs.randn(ctx * E, O).astype(np.float32)
+    got = np.asarray(S.sequence_conv(x, lengths, jnp.asarray(w),
+                                     context_start=-1, context_length=ctx))
+    xn, ln = np.asarray(x), np.asarray(lengths)
+    for i in range(B):
+        for t in range(T):
+            if t >= ln[i]:
+                np.testing.assert_allclose(got[i, t], 0.0)
+                continue
+            cols = []
+            for j in range(ctx):
+                p = t + (-1 + j)
+                cols.append(xn[i, p] if 0 <= p < ln[i]
+                            else np.zeros(E, np.float32))
+            ref = np.concatenate(cols) @ w
+            np.testing.assert_allclose(got[i, t], ref, rtol=1e-5,
+                                       atol=1e-5)
+
+
+def test_sequence_enumerate_and_erase():
+    ids = jnp.asarray([[1, 2, 3, 4, 0, 0], [5, 2, 5, 2, 5, 9]], jnp.int32)
+    lengths = jnp.asarray([4, 6], jnp.int32)
+    win = np.asarray(S.sequence_enumerate(ids, lengths, 2, pad_value=0))
+    np.testing.assert_array_equal(win[0, :4],
+                                  [[1, 2], [2, 3], [3, 4], [4, 0]])
+    out, nl = S.sequence_erase(ids, lengths, jnp.asarray([2, 9]))
+    np.testing.assert_array_equal(np.asarray(nl), [3, 3])
+    np.testing.assert_array_equal(np.asarray(out)[0], [1, 3, 4, 0, 0, 0])
+    np.testing.assert_array_equal(np.asarray(out)[1], [5, 5, 5, 0, 0, 0])
+    # nothing erased + full length: compaction must not clobber the tail
+    out2, nl2 = S.sequence_erase(ids, lengths, jnp.asarray([77]))
+    np.testing.assert_array_equal(np.asarray(out2)[1], [5, 2, 5, 2, 5, 9])
+    np.testing.assert_array_equal(np.asarray(nl2), [4, 6])
+
+
+def test_ragged_collate_dataloader_path():
+    """Variable-length dataset → DataLoader with ragged_collate yields
+    bucketed (padded, lengths) batches; a pooled classifier consumes them
+    with paddle_tpu.ops.sequence — the Imdb/Conll feed shape."""
+    from paddle_tpu.data import DataLoader, ragged_collate
+    from paddle_tpu.data.dataset import Dataset
+
+    rs = np.random.RandomState(6)
+
+    class VarLen(Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            n = 3 + (i * 7) % 50
+            return (rs.randint(1, 100, (n,)).astype(np.int64),
+                    np.int64(i % 2))
+
+    dl = DataLoader(VarLen(), batch_size=4,
+                    collate_fn=ragged_collate(bucket=16))
+    shapes = set()
+    for (ids, lengths), labels in dl:
+        assert ids.shape[0] == 4 and lengths.shape == (4,)
+        assert ids.shape[1] % 16 == 0
+        shapes.add(ids.shape[1])
+        assert labels.shape == (4,)
+        # padding correct: everything beyond each length is 0
+        for i in range(4):
+            assert (ids[i, lengths[i]:] == 0).all()
+        # consume on-device: masked mean pooling
+        emb = jnp.take(jnp.ones((100, 8)), jnp.asarray(ids), axis=0)
+        pooled = S.sequence_pool(emb, jnp.asarray(lengths), "mean")
+        assert np.isfinite(np.asarray(pooled)).all()
+    # bucketing bounds the distinct compile shapes
+    assert len(shapes) <= 4
