@@ -62,10 +62,16 @@ class GradientMergeConfig:
 
 @dataclass
 class LocalSGDConfig:
-    """Reference: localsgd_optimizer.py."""
+    """Reference: localsgd_optimizer.py (fixed-k LocalSGDOptimizer and,
+    with ``adaptive=True``, the AdaComm AdaptiveLocalSGDOptimizer at
+    ``:194`` — loss/lr-driven sync interval, clipped to
+    ``[1, max_k_steps]``)."""
     enable: bool = False
     k_steps: int = 1
     begin_step: int = 1
+    adaptive: bool = False
+    init_k_steps: int = 1
+    max_k_steps: int = 16
 
 
 # DGC (deep gradient compression, reference

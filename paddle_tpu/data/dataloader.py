@@ -27,7 +27,7 @@ from paddle_tpu.core.flags import flag
 from paddle_tpu.data.dataset import Dataset, IterableDataset
 from paddle_tpu.data.sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate"]
+__all__ = ["DataLoader", "default_collate", "ragged_collate"]
 
 _STOP = object()
 

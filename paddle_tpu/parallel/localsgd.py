@@ -15,14 +15,24 @@ which XLA lowers to one all-reduce over ``dp`` — the equivalent of the
 reference's snapshot-delta allreduce, without the snapshot bookkeeping
 (averaging params directly is algebraically identical).
 
-The reference's AdaptiveLocalSGDOptimizer (loss-driven sync interval) is a
-deliberate skip: a data-dependent interval forces either host round-trips
-per step or a traced modulo against a traced k — both worse on TPU than a
-fixed, tuned ``k_steps``.
+The reference's AdaptiveLocalSGDOptimizer
+(``localsgd_optimizer.py:194``, the AdaComm schedule) is supported via
+``strategy.localsgd.adaptive``: the sync interval
+``k = ceil(sqrt(lr_0 * loss_t / (lr_t * loss_0) * init_k))`` (clipped to
+``[1, max_k_steps]``) is recomputed at every sync point. TPU-native
+formulation: rather than threading a traced, data-dependent ``k`` through
+the graph (a traced modulo that would defeat XLA's static schedule), the
+sync decision lives on the *host* and selects between two compiled
+executables — a pure local step and a local+average step. The host only
+blocks on the loss value at sync boundaries (exactly where the reference
+runs its ``c_allreduce_sum`` on the loss), so non-sync steps stay fully
+async. The fixed-``k`` path uses the same two-executable dispatch, which
+also removes the per-step in-graph ``where``-on-synced-params select.
 """
 
 from __future__ import annotations
 
+import weakref
 from typing import Any
 
 import jax
@@ -61,7 +71,15 @@ def build_localsgd_step(model, optimizer, loss_fn=None, *, strategy,
             return m.loss(batch["input_ids"], batch["labels"],
                           training=training)
 
-    k_steps = max(int(cfg.k_steps), 1)
+    adaptive = bool(cfg.adaptive)
+    if adaptive and int(cfg.k_steps) != 1:
+        raise ValueError(
+            "adaptive LocalSGD derives its interval from init_k_steps "
+            f"(got k_steps={cfg.k_steps}); set localsgd.init_k_steps "
+            "instead, or disable adaptive for a fixed k_steps")
+    init_k = max(int(cfg.init_k_steps), 1)
+    max_k = max(int(cfg.max_k_steps), 1)
+    k_steps = init_k if adaptive else max(int(cfg.k_steps), 1)
     begin = max(int(cfg.begin_step), 1)
     train_mask = trainable_mask(model)
 
@@ -76,42 +94,117 @@ def build_localsgd_step(model, optimizer, loss_fn=None, *, strategy,
             lambda u, t: u if t else jnp.zeros_like(u), updates, train_mask)
         return apply_updates(m, updates), new_opt, loss
 
-    def step_fn(state, batch, key):
+    def step_fn(state, batch, key, sched, do_sync: bool):
         keys = jax.random.split(key, n_rep)
         new_model, new_opt, losses = jax.vmap(local_step)(
             state.model, state.opt_state, batch, keys)
         new_step = state.step + 1
-        do_sync = jnp.logical_and(new_step >= begin, new_step % k_steps == 0)
-        # parameter averaging over the replica axis = the reference's
-        # c_allreduce(param - snapshot)/n; buffers averaged too (they are
-        # replica-divergent state just like params)
-        synced = jax.tree_util.tree_map(
-            lambda p: jnp.broadcast_to(
-                jnp.mean(p.astype(jnp.float32), axis=0, keepdims=True),
-                p.shape).astype(p.dtype),
-            new_model)
-        new_model = jax.tree_util.tree_map(
-            lambda s, d: jnp.where(do_sync, s, d), synced, new_model)
+        if do_sync:
+            # parameter averaging over the replica axis = the reference's
+            # c_allreduce(param - snapshot)/n; buffers averaged too (they
+            # are replica-divergent state just like params)
+            new_model = jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(
+                    jnp.mean(p.astype(jnp.float32), axis=0, keepdims=True),
+                    p.shape).astype(p.dtype),
+                new_model)
         metrics = {
             "loss": jnp.mean(losses).astype(jnp.float32),
-            "synced": do_sync,
+            "synced": jnp.asarray(do_sync),
         }
+        # the sync-schedule scalars ride in the (otherwise unused on this
+        # path) scaler slot so they are checkpointed with the TrainState —
+        # the analogue of the reference keeping k_steps/loss_0 as
+        # persistable program variables
         return state._replace(model=new_model, opt_state=new_opt,
-                              step=new_step), metrics
+                              scaler=sched, step=new_step), metrics
 
-    return LocalSGDTrainStep(step_fn, optimizer, mesh, n_rep, donate)
+    lr = optimizer.learning_rate
+    lr_fn = lr if callable(lr) else (lambda step: lr)
+    return LocalSGDTrainStep(
+        step_fn, optimizer, mesh, n_rep, donate, k_steps=k_steps,
+        begin_step=begin, adaptive=adaptive, init_k=init_k, max_k=max_k,
+        lr_fn=lr_fn)
 
 
 class LocalSGDTrainStep:
-    """CompiledTrainStep-compatible wrapper for the LocalSGD path."""
+    """CompiledTrainStep-compatible wrapper for the LocalSGD path.
 
-    def __init__(self, step_fn, optimizer, mesh, n_rep, donate):
+    Host-side sync control: ``__call__`` picks one of two compiled
+    executables (sync / no-sync). In adaptive mode the interval ``k`` is
+    recomputed at every sync with the AdaComm rule the reference's
+    AdaptiveLocalSGDOptimizer uses (``localsgd_optimizer.py:420``):
+    ``k = clip(ceil(sqrt(lr_0 * loss / (lr * loss_0) * init_k)), 1, max_k)``
+    — the interval grows as the learning rate decays or the loss
+    plateaus/rises relative to its initial value, and shrinks again when
+    the loss is falling fast (sync more while progress is cheap to share).
+    """
+
+    def __init__(self, step_fn, optimizer, mesh, n_rep, donate, *,
+                 k_steps=1, begin_step=1, adaptive=False, init_k=1,
+                 max_k=16, lr_fn=None):
         self._step_fn = step_fn
         self._optimizer = optimizer
         self._mesh = mesh
         self.n_replicas = n_rep
         self._donate = donate
         self._jitted = None
+        self._begin = begin_step
+        self._adaptive = adaptive
+        self._init_k = init_k
+        self._max_k = max_k
+        self._lr_fn = lr_fn or (lambda step: 0.0)
+        # host-side mirrors of the sync schedule; the authoritative copy
+        # rides in TrainState.scaler (checkpointed), and the mirrors are
+        # reseeded from any state object this wrapper did not produce
+        self.k_steps = k_steps          # current interval (mutates if adaptive)
+        self._host_step = 0
+        self._last_sync = 0
+        self._loss0 = None
+        self._lr0 = None
+        self._last_out = None
+        self.sync_history: list[int] = []   # host step of every sync
+
+    def _sched_device(self):
+        unset = -1.0
+        return {
+            "k_steps": jnp.asarray(self.k_steps, jnp.int32),
+            "last_sync": jnp.asarray(self._last_sync, jnp.int32),
+            "loss0": jnp.asarray(
+                self._loss0 if self._loss0 is not None else unset,
+                jnp.float32),
+            "lr0": jnp.asarray(
+                self._lr0 if self._lr0 is not None else unset, jnp.float32),
+        }
+
+    def _reseed(self, state):
+        """Adopt the sync schedule of a state this wrapper did not produce
+        (checkpoint restore, fresh init_state): host step and the schedule
+        scalars come from the device state, so resume continues the cadence
+        instead of restarting it."""
+        self._host_step = int(state.step)
+        sched = state.scaler
+        if isinstance(sched, dict) and "k_steps" in sched:
+            vals = jax.device_get(sched)
+            self.k_steps = max(int(vals["k_steps"]), 1)
+            self._last_sync = int(vals["last_sync"])
+            l0, r0 = float(vals["loss0"]), float(vals["lr0"])
+            self._loss0 = l0 if l0 >= 0 else None
+            self._lr0 = r0 if r0 >= 0 else None
+        else:  # state from an older checkpoint without schedule scalars
+            self._last_sync = min(self._last_sync, self._host_step)
+
+    def _ensure_sched_slot(self, state):
+        """Upgrade a pre-schedule-scalars state (scaler=()) so its pytree
+        structure matches what step_fn returns and the shardings expect."""
+        if isinstance(state.scaler, dict) and "k_steps" in state.scaler:
+            return state
+        sched = jax.device_put(
+            self._sched_device(),
+            jax.tree_util.tree_map(
+                lambda _: NamedSharding(self._mesh, P()),
+                self._sched_device()))
+        return state._replace(scaler=sched)
 
     @property
     def mesh(self):
@@ -137,7 +230,8 @@ class LocalSGDTrainStep:
         stack = lambda t: jax.tree_util.tree_map(
             lambda p: (jnp.broadcast_to(p[None], (n,) + p.shape)
                        if hasattr(p, "shape") else p), t)
-        state = TrainState(stack(model), stack(opt_state), (), (),
+        state = TrainState(stack(model), stack(opt_state),
+                           self._sched_device(), (),
                            jnp.zeros((), jnp.int32))
         return jax.device_put(state, self._state_shardings(state))
 
@@ -156,16 +250,76 @@ class LocalSGDTrainStep:
             lambda x: NamedSharding(self._mesh, _stack_spec(x)), batch)
         return jax.device_put(batch, shardings)
 
+    def _should_sync(self, next_step: int) -> bool:
+        if self._adaptive:
+            # reference: sync every step until begin_step, then every k
+            return (next_step <= self._begin
+                    or next_step - self._last_sync >= self.k_steps)
+        return next_step >= self._begin and next_step % self.k_steps == 0
+
+    def _update_interval(self, next_step: int, loss: float) -> None:
+        """AdaComm interval update, run on host at sync boundaries only."""
+        import math
+
+        if not math.isfinite(loss):
+            # diverged/overflowed loss: leave the interval (and a not-yet-
+            # recorded baseline) untouched rather than poisoning them
+            return
+        lr_t = float(jnp.asarray(self._lr_fn(jnp.asarray(next_step))))
+        if self._loss0 is None:
+            self._loss0 = max(loss, 1e-12)
+            self._lr0 = max(lr_t, 1e-12)
+            return
+        if next_step <= self._begin:
+            return
+        ratio = (self._lr0 * max(loss, 0.0)) / (max(lr_t, 1e-12)
+                                                * self._loss0)
+        k = math.ceil(math.sqrt(ratio * self._init_k))
+        self.k_steps = min(max(int(k), 1), self._max_k)
+
     def __call__(self, state, batch, key=None):
         if key is None:
             key = rng.next_key()
+        # identity check via a weakref to the step scalar of the state this
+        # wrapper last returned: a foreign state (checkpoint restore, fresh
+        # init_state) reseeds the host mirrors, and the weakref avoids
+        # pinning a dropped TrainState's replicated params in device memory
+        last_step_arr = self._last_out() if self._last_out else None
+        if state.step is not last_step_arr:
+            self._reseed(state)
+        state = self._ensure_sched_slot(state)  # no-op when slot present
         if self._jitted is None:
             state_sh = self._state_shardings(state)
             data_sh = jax.tree_util.tree_map(
                 lambda x: NamedSharding(self._mesh, _stack_spec(x)), batch)
-            self._jitted = jax.jit(
-                self._step_fn,
-                in_shardings=(state_sh, data_sh, None),
-                out_shardings=(state_sh, None),
-                donate_argnums=(0,) if self._donate else ())
-        return self._jitted(state, batch, key)
+            sched_sh = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self._mesh, P()),
+                self._sched_device())
+            step_fn = self._step_fn
+            self._jitted = {
+                sync: jax.jit(
+                    lambda state, batch, key, sched, _sync=sync: step_fn(
+                        state, batch, key, sched, _sync),
+                    in_shardings=(state_sh, data_sh, None, sched_sh),
+                    out_shardings=(state_sh, None),
+                    donate_argnums=(0,) if self._donate else ())
+                for sync in (False, True)
+            }
+        next_step = self._host_step + 1
+        do_sync = self._should_sync(next_step)
+        if do_sync:
+            self._last_sync = next_step
+            self.sync_history.append(next_step)
+        state, metrics = self._jitted[do_sync](state, batch, key,
+                                               self._sched_device())
+        self._host_step = next_step
+        if do_sync and self._adaptive:
+            # blocks on the replica-averaged loss — only at sync points,
+            # matching the reference's allreduce-on-loss there
+            self._update_interval(next_step, float(metrics["loss"]))
+            # write the post-update schedule back onto the returned state
+            # so a checkpoint taken right after a sync step restores the
+            # grown interval (4 host scalars, sync steps only)
+            state = self._ensure_sched_slot(state._replace(scaler=()))
+        self._last_out = weakref.ref(state.step)
+        return state, metrics

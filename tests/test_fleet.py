@@ -309,6 +309,103 @@ def test_localsgd_k3_diverges_then_syncs(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_adaptive_localsgd_interval_grows_on_plateau(devices8):
+    """AdaptiveLocalSGD (AdaComm, localsgd_optimizer.py:194): with a
+    decaying learning rate and a plateauing loss, the sync interval k must
+    grow — k = ceil(sqrt(lr_0*loss/(lr_t*loss_0)*init_k)) rises as
+    lr_t/lr_0 shrinks faster than loss/loss_0."""
+    paddle_tpu.seed(11)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    s = DistributedStrategy()
+    s.localsgd.enable = True
+    s.localsgd.adaptive = True
+    s.localsgd.init_k_steps = 1
+    s.localsgd.max_k_steps = 8
+    mesh = M.mesh_from_strategy(DistributedStrategy())
+    # lr tiny (loss barely moves = plateau) and halving every step
+    sched = optim.lr.ExponentialDecay(learning_rate=1e-5, gamma=0.5)
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.SGD(sched), strategy=s, mesh=mesh)
+        state = step.init_state(model)
+        for i in range(12):
+            state, m = step(state, step.shard_batch(make_batch()),
+                            jax.random.PRNGKey(i))
+    assert step.k_steps > 1, (step.k_steps, step.sync_history)
+    gaps = np.diff(step.sync_history)
+    assert gaps[-1] > gaps[0], (list(step.sync_history), step.k_steps)
+    assert step.k_steps <= 8  # clipped at max_k_steps
+
+
+def test_adaptive_localsgd_schedule_survives_resume(devices8):
+    """The AdaComm schedule scalars (k, last_sync, loss_0, lr_0) ride in
+    TrainState.scaler, so a fresh wrapper (process restart / checkpoint
+    restore) adopts the grown interval instead of re-baselining to
+    sync-every-step — matching the reference's persistable k_steps/loss_0
+    variables."""
+    paddle_tpu.seed(13)
+    cfg = LlamaConfig.tiny()
+    s = DistributedStrategy()
+    s.localsgd.enable = True
+    s.localsgd.adaptive = True
+    s.localsgd.max_k_steps = 8
+    mesh = M.mesh_from_strategy(DistributedStrategy())
+    sched = optim.lr.ExponentialDecay(learning_rate=1e-5, gamma=0.5)
+
+    def build():
+        model = LlamaForCausalLM(cfg)
+        return dist.fleet.build_train_step(
+            model, optimizer=optim.SGD(sched), strategy=s, mesh=mesh), model
+
+    with M.MeshContext(mesh):
+        step1, model = build()
+        state = step1.init_state(model)
+        for i in range(8):
+            state, _ = step1(state, step1.shard_batch(make_batch()),
+                             jax.random.PRNGKey(i))
+        assert step1.k_steps > 1
+        # "restart": new wrapper object, same (donation-surviving) state
+        step2, _ = build()
+        k_before = step1.k_steps
+        state, m = step2(state, step2.shard_batch(make_batch()),
+                         jax.random.PRNGKey(99))
+        # the grown interval and cadence carried over exactly: same k, and
+        # step 9 is within the interval of the last sync at step 7, so a
+        # re-baselined wrapper (which would sync at its first step) fails
+        assert step2.k_steps == k_before, (k_before, step2.k_steps)
+        assert not bool(m["synced"])
+        assert step2._host_step == 9
+        # a pre-schedule-scalars state (scaler=()) upgrades in place
+        legacy = state._replace(scaler=())
+        st3, _ = step2(legacy, step2.shard_batch(make_batch()),
+                       jax.random.PRNGKey(100))
+        assert isinstance(st3.scaler, dict) and "k_steps" in st3.scaler
+
+
+def test_adaptive_localsgd_constant_lr_stays_synced(devices8):
+    """With a constant lr and a non-increasing loss the AdaComm rule keeps
+    k at init_k (ratio <= 1): adaptive mode degenerates to sync-DP when
+    there is nothing to save."""
+    paddle_tpu.seed(12)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    s = DistributedStrategy()
+    s.localsgd.enable = True
+    s.localsgd.adaptive = True
+    s.localsgd.init_k_steps = 1
+    mesh = M.mesh_from_strategy(DistributedStrategy())
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.SGD(1e-2), strategy=s, mesh=mesh)
+        state = step.init_state(model)
+        for i in range(5):
+            state, m = step(state, step.shard_batch(make_batch()),
+                            jax.random.PRNGKey(i))
+            assert bool(m["synced"])
+    assert step.k_steps == 1
+    assert step.sync_history == [1, 2, 3, 4, 5]
+
+
 def test_localsgd_rejects_hybrid(devices8):
     s = DistributedStrategy()
     s.localsgd.enable = True
