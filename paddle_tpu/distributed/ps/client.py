@@ -58,6 +58,12 @@ class InProcClient:
     def barrier(self, world: int = 1):
         pass
 
+    def heartbeat(self, worker_id: int, status: str = "running"):
+        pass
+
+    def lost_workers(self) -> list[int]:
+        return []
+
     def close(self):
         pass
 
@@ -91,8 +97,20 @@ class PSClient:
     def __init__(self, endpoints: list[str] | str):
         if isinstance(endpoints, str):
             endpoints = [endpoints]
+        self._endpoints = list(endpoints)
         self._conns = [_Conn(e) for e in endpoints]
         self.n = len(self._conns)
+        self._hb_conn: _Conn | None = None
+        self._hb_lock = threading.Lock()
+
+    def _heartbeat_conn(self) -> _Conn:
+        """Dedicated chief connection for liveness traffic: heartbeats must
+        not queue behind long-blocking ops (barrier holds conn 0's lock for
+        up to 120s, which would stall beats past the staleness window)."""
+        with self._hb_lock:
+            if self._hb_conn is None:
+                self._hb_conn = _Conn(self._endpoints[0])
+            return self._hb_conn
 
     def _route(self, ids: np.ndarray) -> np.ndarray:
         # must match across workers; splitmix-free: cheap modulo of the id
@@ -181,6 +199,17 @@ class PSClient:
         barrier, served by server 0)."""
         self._conns[0].request("barrier", {"world": int(world)})
 
+    def heartbeat(self, worker_id: int, status: str = "running"):
+        """Report liveness to the chief (server 0) heartbeat monitor —
+        the reference's trainer→No.0-pserver heartbeat."""
+        self._heartbeat_conn().request(
+            "heartbeat", {"worker": int(worker_id), "status": status})
+
+    def lost_workers(self) -> list[int]:
+        """Workers the chief's monitor has flagged as stale."""
+        h, _ = self._heartbeat_conn().request("lost", {})
+        return list(h.get("lost", []))
+
     def stop_servers(self):
         for c in self._conns:
             try:
@@ -191,3 +220,6 @@ class PSClient:
     def close(self):
         for c in self._conns:
             c.close()
+        if self._hb_conn is not None:
+            self._hb_conn.close()
+            self._hb_conn = None

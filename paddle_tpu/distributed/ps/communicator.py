@@ -33,12 +33,14 @@ _STOP = object()
 
 class Communicator:
     def __init__(self, client, mode: str = "sync", *, geo_k: int = 10,
-                 async_queue_size: int = 64):
+                 async_queue_size: int = 64, worker_id: int | None = None,
+                 heartbeat_secs: float | None = None):
         if mode not in ("sync", "async", "geo"):
             raise ValueError(f"mode {mode!r}")
         self.client = client
         self.mode = mode
         self.geo_k = int(geo_k)
+        self.worker_id = worker_id
         self._specs: dict[str, dict] = {}
         self._local: dict[str, NativeSparseTable] = {}
         self._snapshot: dict[str, dict[int, np.ndarray]] = {}
@@ -46,10 +48,41 @@ class Communicator:
         self._push_count = 0
         self._q: queue.Queue | None = None
         self._sender: threading.Thread | None = None
+        self._hb_stop: threading.Event | None = None
+        self._hb_thread: threading.Thread | None = None
         if mode == "async":
             self._q = queue.Queue(maxsize=async_queue_size)
             self._sender = threading.Thread(target=self._drain, daemon=True)
             self._sender.start()
+        # async/geo workers push on their own cadence, so the server can't
+        # infer liveness from traffic — a background beat to the chief's
+        # HeartBeatMonitor covers the gap (heart_beat_monitor.cc role)
+        if heartbeat_secs is not None and worker_id is not None:
+            self._hb_stop = threading.Event()
+
+            def beat():
+                failures = 0
+                while not self._hb_stop.wait(heartbeat_secs):
+                    try:
+                        self.client.heartbeat(worker_id)
+                        failures = 0
+                    except (RuntimeError, ConnectionError, OSError) as e:
+                        # transient hiccups must not kill the beat — a
+                        # silently dead beat thread on a healthy worker is
+                        # exactly the false positive the monitor must not
+                        # produce; give up only after sustained failure
+                        failures += 1
+                        if failures >= 5:
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "heartbeat to PS failed %d times in a row "
+                                "(%s); stopping beats for worker %s",
+                                failures, e, worker_id)
+                            return
+            self.client.heartbeat(worker_id)   # register immediately
+            self._hb_thread = threading.Thread(target=beat, daemon=True)
+            self._hb_thread.start()
 
     # ------------------------------------------------------------------
     def create_table(self, name: str, dim: int, *, optimizer="sgd", lr=0.01,
@@ -130,3 +163,12 @@ class Communicator:
             self._q.put(_STOP)
             self._sender.join(timeout=10)
             self._sender = None
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+            try:
+                # COMPLETED exempts this worker from staleness flagging
+                self.client.heartbeat(self.worker_id, status="completed")
+            except (RuntimeError, ConnectionError, OSError):
+                pass

@@ -25,10 +25,11 @@ import numpy as np
 
 from paddle_tpu.native import NativeSparseTable
 
-__all__ = ["ParameterServer", "OPS"]
+__all__ = ["ParameterServer", "HeartBeatMonitor", "OPS"]
 
 OPS = {"create": 1, "pull": 2, "push_grad": 3, "push_delta": 4, "size": 5,
-       "save": 6, "load": 7, "keys": 8, "stop": 9, "barrier": 10}
+       "save": 6, "load": 7, "keys": 8, "stop": 9, "barrier": 10,
+       "heartbeat": 11, "lost": 12}
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
@@ -113,6 +114,100 @@ class _TableRegistry:
                         "or the configured world size is wrong")
 
 
+class HeartBeatMonitor:
+    """Worker-liveness tracking on the chief parameter server.
+
+    Reference: ``operators/distributed/heart_beat_monitor.cc`` — the No.0
+    pserver records a timestamp per trainer whenever the monitored
+    variable arrives and a monitor thread flags any RUNNING worker whose
+    last update is older than ``worker_update_interval_secs``.
+
+    Differences fitted to this stack: workers register lazily on their
+    first beat (no pre-declared world size), a flagged worker lands in
+    ``lost`` and fires ``on_lost`` instead of tearing the server down
+    (async/geo training can continue on the remaining workers — eviction
+    is the policy hook, death is the reference's), and COMPLETED workers
+    are exempt from staleness exactly as in the reference.
+    """
+
+    RUNNING, COMPLETED = "running", "completed"
+
+    def __init__(self, interval_secs: float = 900.0, on_lost=None):
+        self.interval_secs = float(interval_secs)
+        self._on_lost = on_lost
+        self._lock = threading.Lock()
+        self._workers: dict[int, list] = {}  # id -> [status, last_ts]
+        self.lost: set[int] = set()
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    def update(self, worker_id: int, status: str = RUNNING) -> None:
+        import time
+
+        if status not in (self.RUNNING, self.COMPLETED):
+            raise ValueError(f"bad heartbeat status {status!r}")
+        with self._lock:
+            entry = self._workers.setdefault(worker_id, [status, 0.0])
+            if entry[0] != self.COMPLETED:  # COMPLETED is sticky
+                entry[0] = status
+            entry[1] = time.monotonic()
+            # a beat from a previously-lost worker resurrects it
+            self.lost.discard(worker_id)
+
+    def check_once(self) -> set[int]:
+        import time
+
+        now = time.monotonic()
+        newly = []
+        with self._lock:
+            for wid, (status, ts) in self._workers.items():
+                if status != self.RUNNING or wid in self.lost:
+                    continue
+                if now - ts >= self.interval_secs:
+                    self.lost.add(wid)
+                    newly.append(wid)
+            snapshot = set(self.lost)
+        for wid in newly:
+            if self._on_lost is not None:
+                try:
+                    self._on_lost(wid)
+                except Exception:   # a failing eviction hook must not
+                    import logging  # kill the monitor thread
+
+                    logging.getLogger(__name__).exception(
+                        "on_lost callback failed for worker %s", wid)
+        return snapshot
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "lost": sorted(self.lost),
+                "workers": {str(w): s for w, (s, _) in self._workers.items()},
+            }
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+
+        def loop():
+            import time
+
+            poll = max(min(self.interval_secs / 4.0, 1.0), 0.05)
+            while self._running:
+                self.check_once()
+                time.sleep(poll)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
 class ParameterServer:
     """Hosts sparse tables and serves the PS protocol.
 
@@ -121,8 +216,10 @@ class ParameterServer:
     ``InProcClient`` can bypass TCP entirely for same-process workers.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 heartbeat_interval: float = 900.0, on_lost=None):
         self.registry = _TableRegistry()
+        self.monitor = HeartBeatMonitor(heartbeat_interval, on_lost=on_lost)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -152,9 +249,11 @@ class ParameterServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self.monitor.start()
         return self
 
     def stop(self) -> None:
+        self.monitor.stop()
         self._server.shutdown()
         self._server.server_close()
 
@@ -177,6 +276,14 @@ class ParameterServer:
             if name == "barrier":
                 self.registry.barrier(int(header["world"]))
                 send_frame(sock, 0, {})
+                return True
+            if name == "heartbeat":
+                self.monitor.update(int(header["worker"]),
+                                    header.get("status", "running"))
+                send_frame(sock, 0, {})
+                return True
+            if name == "lost":
+                send_frame(sock, 0, self.monitor.status())
                 return True
 
             table = self.registry.get(header["name"])

@@ -32,6 +32,7 @@ also removes the per-step in-graph ``where``-on-synced-params select.
 
 from __future__ import annotations
 
+import collections
 import weakref
 from typing import Any
 
@@ -163,10 +164,22 @@ class LocalSGDTrainStep:
         self._loss0 = None
         self._lr0 = None
         self._last_out = None
-        self.sync_history: list[int] = []   # host step of every sync
+        # host steps of recent syncs (bounded: diagnostics, not a log)
+        self.sync_history = collections.deque(maxlen=4096)
 
-    def _sched_device(self):
+    def _sched_device(self, fresh: bool = False):
+        """Schedule scalars as device arrays; ``fresh=True`` gives the
+        pristine start-of-training values (for init_state) rather than the
+        wrapper's current mutated ones."""
         unset = -1.0
+        if fresh:
+            k0 = self._init_k if self._adaptive else self.k_steps
+            return {
+                "k_steps": jnp.asarray(k0, jnp.int32),
+                "last_sync": jnp.asarray(0, jnp.int32),
+                "loss0": jnp.asarray(unset, jnp.float32),
+                "lr0": jnp.asarray(unset, jnp.float32),
+            }
         return {
             "k_steps": jnp.asarray(self.k_steps, jnp.int32),
             "last_sync": jnp.asarray(self._last_sync, jnp.int32),
@@ -231,7 +244,7 @@ class LocalSGDTrainStep:
             lambda p: (jnp.broadcast_to(p[None], (n,) + p.shape)
                        if hasattr(p, "shape") else p), t)
         state = TrainState(stack(model), stack(opt_state),
-                           self._sched_device(), (),
+                           self._sched_device(fresh=True), (),
                            jnp.zeros((), jnp.int32))
         return jax.device_put(state, self._state_shardings(state))
 

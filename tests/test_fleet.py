@@ -333,7 +333,7 @@ def test_adaptive_localsgd_interval_grows_on_plateau(devices8):
             state, m = step(state, step.shard_batch(make_batch()),
                             jax.random.PRNGKey(i))
     assert step.k_steps > 1, (step.k_steps, step.sync_history)
-    gaps = np.diff(step.sync_history)
+    gaps = np.diff(list(step.sync_history))
     assert gaps[-1] > gaps[0], (list(step.sync_history), step.k_steps)
     assert step.k_steps <= 8  # clipped at max_k_steps
 
@@ -403,7 +403,7 @@ def test_adaptive_localsgd_constant_lr_stays_synced(devices8):
                             jax.random.PRNGKey(i))
             assert bool(m["synced"])
     assert step.k_steps == 1
-    assert step.sync_history == [1, 2, 3, 4, 5]
+    assert list(step.sync_history) == [1, 2, 3, 4, 5]
 
 
 def test_localsgd_rejects_hybrid(devices8):

@@ -198,6 +198,65 @@ def test_communicator_async_applies_eventually():
     assert final < losses[0] * 0.5, (final, losses[0])
 
 
+def test_heartbeat_detects_dead_worker():
+    """A worker that stops beating is flagged lost within the heartbeat
+    window (heart_beat_monitor.cc:LostWorkerMonitor); live and COMPLETED
+    workers are never flagged, and a returning beat resurrects."""
+    import time
+
+    lost_events = []
+    server = ParameterServer(heartbeat_interval=0.6,
+                             on_lost=lost_events.append).start()
+    try:
+        c = PSClient(server.endpoint)
+        c.heartbeat(0)            # worker 0: will keep beating
+        c.heartbeat(1)            # worker 1: dies after registration
+        c.heartbeat(2)            # worker 2: completes cleanly
+        c.heartbeat(2, status="completed")
+        deadline = time.time() + 5
+        while time.time() < deadline and 1 not in c.lost_workers():
+            c.heartbeat(0)
+            time.sleep(0.1)
+        lost = c.lost_workers()
+        assert 1 in lost, lost
+        assert 0 not in lost and 2 not in lost, lost
+        assert lost_events == [1]
+        c.heartbeat(1)            # worker 1 comes back
+        assert 1 not in c.lost_workers()
+    finally:
+        server.stop()
+
+
+def test_communicator_background_heartbeat():
+    """An async communicator with heartbeat_secs beats without any push
+    traffic; after stop() the worker is COMPLETED (exempt from staleness),
+    while a silently-killed worker is flagged."""
+    import time
+
+    server = ParameterServer(heartbeat_interval=0.6).start()
+    try:
+        live = Communicator(PSClient(server.endpoint), "async",
+                            worker_id=7, heartbeat_secs=0.15)
+        dead = Communicator(PSClient(server.endpoint), "async",
+                            worker_id=8, heartbeat_secs=0.15)
+        probe = PSClient(server.endpoint)
+        # simulate a crash: stop the beat thread without the completed beat
+        dead._hb_stop.set()
+        dead._hb_thread.join()
+        deadline = time.time() + 5
+        while time.time() < deadline and 8 not in probe.lost_workers():
+            time.sleep(0.1)
+        assert 8 in probe.lost_workers()
+        assert 7 not in probe.lost_workers()
+        live.stop()   # clean shutdown -> completed
+        time.sleep(1.0)
+        status = server.monitor.status()
+        assert status["workers"]["7"] == "completed"
+        assert 7 not in status["lost"]
+    finally:
+        server.stop()
+
+
 def test_communicator_geo_delta_sync():
     """Two geo workers on disjoint ids: local training + delta push must
     land both workers' progress on the server (geo-SGD semantics)."""
