@@ -30,7 +30,10 @@ __all__ = ["auto_cast", "active_dtype", "decorate", "cast_model",
 
 # Ops that are numerically safe (and fast) in low precision — mirrors the
 # reference allow list (amp_auto_cast.cc: conv2d, matmul, mul, ...).
-WHITE_LIST = frozenset({"matmul", "linear", "conv2d", "einsum", "attention"})
+WHITE_LIST = frozenset({
+    "matmul", "linear", "einsum", "attention",
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose"})
 # Ops kept in fp32 — mirrors the reference block list (softmax, layer_norm,
 # cross_entropy, ...).
 BLACK_LIST = frozenset({"softmax", "log_softmax", "layer_norm", "rms_norm",

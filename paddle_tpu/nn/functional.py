@@ -238,9 +238,31 @@ def cosine_similarity(a, b, axis: int = -1, eps: float = 1e-8):
 # Core layers
 # ---------------------------------------------------------------------------
 
+def _amp_inputs(op: str, *tensors):
+    """Dtype alignment for a white-listed op's floating inputs: inside an
+    active ``amp.auto_cast`` scope cast them to the autocast dtype (the
+    reference's AmpOperators allow-list cast, ``amp_auto_cast.cc``);
+    outside, align mixed floating dtypes to their promoted type so bf16
+    params compose with fp32 inputs (lax convs reject mixed dtypes)."""
+    from paddle_tpu import amp as amp_mod
+
+    dt = amp_mod.active_dtype(op)
+    if dt is None:
+        fdts = {t.dtype for t in tensors
+                if t is not None and jnp.issubdtype(t.dtype, jnp.floating)}
+        if len(fdts) <= 1:
+            return tensors
+        dt = jnp.result_type(*fdts)
+    return tuple(
+        t.astype(dt) if t is not None and jnp.issubdtype(
+            t.dtype, jnp.floating) else t
+        for t in tensors)
+
+
 def linear(x, weight, bias=None):
     """y = x @ W (+ b). Weight layout [in, out] like the reference's fc
     (reference ``operators/math/fc.cc``) — feeds the MXU directly."""
+    x, weight, bias = _amp_inputs("linear", x, weight, bias)
     y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias
@@ -525,6 +547,7 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     """2D convolution. Weight layout [out_c, in_c/groups, kh, kw] (reference
     layout); lax.conv_general_dilated lets XLA pick the TPU-optimal internal
     layout regardless of the logical data_format."""
+    x, weight, bias = _amp_inputs("conv2d", x, weight, bias)
     stride, dilation = _pair(stride), _pair(dilation)
     if isinstance(padding, str):
         pad = padding
@@ -535,13 +558,13 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
         else ("NHWC", "OIHW", "NHWC"))
+    # no preferred_element_type=f32 for bf16: the XLA TPU conv already
+    # accumulates bf16 operands in f32 internally, and an f32 *output*
+    # type breaks the autodiff transpose (f32 cotangent vs bf16 operand)
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if y.dtype != x.dtype:
-        y = y.astype(x.dtype)
+        feature_group_count=groups)
     if bias is not None:
         shape = [1] * y.ndim
         shape[1 if data_format == "NCHW" else -1] = bias.shape[0]
@@ -924,6 +947,7 @@ def adaptive_max_pool3d(x, output_size):
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1):
     """[N, C, D, H, W] conv (reference ``operators/conv_op`` 3D path)."""
+    x, weight, bias = _amp_inputs("conv3d", x, weight, bias)
     s = _tuple_n(stride, 3)
     d = _tuple_n(dilation, 3)
     if isinstance(padding, str):
@@ -943,6 +967,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1):
     """[N, C, L] conv via the general dilated conv."""
+    x, weight, bias = _amp_inputs("conv1d", x, weight, bias)
     if isinstance(padding, str):
         pads = padding
     else:
@@ -1086,6 +1111,7 @@ def spectral_norm(weight, u, n_power_iterations: int = 1,
 def conv1d_transpose(x, weight, bias=None, stride: int = 1,
                      padding: int = 0):
     """weight [in, out, k]; output length (L-1)*s - 2p + k."""
+    x, weight, bias = _amp_inputs("conv1d_transpose", x, weight, bias)
     k = weight.shape[2]
     w = jnp.flip(weight, axis=(2,)).transpose(1, 0, 2)
     y = lax.conv_general_dilated(
@@ -1097,6 +1123,7 @@ def conv1d_transpose(x, weight, bias=None, stride: int = 1,
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0):
+    x, weight, bias = _amp_inputs("conv2d_transpose", x, weight, bias)
     s = _pair(stride)
     p = _pair(padding)
     k = weight.shape[2:]
@@ -1111,6 +1138,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0):
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0):
+    x, weight, bias = _amp_inputs("conv3d_transpose", x, weight, bias)
     s = _tuple_n(stride, 3)
     p = _tuple_n(padding, 3)
     k = weight.shape[2:]

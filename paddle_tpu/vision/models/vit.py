@@ -54,7 +54,7 @@ class ViT(Module):
     def __init__(self, image_size: int = 224, patch_size: int = 16,
                  dim: int = 768, depth: int = 12, heads: int = 12,
                  mlp_dim: int = 3072, num_classes: int = 1000,
-                 dropout: float = 0.0, key=None):
+                 dropout: float = 0.0, remat: bool = False, key=None):
         n_patches = (image_size // patch_size) ** 2
         self.patch_embed = Conv2D(3, dim, patch_size, stride=patch_size)
         self.cls_token = TruncatedNormal(std=0.02)(
@@ -62,7 +62,8 @@ class ViT(Module):
         self.pos_embed = TruncatedNormal(std=0.02)(
             rng.next_key(), (1, n_patches + 1, dim))
         self.blocks = ScannedBlocks(
-            lambda i: ViTBlock(dim, heads, mlp_dim, dropout), depth)
+            lambda i: ViTBlock(dim, heads, mlp_dim, dropout), depth,
+            remat=remat)
         self.ln = LayerNorm(dim)
         self.head = Linear(dim, num_classes,
                            weight_init=Normal(0.0, 0.01))

@@ -10,7 +10,60 @@ __all__ = ["Compose", "Normalize", "Resize", "RandomCrop",
            "ToTensor", "Transpose", "Pad", "RandomVerticalFlip",
            "BrightnessTransform", "ContrastTransform", "SaturationTransform",
            "HueTransform", "ColorJitter", "Grayscale", "RandomRotation",
-           "RandomResizedCrop"]
+           "RandomResizedCrop", "resize"]
+
+
+def _interp_axis(in_size: int, out_size: int):
+    """Half-pixel source coordinates for one axis (the cv2 INTER_LINEAR /
+    align_corners=False convention the reference's functional_cv2.resize
+    inherits): src = (dst + 0.5) * in/out - 0.5, edges clamped."""
+    src = (np.arange(out_size) + 0.5) * (in_size / out_size) - 0.5
+    i0 = np.floor(src).astype(np.int64)
+    frac = (src - i0).astype(np.float32)
+    return (np.clip(i0, 0, in_size - 1), np.clip(i0 + 1, 0, in_size - 1),
+            frac)
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    """Resize an HW / HWC numpy image (reference
+    ``vision/transforms/functional.py:96``): int size = shorter edge
+    scaled keeping aspect ratio, (h, w) = exact; bilinear (default, the
+    reference default) or nearest interpolation. Integer inputs come back
+    in their own dtype (rounded), floats stay float32."""
+    arr = np.asarray(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if (w <= h and w == size) or (h <= w and h == size):
+            return np.asarray(img)
+        if w < h:
+            oh, ow = int(size * h / w), size
+        else:
+            oh, ow = size, int(size * w / h)
+    else:
+        oh, ow = size
+    if interpolation == "nearest":
+        yi = (np.arange(oh) * h // oh).clip(0, h - 1)
+        xi = (np.arange(ow) * w // ow).clip(0, w - 1)
+        out = arr[yi][:, xi]
+    elif interpolation == "bilinear":
+        y0, y1, fy = _interp_axis(h, oh)
+        x0, x1, fx = _interp_axis(w, ow)
+        a = arr.astype(np.float32)
+        fx = fx[None, :, None]
+        top = a[y0][:, x0] * (1 - fx) + a[y0][:, x1] * fx
+        bot = a[y1][:, x0] * (1 - fx) + a[y1][:, x1] * fx
+        out = top * (1 - fy)[:, None, None] + bot * fy[:, None, None]
+        if np.issubdtype(arr.dtype, np.integer):
+            info = np.iinfo(arr.dtype)
+            out = np.clip(np.rint(out), info.min, info.max).astype(arr.dtype)
+    else:
+        raise ValueError(
+            f"interpolation {interpolation!r}: supported are 'bilinear' "
+            "and 'nearest'")
+    return out[:, :, 0] if squeeze else out
 
 
 class Compose:
@@ -39,16 +92,18 @@ class ToCHW:
 
 
 class Resize:
-    def __init__(self, size):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
+    """CHW resize (this class predates the HWC new-style transforms and
+    keeps CHW for the MNIST pipelines). Reference transforms.Resize: int
+    size = shorter edge keeping aspect; bilinear by default."""
+
+    def __init__(self, size, interpolation: str = "bilinear"):
+        self.size = size if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        # nearest-neighbour host resize (keeps zero deps)
-        c, h, w = img.shape
-        oh, ow = self.size
-        yi = (np.arange(oh) * h // oh).clip(0, h - 1)
-        xi = (np.arange(ow) * w // ow).clip(0, w - 1)
-        return img[:, yi][:, :, xi]
+        img = np.asarray(img)
+        out = resize(img.transpose(1, 2, 0), self.size, self.interpolation)
+        return out.transpose(2, 0, 1)
 
 
 class CenterCrop:
@@ -281,18 +336,15 @@ class RandomResizedCrop:
     reference's PIL/cv2 HWC convention — ``Resize``/``CenterCrop`` above
     predate them and stay CHW for the MNIST pipelines)."""
 
-    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation: str = "bilinear"):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
         self.scale = scale
         self.ratio = ratio
+        self.interpolation = interpolation
 
-    @staticmethod
-    def _resize_hwc(arr, size):
-        h, w = arr.shape[:2]
-        oh, ow = size
-        yi = (np.arange(oh) * h // oh).clip(0, h - 1)
-        xi = (np.arange(ow) * w // ow).clip(0, w - 1)
-        return arr[yi][:, xi]
+    def _resize_hwc(self, arr, size):
+        return resize(arr, tuple(size), self.interpolation)
 
     def __call__(self, img):
         arr = np.asarray(img)

@@ -20,6 +20,58 @@ def _img(h=8, w=8, c=3, seed=0):
         .astype(np.uint8)
 
 
+def test_resize_bilinear_matches_torch_golden():
+    """Bilinear host resize reproduces the cv2 INTER_LINEAR /
+    align_corners=False half-pixel convention (the reference's
+    functional_cv2.resize backend) — checked against torch interpolate
+    with antialias=False, which implements the same sampling. (Not
+    jax.image.resize: that one low-pass filters on downsample, which
+    cv2's INTER_LINEAR does not.)"""
+    torch = pytest.importorskip("torch")
+
+    rs = np.random.RandomState(3)
+    img = rs.rand(9, 13, 3).astype(np.float32)
+    t = torch.from_numpy(img.transpose(2, 0, 1))[None]
+    for size in [(4, 7), (18, 26), (9, 13), (5, 5)]:
+        ours = T.resize(img, size, "bilinear")
+        golden = torch.nn.functional.interpolate(
+            t, size=size, mode="bilinear", align_corners=False,
+            antialias=False)[0].numpy().transpose(1, 2, 0)
+        np.testing.assert_allclose(ours, golden, rtol=1e-5, atol=1e-5)
+
+
+def test_resize_int_is_shorter_edge():
+    img = _img(16, 24)
+    out = T.resize(img, 8)
+    assert out.shape == (8, 12, 3)      # shorter edge 16 -> 8, aspect kept
+    tall = T.resize(_img(24, 16), 8)
+    assert tall.shape == (12, 8, 3)
+    same = T.resize(_img(8, 12), 8)     # already at size: no-op
+    assert same.shape == (8, 12, 3)
+
+
+def test_resize_dtypes_and_modes():
+    img = _img(8, 8)
+    out = T.resize(img, (4, 4))
+    assert out.dtype == np.uint8        # ints round-trip their dtype
+    near = T.resize(img, (4, 4), "nearest")
+    assert near.dtype == np.uint8
+    # nearest picks exact source pixels
+    assert set(near.ravel()) <= set(img.ravel())
+    gray = T.resize(img[:, :, 0], (4, 4))
+    assert gray.shape == (4, 4)         # 2D in, 2D out
+    with pytest.raises(ValueError, match="interpolation"):
+        T.resize(img, (4, 4), "lanczos")
+
+
+def test_resize_class_chw_bilinear():
+    chw = _img(10, 14).transpose(2, 0, 1).astype(np.float32)
+    out = T.Resize((5, 7))(chw)
+    assert out.shape == (3, 5, 7)
+    golden = T.resize(chw.transpose(1, 2, 0), (5, 7)).transpose(2, 0, 1)
+    np.testing.assert_allclose(out, golden)
+
+
 def test_to_tensor_and_transpose():
     x = _img()
     t = T.ToTensor()(x)
