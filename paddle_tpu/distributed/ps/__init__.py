@@ -21,9 +21,11 @@ TPU-native layering:
 
 from paddle_tpu.distributed.ps.client import PSClient, InProcClient
 from paddle_tpu.distributed.ps.communicator import Communicator
-from paddle_tpu.distributed.ps.server import ParameterServer
+from paddle_tpu.distributed.ps.heter import HeterClient, HeterWorker
+from paddle_tpu.distributed.ps.server import HeartBeatMonitor, ParameterServer
 from paddle_tpu.distributed.ps.sparse_embedding import SparseEmbeddingHelper
 from paddle_tpu.native import NativeSparseTable
 
 __all__ = ["ParameterServer", "PSClient", "InProcClient", "Communicator",
-           "SparseEmbeddingHelper", "NativeSparseTable"]
+           "SparseEmbeddingHelper", "NativeSparseTable", "HeterWorker",
+           "HeterClient", "HeartBeatMonitor"]

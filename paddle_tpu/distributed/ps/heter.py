@@ -1,0 +1,190 @@
+"""Heterogeneous PS: accelerator-side dense section served to CPU trainers.
+
+Reference: ``paddle/fluid/framework/heterxpu_trainer.cc`` +
+``heter_service.proto`` — CPU trainers run the IO/sparse part of the
+program and ship the compute-heavy dense section to an accelerator worker
+over an RPC carrying tensors (``HeterRequest{cmd, vars} -> HeterResponse``);
+the worker executes its cached program section and returns the boundary
+tensors.
+
+TPU-native formulation: the "program section" is a jitted
+forward/backward/update step on the TPU worker. A CPU trainer pulls sparse
+embeddings from the parameter server, sends the dense feature batch to the
+HeterWorker, and gets back the loss and the gradient w.r.t. the features —
+which it pushes back into the PS sparse tables. Dense parameters live and
+update *on the worker* (the reference caches per-device copies the same
+way); sparse parameters live on the PS. Transport reuses the PS
+length-prefixed frame protocol (no pickling).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Callable
+
+import numpy as np
+
+from paddle_tpu.distributed.ps.server import recv_frame, send_frame
+
+__all__ = ["HeterWorker", "HeterClient"]
+
+# separate op space from the PS server's OPS (different service)
+HETER_OPS = {"forward_backward": 1, "eval_loss": 2, "stop": 3, "info": 4}
+_OP_NAMES = {v: k for k, v in HETER_OPS.items()}
+
+
+class HeterWorker:
+    """Hosts the dense section: ``step_fn(features, labels) -> (loss,
+    d_features)`` with dense-parameter updates applied worker-side.
+
+    ``build_step`` is called once at construction with no arguments and
+    must return ``(step_fn, eval_fn)``:
+
+    - ``step_fn(features[B,D] f32, labels) -> (loss, d_features[B,D])`` —
+      one dense train step (jitted inside, carrying its own state), the
+      analogue of HeterXpuTrainer::RunTask running the cached section.
+    - ``eval_fn(features, labels) -> loss`` — no-update evaluation.
+    """
+
+    def __init__(self, build_step: Callable, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._step_fn, self._eval_fn = build_step()
+        self._lock = threading.Lock()   # dense state mutates serially
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, header, payload = recv_frame(self.request)
+                        if not outer._dispatch(self.request, op, header,
+                                               payload):
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "HeterWorker":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @staticmethod
+    def _parse_batch(header, payload):
+        fshape = tuple(header["fshape"])
+        fbytes = int(np.prod(fshape)) * 4
+        feats = np.frombuffer(payload[:fbytes], np.float32).reshape(fshape)
+        labels = np.frombuffer(
+            payload[fbytes:],
+            np.dtype(header.get("ldtype", "float32"))
+        ).reshape(header["lshape"])
+        return feats, labels
+
+    def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
+        name = _OP_NAMES.get(op)
+        try:
+            if name == "stop":
+                send_frame(sock, 0, {})
+                threading.Thread(target=self.stop, daemon=True).start()
+                return False
+            if name == "info":
+                import jax
+
+                send_frame(sock, 0, {
+                    "devices": [str(d) for d in jax.devices()]})
+                return True
+            feats, labels = self._parse_batch(header, payload)
+            if name == "forward_backward":
+                with self._lock:
+                    loss, dfeats = self._step_fn(feats, labels)
+                dfeats = np.ascontiguousarray(np.asarray(dfeats),
+                                              np.float32)
+                send_frame(sock, 0,
+                           {"loss": float(loss), "nbytes": dfeats.nbytes,
+                            "shape": list(dfeats.shape)},
+                           dfeats.tobytes())
+            elif name == "eval_loss":
+                with self._lock:
+                    loss = self._eval_fn(feats, labels)
+                send_frame(sock, 0, {"loss": float(loss)})
+            else:
+                send_frame(sock, 1, {"error": f"bad op {op}"})
+            return True
+        except Exception as e:  # report, keep serving
+            send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})
+            return True
+
+
+class HeterClient:
+    """CPU-trainer side of the heter service."""
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def _request(self, op: str, header: dict, payload: bytes = b""):
+        with self._lock:
+            send_frame(self._sock, HETER_OPS[op], header, payload)
+            code, rheader, rpayload = recv_frame(self._sock,
+                                                 max_payload=None)
+        if code != 0:
+            raise RuntimeError(f"heter {op} failed: {rheader.get('error')}")
+        return rheader, rpayload
+
+    @staticmethod
+    def _pack_batch(features, labels):
+        feats = np.ascontiguousarray(features, np.float32)
+        labels = np.ascontiguousarray(labels)
+        payload = feats.tobytes() + labels.tobytes()
+        header = {"fshape": list(feats.shape), "lshape": list(labels.shape),
+                  "ldtype": labels.dtype.name, "nbytes": len(payload)}
+        return header, payload
+
+    def forward_backward(self, features, labels):
+        """Run one dense train step on the worker; returns
+        ``(loss, d_features)`` — the reference's RunTask round trip."""
+        header, payload = self._pack_batch(features, labels)
+        rheader, rpayload = self._request("forward_backward", header,
+                                          payload)
+        dfeats = np.frombuffer(rpayload, np.float32).reshape(
+            rheader["shape"])
+        return rheader["loss"], dfeats
+
+    def eval_loss(self, features, labels) -> float:
+        header, payload = self._pack_batch(features, labels)
+        rheader, _ = self._request("eval_loss", header, payload)
+        return rheader["loss"]
+
+    def info(self) -> dict:
+        return self._request("info", {})[0]
+
+    def stop_worker(self) -> None:
+        try:
+            self._request("stop", {})
+        except (RuntimeError, ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
