@@ -144,6 +144,11 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     if use_pp and (strategy.sequence_parallel.enable
                    and strategy.sequence_parallel.degree > 1):
         if strategy.sequence_parallel.mode == "ulysses":
+            # Re-probed r3: a *minimal* nested pp/ulysses shard_map now
+            # compiles, but the full pipelined train step (all_to_all
+            # inside the tick scan, under grad) still hard-aborts the
+            # process inside XLA ("Fatal Python error: Aborted") — keep
+            # the gate until the compiler handles it.
             raise NotImplementedError(
                 "pipeline + Ulysses sequence parallelism: the nested "
                 "all_to_all aborts inside the XLA compiler today — use "

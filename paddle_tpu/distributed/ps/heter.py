@@ -84,7 +84,9 @@ class HeterWorker:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread is not None:  # shutdown() hangs unless serving
+            self._server.shutdown()
+            self._thread = None
         self._server.server_close()
 
     @staticmethod
@@ -111,6 +113,9 @@ class HeterWorker:
                 send_frame(sock, 0, {
                     "devices": [str(d) for d in jax.devices()]})
                 return True
+            if name not in ("forward_backward", "eval_loss"):
+                send_frame(sock, 1, {"error": f"bad op {op}"})
+                return True
             feats, labels = self._parse_batch(header, payload)
             if name == "forward_backward":
                 with self._lock:
@@ -121,12 +126,10 @@ class HeterWorker:
                            {"loss": float(loss), "nbytes": dfeats.nbytes,
                             "shape": list(dfeats.shape)},
                            dfeats.tobytes())
-            elif name == "eval_loss":
+            else:  # eval_loss
                 with self._lock:
                     loss = self._eval_fn(feats, labels)
                 send_frame(sock, 0, {"loss": float(loss)})
-            else:
-                send_frame(sock, 1, {"error": f"bad op {op}"})
             return True
         except Exception as e:  # report, keep serving
             send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})

@@ -185,8 +185,8 @@ class Model:
         return self
 
     def summary(self) -> str:
-        from paddle_tpu.core.module import count_params
+        """Per-parameter table (delegates to the real ``paddle.summary``
+        implementation in ``hapi/flops.py`` rather than duplicating it)."""
+        from paddle_tpu.hapi.flops import summary
 
-        lines = [f"{type(self.network).__name__}: "
-                 f"{count_params(self.network):,} parameters"]
-        return "\n".join(lines)
+        return summary(self.network)

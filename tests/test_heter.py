@@ -76,13 +76,12 @@ def test_heter_worker_trains_sparse_dense():
         ids_all = np.arange(32, dtype=np.int64)
         # ground truth depends on the id so the embedding must learn
         target = (ids_all % 4).astype(np.float32)
+        before = ps.pull("emb", ids_all).copy()   # pre-training snapshot
 
-        first = before = None
+        first = None
         for step in range(60):
             ids = rs.choice(ids_all, size=16, replace=False)
             feats = ps.pull("emb", ids)
-            if before is None:
-                before = feats.copy()
             loss, dfeats = client.forward_backward(feats, target[ids])
             assert dfeats.shape == feats.shape
             ps.push_grad("emb", ids, dfeats)
@@ -90,7 +89,7 @@ def test_heter_worker_trains_sparse_dense():
                 first = loss
         final = client.eval_loss(ps.pull("emb", ids_all), target)
         assert final < first * 0.5, (first, final)
-        moved = np.abs(ps.pull("emb", ids_all[:16]) - before).max()
+        moved = np.abs(ps.pull("emb", ids_all) - before).max()
         assert moved > 1e-3, "sparse rows never updated"
     finally:
         client.stop_worker()
