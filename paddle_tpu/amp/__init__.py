@@ -83,18 +83,44 @@ def _is_float(x):
         x.dtype, jnp.floating)
 
 
-def cast_model(model, dtype=jnp.bfloat16):
-    """Cast all floating parameters (pure dtype move, preserves structure)."""
+def _norm_classes() -> tuple:
+    """Norm layers whose parameters (and running stats) stay fp32 under
+    O2-style casting — the reference's ``keep_batch_norm_fp32``
+    (pure-fp16 decorator, ``fluid/contrib/mixed_precision/
+    fp16_utils.py``) extended to the whole norm family, since norm math
+    is precision-sensitive and cheap. isinstance-based so user
+    *subclasses* of the norm layers keep the protection. Lazy import:
+    amp must stay importable without pulling the nn package at module
+    load."""
+    from paddle_tpu.nn import norm as _n
+
+    return (_n.LayerNorm, _n.RMSNorm, _n.GroupNorm, _n.BatchNorm,
+            _n.InstanceNorm1D, _n.InstanceNorm2D, _n.InstanceNorm3D)
+
+
+def _is_norm_module(x) -> bool:
+    return isinstance(x, _norm_classes())
+
+
+def cast_model(model, dtype=jnp.bfloat16, keep_norms_fp32: bool = False):
+    """Cast floating parameters (pure dtype move, preserves structure).
+    With ``keep_norms_fp32``, norm-layer subtrees (params + running stats)
+    are left untouched — the keep_batch_norm_fp32 semantics."""
+    cast = lambda x: x.astype(dtype) if _is_float(x) else x
+    if not keep_norms_fp32:
+        return jax.tree_util.tree_map(cast, model)
     return jax.tree_util.tree_map(
-        lambda x: x.astype(dtype) if _is_float(x) else x, model)
+        lambda x: x if _is_norm_module(x) else cast(x),
+        model, is_leaf=_is_norm_module)
 
 
 def decorate(model, optimizer=None, dtype: str = "bfloat16",
-             master_weight: bool = True):
+             master_weight: bool = True, keep_norms_fp32: bool = True):
     """``paddle.amp.decorate`` equivalent: returns a low-precision compute
     copy of the model (and the optimizer untouched — master fp32 weights are
-    the *caller's* model; see :func:`master_weights` for the pattern)."""
-    out = cast_model(model, jnp.dtype(dtype))
+    the *caller's* model; see :func:`master_weights` for the pattern).
+    Norms stay fp32 by default, as in the reference's O2 decorator."""
+    out = cast_model(model, jnp.dtype(dtype), keep_norms_fp32=keep_norms_fp32)
     return (out, optimizer) if optimizer is not None else out
 
 

@@ -40,6 +40,8 @@ class AMPConfig:
     use_dynamic_loss_scaling: bool = True
     custom_white_list: tuple = ()
     custom_black_list: tuple = ()
+    # keep_batch_norm_fp32 analogue, extended to the whole norm family
+    keep_norms_fp32: bool = True
 
 
 @dataclass

@@ -1,0 +1,148 @@
+"""Length-prefixed binary frame protocol shared by the TCP services
+(parameter server, heter worker, inference server).
+
+Reference role: the serialized-variable wire format of
+``operators/distributed/sendrecvop_utils.h`` / ``heter_service.proto``
+(VariableMessage), reduced to its TPU-stack essentials: one request frame
+
+    [4B op][4B json_len][json header][raw payload]
+
+and one response frame ``[4B status][4B json_len][json][raw payload]``.
+Numpy buffers cross the wire raw — no pickling, so a malformed frame
+cannot execute code. (Deserialization safety only: individual services
+still gate their mutating/admin ops before non-loopback exposure — see
+``InferenceServer.admin_ops``.)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any
+
+__all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
+           "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES"]
+
+# Hard caps on request frames arriving at a server. Header/payload lengths
+# come from the (untrusted) peer; without a bound a single corrupt frame
+# could demand an arbitrarily large allocation. Clients reading replies
+# from a server they chose to connect to pass ``max_payload=None``.
+MAX_HEADER_BYTES = 1 << 20   # 1 MiB of JSON is already absurd
+MAX_PAYLOAD_BYTES = 1 << 31  # 2 GiB per request frame
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
+               payload: bytes = b"") -> None:
+    hj = json.dumps(header).encode()
+    sock.sendall(struct.pack("<ii", code, len(hj)) + hj + payload)
+
+
+def recv_frame(sock: socket.socket,
+               max_payload: int | None = MAX_PAYLOAD_BYTES):
+    code, hlen = struct.unpack("<ii", _recv_exact(sock, 8))
+    if not 0 <= hlen <= MAX_HEADER_BYTES:
+        raise ConnectionError(f"header length {hlen} out of bounds")
+    header = json.loads(_recv_exact(sock, hlen)) if hlen else {}
+    nbytes = int(header.get("nbytes", 0))
+    if nbytes < 0 or (max_payload is not None and nbytes > max_payload):
+        raise ConnectionError(f"payload length {nbytes} out of bounds")
+    payload = _recv_exact(sock, nbytes)
+    return code, header, payload
+
+
+class FrameService:
+    """Threaded TCP service skeleton over the frame protocol.
+
+    One thread per connection (the reference RPC servers' thread-pool
+    role), frames dispatched to ``_dispatch(sock, op, header, payload)
+    -> bool`` (False closes the connection). Subclasses implement
+    ``_dispatch``; ``start``/``stop`` manage the accept loop — shared so
+    lifecycle fixes (e.g. shutdown() hanging when the loop never ran)
+    exist in exactly one place.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, header, payload = recv_frame(self.request)
+                        if not outer._dispatch(self.request, op, header,
+                                               payload):
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:  # shutdown() hangs unless serving
+            self._server.shutdown()
+            self._thread = None
+        self._server.server_close()
+
+    def _dispatch(self, sock, op: int, header: dict,
+                  payload: bytes) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class FrameClient:
+    """Single-connection client over the frame protocol; thread-safe
+    request/response with server errors surfaced as RuntimeError."""
+
+    def __init__(self, endpoint: str, ops: dict[str, int],
+                 service: str = "service"):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+        self._ops = ops
+        self._service = service
+
+    def _request(self, op: str, header: dict, payload: bytes = b""):
+        with self._lock:
+            send_frame(self._sock, self._ops[op], header, payload)
+            # replies come from the server this client chose to connect
+            # to — no size cap (a large pull/infer reply is legitimate)
+            code, rheader, rpayload = recv_frame(self._sock,
+                                                 max_payload=None)
+        if code != 0:
+            raise RuntimeError(
+                f"{self._service} {op} failed: {rheader.get('error')}")
+        return rheader, rpayload
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

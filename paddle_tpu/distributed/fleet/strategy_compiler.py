@@ -255,7 +255,9 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
         def compute_loss(m, b):
             if amp_enabled:
-                m = amp_mod.cast_model(m, amp_dtype)
+                m = amp_mod.cast_model(
+                    m, amp_dtype,
+                    keep_norms_fp32=amp_cfg.keep_norms_fp32)
             from paddle_tpu.nn.stateful import state_tape
             with rng.stream(key):
                 with amp_mod.auto_cast(
@@ -305,7 +307,9 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                             custom_white_list=amp_cfg.custom_white_list,
                             custom_black_list=amp_cfg.custom_black_list):
                         loss, grads_c = pipe_loss_grads(
-                            amp_mod.cast_model(model, amp_dtype))
+                            amp_mod.cast_model(
+                                model, amp_dtype,
+                                keep_norms_fp32=amp_cfg.keep_norms_fp32))
                     grads = jax.tree_util.tree_map(
                         lambda g, p: (g.astype(p.dtype)
                                       if hasattr(p, "dtype") else g),

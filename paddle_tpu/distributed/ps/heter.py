@@ -19,14 +19,12 @@ length-prefixed frame protocol (no pickling).
 
 from __future__ import annotations
 
-import socket
-import socketserver
 import threading
 from typing import Callable
 
 import numpy as np
 
-from paddle_tpu.distributed.ps.server import recv_frame, send_frame
+from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
 
 __all__ = ["HeterWorker", "HeterClient"]
 
@@ -35,7 +33,7 @@ HETER_OPS = {"forward_backward": 1, "eval_loss": 2, "stop": 3, "info": 4}
 _OP_NAMES = {v: k for k, v in HETER_OPS.items()}
 
 
-class HeterWorker:
+class HeterWorker(FrameService):
     """Hosts the dense section: ``step_fn(features, labels) -> (loss,
     d_features)`` with dense-parameter updates applied worker-side.
 
@@ -52,42 +50,7 @@ class HeterWorker:
                  port: int = 0):
         self._step_fn, self._eval_fn = build_step()
         self._lock = threading.Lock()   # dense state mutates serially
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    while True:
-                        op, header, payload = recv_frame(self.request)
-                        if not outer._dispatch(self.request, op, header,
-                                               payload):
-                            return
-                except (ConnectionError, OSError):
-                    return
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
-        self.host, self.port = self._server.server_address
-        self._thread: threading.Thread | None = None
-
-    @property
-    def endpoint(self) -> str:
-        return f"{self.host}:{self.port}"
-
-    def start(self) -> "HeterWorker":
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        if self._thread is not None:  # shutdown() hangs unless serving
-            self._server.shutdown()
-            self._thread = None
-        self._server.server_close()
+        super().__init__(host, port)
 
     @staticmethod
     def _parse_batch(header, payload):
@@ -136,22 +99,11 @@ class HeterWorker:
             return True
 
 
-class HeterClient:
+class HeterClient(FrameClient):
     """CPU-trainer side of the heter service."""
 
     def __init__(self, endpoint: str):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)))
-        self._lock = threading.Lock()
-
-    def _request(self, op: str, header: dict, payload: bytes = b""):
-        with self._lock:
-            send_frame(self._sock, HETER_OPS[op], header, payload)
-            code, rheader, rpayload = recv_frame(self._sock,
-                                                 max_payload=None)
-        if code != 0:
-            raise RuntimeError(f"heter {op} failed: {rheader.get('error')}")
-        return rheader, rpayload
+        super().__init__(endpoint, HETER_OPS, service="heter")
 
     @staticmethod
     def _pack_batch(features, labels):
@@ -184,10 +136,4 @@ class HeterClient:
         try:
             self._request("stop", {})
         except (RuntimeError, ConnectionError, OSError):
-            pass
-
-    def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
             pass

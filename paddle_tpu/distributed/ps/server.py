@@ -14,12 +14,8 @@ expose beyond localhost, unlike pickle-RPC).
 
 from __future__ import annotations
 
-import json
-import socket
 import socketserver
-import struct
 import threading
-from typing import Any
 
 import numpy as np
 
@@ -33,42 +29,11 @@ OPS = {"create": 1, "pull": 2, "push_grad": 3, "push_delta": 4, "size": 5,
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
-               payload: bytes = b"") -> None:
-    hj = json.dumps(header).encode()
-    sock.sendall(struct.pack("<ii", code, len(hj)) + hj + payload)
-
-
-# Hard cap on request frames arriving at the server.  Header/payload
-# lengths come from the (untrusted) peer; without a bound a single corrupt
-# frame could demand an arbitrarily large allocation.  The cap applies to
-# *requests* only — clients reading replies from the server they chose to
-# connect to pass ``max_payload=None`` (a pull of millions of rows is a
-# legitimate response size).
-MAX_HEADER_BYTES = 1 << 20  # 1 MiB of JSON is already absurd
-MAX_PAYLOAD_BYTES = 1 << 31  # 2 GiB per request frame
-
-
-def recv_frame(sock: socket.socket, max_payload: int | None = MAX_PAYLOAD_BYTES):
-    code, hlen = struct.unpack("<ii", _recv_exact(sock, 8))
-    if not 0 <= hlen <= MAX_HEADER_BYTES:
-        raise ConnectionError(f"header length {hlen} out of bounds")
-    header = json.loads(_recv_exact(sock, hlen)) if hlen else {}
-    nbytes = int(header.get("nbytes", 0))
-    if nbytes < 0 or (max_payload is not None and nbytes > max_payload):
-        raise ConnectionError(f"payload length {nbytes} out of bounds")
-    payload = _recv_exact(sock, nbytes)
-    return code, header, payload
+# Frame protocol shared with the heter worker and inference server —
+# see paddle_tpu/core/wire.py (re-exported here for back-compat).
+from paddle_tpu.core.wire import (  # noqa: E402
+    MAX_HEADER_BYTES, MAX_PAYLOAD_BYTES, FrameService, recv_frame,
+    send_frame)
 
 
 class _TableRegistry:
@@ -208,7 +173,7 @@ class HeartBeatMonitor:
             self._thread = None
 
 
-class ParameterServer:
+class ParameterServer(FrameService):
     """Hosts sparse tables and serves the PS protocol.
 
     ``start()`` runs the service loop in background threads (one per
@@ -220,42 +185,16 @@ class ParameterServer:
                  heartbeat_interval: float = 900.0, on_lost=None):
         self.registry = _TableRegistry()
         self.monitor = HeartBeatMonitor(heartbeat_interval, on_lost=on_lost)
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    while True:
-                        op, header, payload = recv_frame(self.request)
-                        if not outer._dispatch(self.request, op, header,
-                                               payload):
-                            return
-                except (ConnectionError, OSError):
-                    return
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
-        self.host, self.port = self._server.server_address
-        self._thread: threading.Thread | None = None
-
-    @property
-    def endpoint(self) -> str:
-        return f"{self.host}:{self.port}"
+        super().__init__(host, port)
 
     def start(self) -> "ParameterServer":
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        super().start()
         self.monitor.start()
         return self
 
     def stop(self) -> None:
         self.monitor.stop()
-        self._server.shutdown()
-        self._server.server_close()
+        super().stop()
 
     # -- request dispatch --------------------------------------------------
     def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:

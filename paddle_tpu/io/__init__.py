@@ -19,6 +19,7 @@ from paddle_tpu.io.export import (
     save_inference_model,
 )
 from paddle_tpu.io.auto_checkpoint import TrainEpochRange, train_epoch_range
+from paddle_tpu.io.serving import InferenceClient, InferenceServer
 from paddle_tpu.io.crypto import (
     load_state_dict_encrypted, save_state_dict_encrypted, generate_key,
 )
@@ -28,4 +29,4 @@ __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
            "export_function", "save_inference_model", "load_inference_model",
            "Predictor", "TrainEpochRange", "train_epoch_range",
            "save_state_dict_encrypted", "load_state_dict_encrypted",
-           "generate_key"]
+           "generate_key", "InferenceServer", "InferenceClient"]

@@ -115,7 +115,8 @@ class Predictor:
                 f"got {len(inputs)}")
         arrays = []
         for i, (x, spec) in enumerate(zip(inputs, self.meta["inputs"])):
-            a = jnp.asarray(np.asarray(x))
+            a = np.asarray(x)   # dtype checked pre-jnp: jnp.asarray would
+            # silently downcast f64/i64 under the default x32 mode
             if list(a.shape) != spec["shape"]:
                 raise ValueError(
                     f"input {i}: shape {list(a.shape)} != exported "
@@ -124,7 +125,7 @@ class Predictor:
                 raise ValueError(
                     f"input {i}: dtype {a.dtype} != exported "
                     f"{spec['dtype']}")
-            arrays.append(a)
+            arrays.append(jnp.asarray(a))
         return self._call(*arrays)
 
     def __call__(self, *inputs) -> Any:

@@ -1,0 +1,162 @@
+"""Model serving: a TCP inference service over exported artifacts.
+
+Reference role: the serving layer around the inference engine — the
+C-API / AnalysisPredictor service wrapping
+(``paddle/fluid/inference/api/analysis_predictor.h:82``,
+``inference/capi/pd_predictor.cc``) that Paddle deploys behind
+Paddle Serving. TPU-native formulation: an ``InferenceServer`` hosts
+named :class:`~paddle_tpu.io.export.Predictor` instances (StableHLO
+artifacts with baked-in weights, compiled once per model) and serves the
+shared length-prefixed frame protocol (``core/wire.py`` — raw numpy
+buffers, no pickling). Models can be registered at construction or
+hot-loaded over the wire; requests run concurrently (jitted calls are
+thread-safe; XLA serializes device execution).
+
+Wire format for ``infer``: header ``{"model": name, "inputs":
+[{"shape": [...], "dtype": "float32"}, ...], "nbytes": N}`` with the raw
+input buffers concatenated in order; response mirrors it with output
+specs + buffers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
+
+__all__ = ["InferenceServer", "InferenceClient"]
+
+SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4}
+_OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
+
+
+def _pack_arrays(arrays) -> tuple[list[dict], bytes]:
+    specs, chunks = [], []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        specs.append({"shape": list(a.shape), "dtype": a.dtype.name})
+        chunks.append(a.tobytes())
+    return specs, b"".join(chunks)
+
+
+def _unpack_arrays(specs: list[dict], payload: bytes) -> list[np.ndarray]:
+    out, off = [], 0
+    for spec in specs:
+        dt = np.dtype(spec["dtype"])
+        count = int(np.prod(spec["shape"]))
+        n = count * dt.itemsize
+        if off + n > len(payload):
+            raise ValueError("payload shorter than declared input specs")
+        # zero-copy view at offset (no bytes-slice duplicate of the buffer)
+        out.append(np.frombuffer(payload, dt, count=count, offset=off)
+                   .reshape(spec["shape"]))
+        off += n
+    if off != len(payload):
+        raise ValueError("payload longer than declared input specs")
+    return out
+
+
+class InferenceServer(FrameService):
+    """Serve named Predictors over TCP.
+
+    ``models`` maps name -> saved-model directory (see
+    ``io.save_inference_model``) or an already-constructed Predictor.
+
+    ``admin_ops`` controls the mutating wire ops (``load_model`` — which
+    reads an arbitrary server-side path — and ``stop``). Default: enabled
+    only when bound to loopback; when exposing the server beyond
+    localhost, the data-plane ``infer``/``list_models`` stay available
+    and admin must be opted into explicitly.
+    """
+
+    def __init__(self, models: dict[str, Any] | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 admin_ops: bool | None = None):
+        from paddle_tpu.io.export import Predictor
+
+        self._predictor_cls = Predictor
+        self._models: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        for name, m in (models or {}).items():
+            self.add_model(name, m)
+        if admin_ops is None:
+            admin_ops = host in ("127.0.0.1", "localhost", "::1")
+        self._admin_ops = bool(admin_ops)
+        super().__init__(host, port)
+
+    def add_model(self, name: str, model) -> None:
+        pred = (model if not isinstance(model, str)
+                else self._predictor_cls(model))
+        with self._lock:
+            self._models[name] = pred
+
+    def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
+        name = _OP_NAMES.get(op)
+        try:
+            if name in ("stop", "load_model") and not self._admin_ops:
+                send_frame(sock, 1, {"error": f"admin op {name!r} disabled "
+                                     "on this server (admin_ops=False)"})
+                return True
+            if name == "stop":
+                send_frame(sock, 0, {})
+                threading.Thread(target=self.stop, daemon=True).start()
+                return False
+            if name == "list_models":
+                with self._lock:
+                    info = {n: {"inputs": p.input_specs,
+                                "outputs": p.output_specs}
+                            for n, p in self._models.items()}
+                send_frame(sock, 0, {"models": info})
+                return True
+            if name == "load_model":
+                self.add_model(header["name"], header["path"])
+                send_frame(sock, 0, {})
+                return True
+            if name != "infer":
+                send_frame(sock, 1, {"error": f"bad op {op}"})
+                return True
+            with self._lock:
+                pred = self._models.get(header["model"])
+            if pred is None:
+                raise KeyError(f"no model {header['model']!r}; loaded: "
+                               f"{sorted(self._models)}")
+            inputs = _unpack_arrays(header["inputs"], payload)
+            outs = pred.run(*inputs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            specs, body = _pack_arrays(np.asarray(o) for o in outs)
+            send_frame(sock, 0, {"outputs": specs, "nbytes": len(body)},
+                       body)
+            return True
+        except Exception as e:  # report, keep serving
+            send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})
+            return True
+
+
+class InferenceClient(FrameClient):
+    """Client for :class:`InferenceServer`."""
+
+    def __init__(self, endpoint: str):
+        super().__init__(endpoint, SERVING_OPS, service="serving")
+
+    def infer(self, model: str, *inputs) -> list[np.ndarray]:
+        specs, payload = _pack_arrays(inputs)
+        rheader, rpayload = self._request(
+            "infer", {"model": model, "inputs": specs,
+                      "nbytes": len(payload)}, payload)
+        return _unpack_arrays(rheader["outputs"], rpayload)
+
+    def list_models(self) -> dict:
+        return self._request("list_models", {})[0]["models"]
+
+    def load_model(self, name: str, path: str) -> None:
+        self._request("load_model", {"name": name, "path": path})
+
+    def stop_server(self) -> None:
+        try:
+            self._request("stop", {})
+        except (RuntimeError, ConnectionError, OSError):
+            pass

@@ -91,6 +91,38 @@ def test_fleet_gradient_merge(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_amp_cast_model_keeps_norms_fp32():
+    """keep_norms_fp32 (keep_batch_norm_fp32 analogue): norm subtrees —
+    params AND running stats — stay fp32 while everything else casts."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import amp
+
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.LayerNorm(8),
+                        nn.BatchNorm1D(8), nn.Linear(8, 2))
+    cast = amp.cast_model(net, jnp.bfloat16, keep_norms_fp32=True)
+    assert cast.layers[0].weight.dtype == jnp.bfloat16
+    assert cast.layers[3].weight.dtype == jnp.bfloat16
+    assert cast.layers[1].weight.dtype == jnp.float32      # LayerNorm
+    assert cast.layers[2].weight.dtype == jnp.float32      # BatchNorm
+    assert cast.layers[2].running_mean.dtype == jnp.float32
+    # decorate defaults to keeping norms fp32 (reference O2 decorator)
+    dec = amp.decorate(net, dtype="bfloat16")
+    assert dec.layers[1].weight.dtype == jnp.float32
+    # plain cast_model still casts everything (master-weights path)
+    allc = amp.cast_model(net, jnp.bfloat16)
+    assert allc.layers[1].weight.dtype == jnp.bfloat16
+
+    # user subclasses of norm layers keep the protection (isinstance)
+    class MyNorm(nn.LayerNorm):
+        pass
+
+    sub = nn.Sequential(nn.Linear(4, 4), MyNorm(4))
+    csub = amp.cast_model(sub, jnp.bfloat16, keep_norms_fp32=True)
+    assert csub.layers[1].weight.dtype == jnp.float32
+
+
 def test_fleet_amp_bf16(devices8):
     s = DistributedStrategy()
     s.amp.enable = True

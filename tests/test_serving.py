@@ -1,0 +1,116 @@
+"""Inference serving: exported StableHLO models behind the TCP service.
+
+Reference role: the C-API/AnalysisPredictor serving layer
+(``inference/api/analysis_predictor.h:82``).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.io import (
+    InferenceClient, InferenceServer, Predictor, save_inference_model,
+)
+
+
+@pytest.fixture(scope="module")
+def saved_mlp(tmp_path_factory):
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path_factory.mktemp("srv") / "mlp")
+    example = np.zeros((2, 4), np.float32)
+    save_inference_model(path, net, [example])
+    return path, net
+
+
+def test_serving_matches_local_predictor(saved_mlp):
+    path, net = saved_mlp
+    server = InferenceServer({"mlp": path}).start()
+    client = InferenceClient(server.endpoint)
+    try:
+        x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        (remote,) = client.infer("mlp", x)
+        local = np.asarray(Predictor(path).run(x))
+        np.testing.assert_allclose(remote, local, rtol=1e-6)
+        # and the artifact reproduces the live model
+        np.testing.assert_allclose(remote, np.asarray(net(x)), rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        client.stop_server()
+        client.close()
+
+
+def test_serving_list_load_and_errors(saved_mlp, tmp_path):
+    path, _ = saved_mlp
+    server = InferenceServer().start()
+    client = InferenceClient(server.endpoint)
+    try:
+        assert client.list_models() == {}
+        client.load_model("m2", path)          # hot-load over the wire
+        models = client.list_models()
+        assert models["m2"]["inputs"][0]["shape"] == [2, 4]
+        x = np.zeros((2, 4), np.float32)
+        (y,) = client.infer("m2", x)
+        assert y.shape == (2, 3)
+        with pytest.raises(RuntimeError, match="no model"):
+            client.infer("nope", x)
+        with pytest.raises(RuntimeError, match="shape"):
+            client.infer("m2", np.zeros((3, 4), np.float32))
+        with pytest.raises(RuntimeError, match="dtype"):
+            client.infer("m2", np.zeros((2, 4), np.float64))
+        # server kept serving through the errors
+        (y2,) = client.infer("m2", x)
+        np.testing.assert_allclose(y2, y)
+    finally:
+        client.stop_server()
+        client.close()
+
+
+def test_serving_admin_ops_gated(saved_mlp):
+    """admin_ops=False: the data plane stays up, but hot-load and stop
+    over the wire are refused — the non-loopback exposure posture."""
+    path, _ = saved_mlp
+    server = InferenceServer({"mlp": path}, admin_ops=False).start()
+    client = InferenceClient(server.endpoint)
+    try:
+        x = np.zeros((2, 4), np.float32)
+        (y,) = client.infer("mlp", x)
+        assert y.shape == (2, 3)
+        with pytest.raises(RuntimeError, match="admin op"):
+            client.load_model("evil", "/etc")
+        client.stop_server()            # refused server-side, swallowed
+        (y2,) = client.infer("mlp", x)  # still serving
+        np.testing.assert_allclose(y2, y)
+    finally:
+        server.stop()
+        client.close()
+
+
+def test_serving_concurrent_clients(saved_mlp):
+    import threading
+
+    path, _ = saved_mlp
+    server = InferenceServer({"mlp": path}).start()
+    results, errs = {}, []
+
+    def worker(i):
+        try:
+            c = InferenceClient(server.endpoint)
+            x = np.full((2, 4), float(i), np.float32)
+            (y,) = c.infer("mlp", x)
+            results[i] = y
+            c.close()
+        except Exception as e:   # pragma: no cover - failure reporting
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    server.stop()
+    assert not errs and len(results) == 6
+    ref = Predictor(path)
+    for i, y in results.items():
+        np.testing.assert_allclose(
+            y, np.asarray(ref.run(np.full((2, 4), float(i), np.float32))),
+            rtol=1e-6)
