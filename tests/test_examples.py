@@ -23,6 +23,7 @@ def run_example(name, *args):
     ("ps_recommender.py", ("--steps", "10")),
     ("qat_mnist_style.py", ("--steps", "10")),
     ("generate_text.py", ()),
+    ("serve_model.py", ("--steps", "120")),
 ])
 def test_example_runs(script, args):
     proc = run_example(script, *args)
