@@ -46,6 +46,7 @@ def main():
         net, opt_state = opt.apply_gradients(net, grads, opt_state)
         return net, opt_state, loss
 
+    loss = float("nan")
     for i in range(args.steps):
         net, opt_state, loss = step(net, opt_state, jnp.asarray(X),
                                     jnp.asarray(Y))

@@ -26,6 +26,8 @@ __all__ = [
     "sequence_softmax", "sequence_reverse", "sequence_concat",
     "sequence_expand_as", "sequence_conv", "sequence_enumerate",
     "sequence_erase", "sequence_first_step", "sequence_last_step",
+    "linear_chain_crf", "crf_decoding", "edit_distance", "ctc_align",
+    "im2sequence",
 ]
 
 
@@ -236,20 +238,184 @@ def sequence_enumerate(ids, lengths, win_size: int, pad_value: int = 0):
     return jnp.stack(out, axis=-1)
 
 
+def _left_compact(ids, keep, length_dtype):
+    """Keep-masked tokens, left-compacted per row (stable order):
+    returns ([B, T] zero-padded, new lengths). Dropped tokens target
+    index T → out-of-bounds → ``mode="drop"`` skips the write; only kept
+    ids land, at their cumsum-compacted slots."""
+    B, T = ids.shape
+    new_pos = jnp.cumsum(keep, axis=1) - 1                 # [B, T]
+    new_len = jnp.sum(keep, axis=1).astype(length_dtype)
+    b = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    tgt = jnp.where(keep, new_pos, T)
+    out = jnp.zeros_like(ids).at[b, tgt].set(ids, mode="drop")
+    return out, new_len
+
+
 def sequence_erase(ids, lengths, tokens):
     """Remove every occurrence of ``tokens`` and left-compact each
     sequence (reference ``sequence_erase_op.h``). Static shapes: output
     [B, T] with ``pad`` (0) tail and the new lengths."""
-    B, T = ids.shape
     tokens = jnp.asarray(tokens)
-    valid = sequence_mask(lengths, T)
+    valid = sequence_mask(lengths, ids.shape[1])
     keep = valid & ~jnp.isin(ids, tokens)
-    # left-compact: stable order of kept tokens via cumsum positions
-    new_pos = jnp.cumsum(keep, axis=1) - 1                 # [B, T]
-    new_len = jnp.sum(keep, axis=1).astype(lengths.dtype)
-    b = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
-    # dropped tokens target index T → out-of-bounds → mode="drop" skips
-    # the write; only kept ids land, at their compacted slots
-    tgt = jnp.where(keep, new_pos, T)
-    out = jnp.zeros_like(ids).at[b, tgt].set(ids, mode="drop")
-    return out, new_len
+    return _left_compact(ids, keep, lengths.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sequence labeling: CRF, edit distance, CTC alignment, im2sequence
+# (reference operators/linear_chain_crf_op.*, crf_decoding_op.*,
+# edit_distance_op.*, ctc_align_op.*, im2sequence_op.*)
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(emission, transition, labels, lengths):
+    """Per-sequence negative log-likelihood of a linear-chain CRF
+    (reference ``linear_chain_crf_op.h``; same transition layout:
+    ``transition[0]`` = start weights, ``transition[1]`` = stop weights,
+    ``transition[2:]`` = the [D, D] transition matrix w[prev, next]).
+
+    TPU-native formulation: the reference normalizes per-step in the
+    probability domain (``NormalizeL1``); here the forward algorithm runs
+    in the log domain with a ``lax.scan`` over time — algebraically the
+    same partition function, MXU/VPU-friendly and stable without
+    normalization. Inputs are the padded encoding: emission [B, T, D],
+    labels [B, T] int, lengths [B]. Returns nll [B].
+    """
+    emission = jnp.asarray(emission)
+    B, T, D = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    valid = sequence_mask(lengths, T)                       # [B, T]
+
+    # log partition via forward recursion
+    alpha0 = start[None, :] + emission[:, 0]                # [B, D]
+
+    def fwd(alpha, t):
+        e_t = emission[:, t]                                # [B, D]
+        new = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + e_t
+        alpha = jnp.where(valid[:, t][:, None], new, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, T)) if T > 1 \
+        else (alpha0, None)
+    log_z = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=-1)
+
+    # gold-path score: start + emissions + transitions + stop, masked
+    lab = jnp.clip(labels, 0, D - 1)
+    b = jnp.arange(B)
+    e_score = jnp.sum(
+        jnp.where(valid, jnp.take_along_axis(
+            emission, lab[:, :, None], axis=2)[:, :, 0], 0.0), axis=1)
+    pair_valid = valid[:, 1:]                               # step t-1 → t
+    t_score = jnp.sum(
+        jnp.where(pair_valid, trans[lab[:, :-1], lab[:, 1:]], 0.0),
+        axis=1) if T > 1 else jnp.zeros((B,), emission.dtype)
+    last = jnp.clip(lengths - 1, 0, T - 1)
+    gold = (start[lab[:, 0]] + e_score + t_score
+            + stop[lab[b, last]])
+    return log_z - gold
+
+
+def crf_decoding(emission, transition, lengths, labels=None):
+    """Viterbi decode (reference ``crf_decoding_op.h``): best path
+    [B, T] (zeros past each length). With ``labels``, returns instead the
+    reference's per-position correctness indicator — 1 where the decoded
+    tag equals the label within the sequence, 0 elsewhere."""
+    emission = jnp.asarray(emission)
+    B, T, D = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    valid = sequence_mask(lengths, T)
+
+    v0 = start[None, :] + emission[:, 0]                    # [B, D]
+
+    def step(v, t):
+        scores = v[:, :, None] + trans[None]                # [B, D, D]
+        best_prev = jnp.argmax(scores, axis=1)              # [B, D]
+        new = jnp.max(scores, axis=1) + emission[:, t]
+        keep = valid[:, t][:, None]
+        v = jnp.where(keep, new, v)
+        # frozen steps point back at themselves (identity backpointer)
+        bp = jnp.where(keep, best_prev,
+                       jnp.broadcast_to(jnp.arange(D)[None], (B, D)))
+        return v, bp
+
+    if T > 1:
+        v, bps = jax.lax.scan(step, v0, jnp.arange(1, T))   # bps [T-1,B,D]
+    else:
+        v, bps = v0, jnp.zeros((0, B, D), jnp.int32)
+    last_tag = jnp.argmax(v + stop[None, :], axis=-1)       # [B]
+
+    # backtrace: bps[k] holds, for position k+1, the best tag at position
+    # k. reverse scan carries the tag backwards; frozen (past-length)
+    # steps have identity backpointers so the final real tag propagates
+    # unchanged through the padding region.
+    def back(tag, bp):
+        prev = bp[jnp.arange(B), tag]
+        return prev, tag              # emit the tag at position k+1
+
+    first_tag, rest = jax.lax.scan(back, last_tag, bps, reverse=True)
+    path = jnp.concatenate([first_tag[:, None], rest.T], axis=1) \
+        if T > 1 else last_tag[:, None]
+    path = jnp.where(valid, path, 0)
+    if labels is not None:
+        return jnp.where(valid, (path == labels).astype(jnp.int32), 0)
+    return path
+
+
+def edit_distance(hyp, hyp_len, ref, ref_len, normalized: bool = False):
+    """Levenshtein distance per pair (reference ``edit_distance_op.h``):
+    hyp [B, Th] int, ref [B, Tr] int with lengths; ``normalized`` divides
+    by the reference length. Wavefront DP as a ``lax.scan`` over hyp
+    positions carrying one [Tr+1] row per sequence (vmapped over B)."""
+    hyp, ref = jnp.asarray(hyp), jnp.asarray(ref)
+    Th, Tr = hyp.shape[1], ref.shape[1]
+
+    def one(h, hl, r, rl):
+        row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+        idx = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+        def step(row, i):
+            # row = distances for hyp[:i]; compute for hyp[:i+1]. The
+            # left-to-right recurrence new[j] = min(base_j, new[j-1]+1)
+            # is a (min,+) running min: new[j] = j + cummin(base - j) —
+            # log-depth on TPU instead of Tr sequential scalar steps.
+            ins = row[1:] + 1.0
+            sub = row[:-1] + (h[i] != r).astype(jnp.float32)
+            base = jnp.concatenate([row[:1] + 1.0,
+                                    jnp.minimum(ins, sub)])
+            new = idx + jax.lax.cummin(base - idx)
+            return jnp.where(i < hl, new, row), None
+
+        row, _ = jax.lax.scan(step, row0, jnp.arange(Th))
+        # (rl == 0 needs no special case: row[0] accumulates +1 per valid
+        # hyp step, so it already equals hl there)
+        d = row[jnp.clip(rl, 0, Tr)]
+        if normalized:
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    return jax.vmap(one)(hyp, hyp_len, ref, ref_len)
+
+
+def ctc_align(ids, lengths, blank: int = 0):
+    """CTC greedy-decode alignment (reference ``ctc_align_op.h``): merge
+    repeated tokens, drop blanks, left-compact. Returns (aligned [B, T]
+    zero-padded, new_lengths [B])."""
+    ids = jnp.asarray(ids)
+    B, T = ids.shape
+    valid = sequence_mask(lengths, T)
+    prev = jnp.concatenate([jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]],
+                           axis=1)
+    keep = valid & (ids != blank) & (ids != prev)
+    return _left_compact(ids, keep, lengths.dtype)
+
+
+def im2sequence(x, kernel_size, stride=1, padding=0):
+    """[N, C, H, W] → [N, L, C*kh*kw] patch sequence (reference
+    ``im2sequence_op.h``, the OCR feeder): each output step is one
+    flattened receptive field, row-major over output positions."""
+    from paddle_tpu.nn import functional as F
+
+    cols = F.unfold(x, kernel_size, stride=stride, padding=padding)
+    return cols.transpose(0, 2, 1)                          # [N, L, C*k*k]

@@ -205,3 +205,137 @@ def test_ragged_collate_dataloader_path():
         assert np.isfinite(np.asarray(pooled)).all()
     # bucketing bounds the distinct compile shapes
     assert len(shapes) <= 4
+
+
+# ---------------------------------------------------------------------------
+# sequence labeling: CRF / edit distance / ctc_align / im2sequence
+# ---------------------------------------------------------------------------
+
+def _brute_crf(emission, transition, lengths):
+    """Enumerate all label sequences: exact log-partition and best path."""
+    import itertools
+
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    B, T, D = emission.shape
+    log_zs, best_paths, best_scores = [], [], []
+    for b in range(B):
+        L = int(lengths[b])
+        scores = {}
+        for seq in itertools.product(range(D), repeat=L):
+            s = start[seq[0]] + emission[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + emission[b, t, seq[t]]
+            s += stop[seq[-1]]
+            scores[seq] = s
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        log_zs.append(m + np.log(np.exp(vals - m).sum()))
+        best = max(scores, key=scores.get)
+        best_paths.append(list(best) + [0] * (T - L))
+        best_scores.append(scores[best])
+    return np.array(log_zs), np.array(best_paths)
+
+
+def test_linear_chain_crf_matches_enumeration():
+    rs = np.random.RandomState(0)
+    B, T, D = 3, 4, 3
+    emission = rs.randn(B, T, D).astype(np.float64)
+    transition = rs.randn(D + 2, D).astype(np.float64)
+    labels = rs.randint(0, D, (B, T))
+    lengths = np.array([4, 2, 3])
+    log_z, _ = _brute_crf(emission, transition, lengths)
+    nll = np.asarray(S.linear_chain_crf(
+        jnp.asarray(emission), jnp.asarray(transition),
+        jnp.asarray(labels), jnp.asarray(lengths)))
+    # manual gold scores
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = int(lengths[b])
+        g = start[labels[b, 0]] + emission[b, 0, labels[b, 0]]
+        for t in range(1, L):
+            g += trans[labels[b, t - 1], labels[b, t]]
+            g += emission[b, t, labels[b, t]]
+        g += stop[labels[b, L - 1]]
+        np.testing.assert_allclose(nll[b], log_z[b] - g, rtol=1e-5)
+
+
+def test_linear_chain_crf_grads():
+    rs = np.random.RandomState(1)
+    emission = rs.randn(2, 3, 3)
+    transition = rs.randn(5, 3)
+    labels = jnp.asarray(rs.randint(0, 3, (2, 3)))
+    lengths = jnp.asarray(np.array([3, 2]))
+    check_grad(
+        lambda e, tr: S.linear_chain_crf(e, tr, labels, lengths),
+        [emission, transition], wrt=(0, 1))
+
+
+def test_crf_decoding_matches_enumeration():
+    rs = np.random.RandomState(2)
+    B, T, D = 3, 5, 3
+    emission = rs.randn(B, T, D).astype(np.float64)
+    transition = rs.randn(D + 2, D).astype(np.float64)
+    lengths = np.array([5, 3, 1])
+    _, best = _brute_crf(emission, transition, lengths)
+    path = np.asarray(S.crf_decoding(
+        jnp.asarray(emission), jnp.asarray(transition),
+        jnp.asarray(lengths)))
+    np.testing.assert_array_equal(path, best)
+    # label mode: per-position correctness indicator
+    ind = np.asarray(S.crf_decoding(
+        jnp.asarray(emission), jnp.asarray(transition),
+        jnp.asarray(lengths), labels=jnp.asarray(best)))
+    expect = (np.arange(T)[None] < lengths[:, None]).astype(np.int64)
+    np.testing.assert_array_equal(ind, expect)
+
+
+def test_edit_distance_golden():
+    def py_ed(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[-1, -1]
+
+    rs = np.random.RandomState(3)
+    B, Th, Tr = 4, 6, 5
+    hyp = rs.randint(0, 4, (B, Th))
+    ref = rs.randint(0, 4, (B, Tr))
+    hl = np.array([6, 4, 2, 0])
+    rl = np.array([5, 5, 0, 3])
+    got = np.asarray(S.edit_distance(
+        jnp.asarray(hyp), jnp.asarray(hl), jnp.asarray(ref),
+        jnp.asarray(rl)))
+    want = [py_ed(list(hyp[b, :hl[b]]), list(ref[b, :rl[b]]))
+            for b in range(B)]
+    np.testing.assert_allclose(got, want)
+    norm = np.asarray(S.edit_distance(
+        jnp.asarray(hyp), jnp.asarray(hl), jnp.asarray(ref),
+        jnp.asarray(rl), normalized=True))
+    np.testing.assert_allclose(norm, np.array(want) / np.maximum(rl, 1))
+
+
+def test_ctc_align_golden():
+    ids = jnp.asarray(np.array([[1, 1, 0, 2, 2, 0, 3],
+                                [0, 0, 4, 4, 4, 5, 0]]))
+    lengths = jnp.asarray(np.array([7, 6]))
+    out, new_len = S.ctc_align(ids, lengths, blank=0)
+    np.testing.assert_array_equal(np.asarray(new_len), [3, 2])
+    np.testing.assert_array_equal(np.asarray(out)[0, :3], [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(out)[1, :2], [4, 5])
+    assert np.asarray(out)[0, 3:].sum() == 0
+
+
+def test_im2sequence_matches_unfold():
+    from paddle_tpu.nn import functional as F
+
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 3, 6, 6).astype(np.float32))
+    seq = S.im2sequence(x, 2, stride=2)
+    assert seq.shape == (2, 9, 12)        # 3x3 positions, 3*2*2 features
+    cols = F.unfold(x, 2, stride=2)
+    np.testing.assert_allclose(np.asarray(seq),
+                               np.asarray(cols).transpose(0, 2, 1))
