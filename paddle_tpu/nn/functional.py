@@ -325,6 +325,7 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
         # screen on everything but the row count before paying for the
         # padded copy (v alignment, dtypes)
         if mode != "off" and v % _px._BLOCK_V == 0 \
+                and v <= _px.DISPATCH_MAX_V \
                 and logits.dtype in (jnp.float32, jnp.bfloat16):
             # Row-pad to the kernel block so shifted-label LM losses
             # ([B, T-1, V] → B·(T-1) rows) still dispatch; padded rows are

@@ -371,9 +371,7 @@ def edit_distance(hyp, hyp_len, ref, ref_len, normalized: bool = False):
     Th, Tr = hyp.shape[1], ref.shape[1]
 
     def one(h, hl, r, rl):
-        row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
-
-        idx = jnp.arange(Tr + 1, dtype=jnp.float32)
+        idx = jnp.arange(Tr + 1, dtype=jnp.float32)  # also the DP row 0
 
         def step(row, i):
             # row = distances for hyp[:i]; compute for hyp[:i+1]. The
@@ -387,7 +385,7 @@ def edit_distance(hyp, hyp_len, ref, ref_len, normalized: bool = False):
             new = idx + jax.lax.cummin(base - idx)
             return jnp.where(i < hl, new, row), None
 
-        row, _ = jax.lax.scan(step, row0, jnp.arange(Th))
+        row, _ = jax.lax.scan(step, idx, jnp.arange(Th))
         # (rl == 0 needs no special case: row[0] accumulates +1 per valid
         # hyp step, so it already equals hl there)
         d = row[jnp.clip(rl, 0, Tr)]
