@@ -70,6 +70,8 @@ from paddle_tpu.tensor_ops import *  # noqa: E402,F401,F403
 from paddle_tpu import tensor_ops as tensor  # noqa: E402
 from paddle_tpu import jit  # noqa: E402
 from paddle_tpu import distribution  # noqa: E402
+from paddle_tpu import device  # noqa: E402
+from paddle_tpu.data.reader import batch  # noqa: E402
 from paddle_tpu import regularizer  # noqa: E402
 from paddle_tpu import text  # noqa: E402
 from paddle_tpu.hapi.flops import flops, summary  # noqa: E402

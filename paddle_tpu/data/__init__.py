@@ -18,3 +18,4 @@ from paddle_tpu.data.sampler import (
     SequenceSampler,
 )
 from paddle_tpu.data.dataloader import DataLoader, default_collate, ragged_collate
+from paddle_tpu.data.reader import batch, chain, shuffle

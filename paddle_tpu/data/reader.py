@@ -1,0 +1,64 @@
+"""Reader decorators — the ``paddle.batch`` / ``paddle.reader`` surface.
+
+Reference: ``python/paddle/batch.py:18`` (mini-batching decorator over a
+sample generator) and the ``fluid/reader``-era composers (shuffle,
+chain). Kept for API parity with generator-based input pipelines; new
+code should prefer ``paddle_tpu.data.DataLoader``.
+"""
+
+from __future__ import annotations
+
+import random as _random
+
+__all__ = ["batch", "shuffle", "chain"]
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Wrap a sample-generator factory into a mini-batch generator
+    factory (reference ``paddle.batch``)."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def shuffle(reader, buf_size: int, seed: int | None = None):
+    """Buffered shuffle of a sample generator (reference
+    ``fluid.io.shuffle``)."""
+
+    if buf_size <= 0:
+        raise ValueError(f"buf_size must be positive, got {buf_size}")
+
+    def shuffled():
+        rng = _random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate sample generators (reference ``fluid.io.chain``)."""
+
+    def chained():
+        for r in readers:
+            yield from r()
+
+    return chained

@@ -1,0 +1,77 @@
+"""Device queries — the ``paddle.device`` surface, TPU-native.
+
+Reference: ``python/paddle/device.py`` (set_device/get_device at
+``:104,170``, backend predicates). On TPU the "place" concept maps to
+JAX's device list: ``get_device()`` reports the default backend and
+ordinal (``"tpu:0"``), ``set_device`` switches JAX's default device, and
+the CUDA/XPU predicates report False (with ``is_compiled_with_tpu`` as
+the native affirmative).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "get_all_devices"]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    """Number of devices on the default backend (the reference's
+    ``cuda.device_count`` role)."""
+    return len(jax.devices())
+
+
+def get_all_devices() -> list[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def set_device(device: str):
+    """``"tpu"``, ``"cpu"``, ``"tpu:1"``, … — sets JAX's default device
+    (reference ``paddle.set_device``). Returns the device object."""
+    if ":" in device:
+        platform, idx_s = device.rsplit(":", 1)
+        idx = int(idx_s)
+    else:
+        platform, idx = device, 0
+    if platform == "gpu":
+        raise ValueError(
+            "this is the TPU-native build: no CUDA places; use 'tpu' "
+            "or 'cpu'")
+    try:
+        matches = list(jax.devices(platform)) if platform else []
+    except RuntimeError as e:  # unknown/absent backend → our contract
+        raise ValueError(
+            f"device {device!r}: backend not available ({e}); use 'tpu' "
+            "or 'cpu'") from None
+    if not 0 <= idx < len(matches):
+        raise ValueError(
+            f"device {device!r}: only {len(matches)} {platform} "
+            "device(s) present")
+    dev = matches[idx]
+    jax.config.update("jax_default_device", dev)
+    return dev
+
+
+def get_device() -> str:
+    """Current default device as ``"<platform>:<id>"`` (reference
+    ``paddle.get_device``)."""
+    dev = jax.config.jax_default_device
+    if dev is None:
+        dev = jax.devices()[0]
+    elif isinstance(dev, str):  # JAX also accepts a platform string here
+        dev = jax.devices(dev)[0]
+    return f"{dev.platform}:{dev.id}"

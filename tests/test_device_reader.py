@@ -1,0 +1,55 @@
+"""paddle.device + paddle.batch/reader surface parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import device
+from paddle_tpu.data import batch, chain, shuffle
+
+
+def test_device_queries():
+    assert device.device_count() >= 1
+    d = device.get_device()
+    platform, idx = d.rsplit(":", 1)
+    assert platform in ("cpu", "tpu") and idx.isdigit()
+    assert not device.is_compiled_with_cuda()
+    assert not device.is_compiled_with_xpu()
+    assert all(":" in s for s in device.get_all_devices())
+
+
+def test_set_device_roundtrip():
+    platform = device.get_device().rsplit(":", 1)[0]
+    dev = device.set_device(f"{platform}:0")
+    assert dev.id == 0
+    assert device.get_device() == f"{platform}:0"
+    with pytest.raises(ValueError, match="TPU-native"):
+        device.set_device("gpu:0")
+    with pytest.raises(ValueError, match="device"):
+        device.set_device(f"{platform}:999")
+    with pytest.raises(ValueError, match="device"):
+        device.set_device(f"{platform}:-1")    # negative index rejected
+    with pytest.raises(ValueError, match="backend not available"):
+        device.set_device("xpu")
+
+
+def test_batch_reader():
+    r = batch(lambda: iter(range(10)), 4)
+    assert [list(b) for b in r()] == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    r2 = batch(lambda: iter(range(10)), 4, drop_last=True)
+    assert [list(b) for b in r2()] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert paddle_tpu.batch is batch
+    with pytest.raises(ValueError, match="batch_size"):
+        batch(lambda: iter(()), 0)
+
+
+def test_shuffle_and_chain_readers():
+    base = lambda: iter(range(20))
+    out = list(shuffle(base, buf_size=8, seed=3)())
+    assert sorted(out) == list(range(20)) and out != list(range(20))
+    # deterministic under the same seed
+    assert out == list(shuffle(base, buf_size=8, seed=3)())
+    both = list(chain(lambda: iter([1, 2]), lambda: iter([3]))())
+    assert both == [1, 2, 3]
+    with pytest.raises(ValueError, match="buf_size"):
+        shuffle(base, buf_size=0)
