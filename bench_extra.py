@@ -91,6 +91,21 @@ def main(only: str | None = None):
         lm_bench("moe-8x", MoEForCausalLM(ecfg), 32000, 8, 1024,
                  ecfg.num_params())
 
+    if want("longctx"):
+        # Long-context single-chip: seq 16384 through the Pallas flash
+        # attention (O(T) memory) + per-layer remat — the on-hardware leg
+        # of the long-context story (ring/Ulysses extend it across chips)
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        lcfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=8, num_heads=16, num_kv_heads=8,
+            max_seq_len=16384, dtype="bfloat16", remat=True,
+            remat_policy="nothing_saveable")
+        n = lcfg.num_params()
+        lm_bench("llama-longctx-16k", LlamaForCausalLM(lcfg), 32000, 1,
+                 16384, n)
+
     # ERNIE base MLM (encoder side)
     import paddle_tpu.distributed as dist
     from paddle_tpu.parallel import mesh as M

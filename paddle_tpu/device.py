@@ -44,7 +44,13 @@ def set_device(device: str):
     (reference ``paddle.set_device``). Returns the device object."""
     if ":" in device:
         platform, idx_s = device.rsplit(":", 1)
-        idx = int(idx_s)
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            raise ValueError(
+                f"device {device!r}: ordinal {idx_s!r} is not an "
+                "integer; expected '<platform>' or '<platform>:<id>'"
+            ) from None
     else:
         platform, idx = device, 0
     if platform == "gpu":

@@ -67,6 +67,37 @@ def test_serving_list_load_and_errors(saved_mlp, tmp_path):
         client.close()
 
 
+def test_serving_llm_generate_endpoint(tmp_path):
+    """LLM serving end to end: the compiled greedy-decode loop
+    (lax.fori_loop + static KV cache) exports to StableHLO and serves
+    behind the TCP service — remote generations match local ones."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.io import save_inference_model
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=128, num_layers=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    prompt = np.random.RandomState(0).randint(0, 128, (2, 8)) \
+        .astype(np.int32)
+    path = str(tmp_path / "llm")
+    save_inference_model(path, model, [prompt],
+                         forward=lambda m, ids: generate(m, ids, 16))
+
+    server = InferenceServer({"llm": path}).start()
+    client = InferenceClient(server.endpoint)
+    try:
+        (out,) = client.infer("llm", prompt)
+        assert out.shape == (2, 24)
+        ref = np.asarray(generate(model, jnp.asarray(prompt), 16))
+        np.testing.assert_array_equal(out, ref)
+    finally:
+        client.stop_server()
+        client.close()
+
+
 def test_serving_admin_ops_gated(saved_mlp):
     """admin_ops=False: the data plane stays up, but hot-load and stop
     over the wire are refused — the non-loopback exposure posture."""
