@@ -53,3 +53,17 @@ def test_shuffle_and_chain_readers():
     assert both == [1, 2, 3]
     with pytest.raises(ValueError, match="buf_size"):
         shuffle(base, buf_size=0)
+
+
+def test_run_check_and_deprecated():
+    from paddle_tpu import utils
+
+    assert utils.run_check(verbose=False)
+
+    @utils.deprecated(since="0.3", update_to="new_fn", reason="renamed")
+    def old_fn(x):
+        return x + 1
+
+    with pytest.warns(DeprecationWarning, match="old_fn.*renamed.*new_fn"):
+        assert old_fn(1) == 2
+    assert "[deprecated]" in old_fn.__doc__
