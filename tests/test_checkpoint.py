@@ -142,6 +142,57 @@ def test_encrypted_state_dict_roundtrip(tmp_path):
                                   np.asarray(model.weight))
 
 
+def _run_elastic_resume(ckdir, build, strategy1, strategy2, *, n_epochs,
+                        break_epoch, rtol, check_restored=None):
+    """Shared elastic-resume harness: phase 1 trains under ``strategy1``
+    and is killed (break) after ``break_epoch``'s save; phase 2 resumes
+    the SAME job under ``strategy2`` (resharded restore); the merged loss
+    curve must match one uninterrupted ``strategy1`` run. Mesh contexts
+    are closed on every path so a failing phase can't leak a global mesh
+    into later tests."""
+    losses = {}
+    step, state, batch, ctx = build(strategy1)
+    try:
+        r = io.TrainEpochRange(n_epochs, ckdir, state=state)
+        for epoch in r:
+            state, metrics = step(state, batch, jax.random.PRNGKey(epoch))
+            losses[epoch] = float(metrics["loss"])
+            r.state = state
+            if epoch == break_epoch:
+                break
+        r.flush()
+    finally:
+        ctx.__exit__(None, None, None)
+
+    step2, state2, batch2, ctx2 = build(strategy2)
+    try:
+        r2 = io.TrainEpochRange(n_epochs, ckdir, state=state2)
+        assert r2.resumed
+        state2 = r2.state
+        for epoch in r2:
+            state2, metrics = step2(state2, batch2,
+                                    jax.random.PRNGKey(epoch))
+            losses[epoch] = float(metrics["loss"])
+            r2.state = state2
+        r2.flush()
+        if check_restored is not None:
+            check_restored(state2)
+    finally:
+        ctx2.__exit__(None, None, None)
+
+    step3, state3, batch3, ctx3 = build(strategy1)
+    try:
+        ref = []
+        for epoch in range(n_epochs):
+            state3, metrics = step3(state3, batch3,
+                                    jax.random.PRNGKey(epoch))
+            ref.append(float(metrics["loss"]))
+    finally:
+        ctx3.__exit__(None, None, None)
+    np.testing.assert_allclose([losses[e] for e in range(n_epochs)], ref,
+                               rtol=rtol)
+
+
 def test_auto_checkpoint_resume_on_different_topology(tmp_path):
     """Resume a dp-only run as zero2-sharded (different mesh layout): the
     orbax restore reshapes shards onto the new topology and the loss
@@ -152,8 +203,7 @@ def test_auto_checkpoint_resume_on_different_topology(tmp_path):
     from paddle_tpu.core.strategy import DistributedStrategy
     from paddle_tpu.parallel import mesh as M
 
-    devs = jax.devices()
-    if len(devs) < 8:
+    if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
 
     rs = np.random.RandomState(0)
@@ -169,52 +219,76 @@ def test_auto_checkpoint_resume_on_different_topology(tmp_path):
         mesh = M.mesh_from_strategy(strategy)
         ctx = M.MeshContext(mesh)
         ctx.__enter__()
-        step = dist.fleet.build_train_step(
-            model, optimizer=optim.Adam(1e-2), loss_fn=loss_fn, mesh=mesh)
-        state = step.init_state(model)
-        batch = step.shard_batch({"x": x, "y": y})
+        try:
+            step = dist.fleet.build_train_step(
+                model, optimizer=optim.Adam(1e-2), loss_fn=loss_fn,
+                mesh=mesh)
+            state = step.init_state(model)
+            batch = step.shard_batch({"x": x, "y": y})
+        except BaseException:
+            ctx.__exit__(None, None, None)
+            raise
         return step, state, batch, ctx
 
-    ckdir = str(tmp_path / "topo")
-
-    # phase 1: pure dp over 8 devices, run 3 epochs, save
-    s1 = DistributedStrategy()
-    step, state, batch, ctx = build(s1)
-    r = io.TrainEpochRange(6, ckdir, state=state)
-    losses = {}
-    for epoch in r:
-        state, metrics = step(state, batch, jax.random.PRNGKey(epoch))
-        losses[epoch] = float(metrics["loss"])
-        r.state = state
-        if epoch == 2:
-            break
-    r.flush()
-    ctx.__exit__(None, None, None)
-
-    # phase 2: SAME job resumed as zero-2 over (dp=4, fsdp=2)
     s2 = DistributedStrategy()
     s2.sharding.enable = True
     s2.sharding.stage = 2
     s2.sharding.degree = 2
-    step2, state2, batch2, ctx2 = build(s2)
-    r2 = io.TrainEpochRange(6, ckdir, state=state2)
-    assert r2.resumed
-    state2 = r2.state
-    for epoch in r2:
-        state2, metrics = step2(state2, batch2, jax.random.PRNGKey(epoch))
-        losses[epoch] = float(metrics["loss"])
-        r2.state = state2
-    r2.flush()
-    ctx2.__exit__(None, None, None)
+    _run_elastic_resume(str(tmp_path / "topo"), build,
+                        DistributedStrategy(), s2, n_epochs=6,
+                        break_epoch=2, rtol=1e-5)
 
-    # reference: one uninterrupted dp run
-    s3 = DistributedStrategy()
-    step3, state3, batch3, ctx3 = build(s3)
-    ref = []
-    for epoch in range(6):
-        state3, metrics = step3(state3, batch3, jax.random.PRNGKey(epoch))
-        ref.append(float(metrics["loss"]))
-    ctx3.__exit__(None, None, None)
 
-    got = [losses[e] for e in range(6)]
-    np.testing.assert_allclose(got, ref, rtol=1e-5)
+def test_auto_checkpoint_resume_into_tp_sharded_llama(tmp_path):
+    """Elastic resume with a genuinely resharded parameter layout: a
+    dp-only tiny-Llama run is resumed as zero3 x tp2 — Megatron-split
+    weights (fsdp AND tp axes in the pspecs) restored from replicated
+    shards. The loss curve must continue exactly as an uninterrupted dp
+    run."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.core.strategy import DistributedStrategy
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import mesh as M
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(0, 256, (8, 16)).astype(np.int32))
+
+    def build(strategy):
+        paddle_tpu.seed(31)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        mesh = M.mesh_from_strategy(strategy)
+        ctx = M.MeshContext(mesh)
+        ctx.__enter__()
+        try:
+            step = dist.fleet.build_train_step(
+                model, optimizer=optim.Adam(1e-3), strategy=strategy,
+                mesh=mesh)
+            state = step.init_state(model)
+            batch = step.shard_batch({"input_ids": ids, "labels": ids})
+        except BaseException:
+            ctx.__exit__(None, None, None)
+            raise
+        return step, state, batch, ctx
+
+    def check_restored(state2):
+        # the restored params really are Megatron-split on the new mesh:
+        # wq's spec must carry BOTH the fsdp and the tp axis
+        spec = state2.model.blocks.block.attn.wq.weight.sharding.spec
+        axes = {ax for part in spec if part
+                for ax in (part if isinstance(part, tuple) else (part,))}
+        assert {"tp", "fsdp"} <= axes, axes
+
+    s2 = DistributedStrategy()
+    s2.sharding.enable = True
+    s2.sharding.stage = 3
+    s2.sharding.degree = 2
+    s2.tensor_parallel.enable = True
+    s2.tensor_parallel.degree = 2
+    _run_elastic_resume(str(tmp_path / "llama_topo"), build,
+                        DistributedStrategy(), s2, n_epochs=5,
+                        break_epoch=1, rtol=2e-4,
+                        check_restored=check_restored)
