@@ -478,23 +478,48 @@ class CompiledTrainStep:
             lambda x: NamedSharding(self._mesh, self._data_spec_fn(x)), batch)
         return jax.device_put(batch, shardings)
 
+    def _build_jit(self, state, batch):
+        """The production jit wiring (shardings + donation) — shared by
+        ``__call__`` and ``compile_abstract`` so AOT artifacts measure
+        exactly what training executes."""
+        specs = self._state_specs_fn(state)
+        state_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        data_shardings = jax.tree_util.tree_map(
+            lambda x: NamedSharding(self._mesh, self._data_spec_fn(x)),
+            batch)
+        return jax.jit(
+            self._step_fn,
+            in_shardings=(state_shardings, data_shardings, None),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if self._donate else (),
+        )
+
+    def compile_abstract(self, abstract_state, abstract_batch, key=None):
+        """AOT-compile the train step over abstract (ShapeDtypeStruct)
+        state/batch — full-size flagship configs compile and report XLA
+        memory analysis without materializing any weights. Uses the SAME
+        jit wiring (shardings, donation, partitioner scoping) as
+        ``__call__``."""
+        if key is None:
+            key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        lowered = self._build_jit(abstract_state, abstract_batch).lower(
+            abstract_state, abstract_batch, key)
+        if self._use_gspmd:
+            prev = jax.config.jax_use_shardy_partitioner
+            jax.config.update("jax_use_shardy_partitioner", False)
+            try:
+                return lowered.compile()
+            finally:
+                jax.config.update("jax_use_shardy_partitioner", prev)
+        return lowered.compile()
+
     def __call__(self, state: TrainState, batch, key=None):
         if key is None:
             key = rng.next_key()
         if self._jitted is None:
-            specs = self._state_specs_fn(state)
-            state_shardings = jax.tree_util.tree_map(
-                lambda s: NamedSharding(self._mesh, s), specs,
-                is_leaf=lambda x: isinstance(x, P))
-            data_shardings = jax.tree_util.tree_map(
-                lambda x: NamedSharding(self._mesh, self._data_spec_fn(x)),
-                batch)
-            self._jitted = jax.jit(
-                self._step_fn,
-                in_shardings=(state_shardings, data_shardings, None),
-                out_shardings=(state_shardings, None),
-                donate_argnums=(0,) if self._donate else (),
-            )
+            self._jitted = self._build_jit(state, batch)
         if self._use_gspmd:
             # scoped partitioner switch: compile (first call) happens under
             # GSPMD, restore immediately — the cached executable keeps its

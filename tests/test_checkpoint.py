@@ -145,11 +145,15 @@ def test_encrypted_state_dict_roundtrip(tmp_path):
 def _run_elastic_resume(ckdir, build, strategy1, strategy2, *, n_epochs,
                         break_epoch, rtol, check_restored=None):
     """Shared elastic-resume harness: phase 1 trains under ``strategy1``
-    and is killed (break) after ``break_epoch``'s save; phase 2 resumes
-    the SAME job under ``strategy2`` (resharded restore); the merged loss
-    curve must match one uninterrupted ``strategy1`` run. Mesh contexts
-    are closed on every path so a failing phase can't leak a global mesh
-    into later tests."""
+    and is killed by breaking *inside* ``break_epoch``'s iteration —
+    before that epoch's post-yield save — so the checkpoint on disk is
+    ``break_epoch - 1``'s and the resumed phase re-trains ``break_epoch``
+    (requires ``break_epoch >= 1``). Phase 2 resumes the SAME job under
+    ``strategy2`` (resharded restore); the merged loss curve must match
+    one uninterrupted ``strategy1`` run. Mesh contexts are closed on
+    every path so a failing phase can't leak a global mesh into later
+    tests."""
+    assert break_epoch >= 1, "no checkpoint exists before epoch 0's save"
     losses = {}
     step, state, batch, ctx = build(strategy1)
     try:

@@ -1,0 +1,89 @@
+"""Flagship-scale compile checks — abstract AOT lowering.
+
+The environment has one real chip, but the north-star configs are
+multi-chip (Llama-2-7B sharded; 70B 4D-parallel). ``jax.eval_shape``
+builds the full-size model abstractly (no weights materialized) and
+``jax.jit(...).lower(...).compile()`` partitions + compiles the real
+train step for the virtual mesh, with XLA's memory analysis giving
+per-device footprints — the strongest no-hardware evidence that the
+strategy compiler's output actually scales.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.distributed as dist
+from paddle_tpu import optimizer as optim
+from paddle_tpu.core.strategy import DistributedStrategy
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel import mesh as M
+
+
+def _compile_abstract(cfg, strategy, bs=8, seq=4096):
+    """Lower + compile the train step over abstract full-size state;
+    returns (compiled, params_B, mesh)."""
+    mesh = M.mesh_from_strategy(strategy)
+
+    def make_model():
+        paddle_tpu.seed(0)
+        return LlamaForCausalLM(cfg)
+
+    abs_model = jax.eval_shape(make_model)
+    params = sum(int(np.prod(l.shape)) for l in
+                 jax.tree_util.tree_leaves(abs_model)
+                 if hasattr(l, "shape"))
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            abs_model, optimizer=optim.AdamW(3e-4), strategy=strategy,
+            mesh=mesh)
+        abs_state = jax.eval_shape(step.init_state, abs_model)
+        abs_batch = {
+            "input_ids": jax.ShapeDtypeStruct((bs, seq), jnp.int32),
+            "labels": jax.ShapeDtypeStruct((bs, seq), jnp.int32),
+        }
+        compiled = step.compile_abstract(abs_state, abs_batch)
+    return compiled, params / 1e9, mesh
+
+
+def test_llama2_7b_zero3_tp_compiles(devices8):
+    """The 7B north-star config (zero3 x tp2 x dp2, seq 4096) compiles
+    for an 8-device mesh; XLA's memory analysis confirms the state is
+    genuinely sharded (per-device args ~ total/4, far below the 54GB a
+    replicated 7B + fp32 moments would need)."""
+    s = DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 2
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    s.dp_degree = 2
+    compiled, params_b, _ = _compile_abstract(LlamaConfig.llama2_7b(), s)
+    assert 6.5 < params_b < 7.0, params_b
+    ma = compiled.memory_analysis()
+    # bf16 params + fp32 m/v (~10B/param total), sharded 4-way over
+    # fsdp2 x tp2 (dp replicates) -> ~17GB/device, all donated
+    args_gb = ma.argument_size_in_bytes / 1e9
+    assert 12 < args_gb < 22, args_gb
+    assert ma.alias_size_in_bytes / 1e9 > 12   # state donated, not copied
+    assert ma.temp_size_in_bytes / 1e9 < 40    # remat keeps temps bounded
+
+
+def test_llama2_70b_4d_compiles(devices8):
+    """The 70B config compiles under zero3(4) x tp2 — the graph builds
+    and partitions; the reported per-device footprint documents why a
+    real run needs a pod slice (the same specs scale the denominator)."""
+    s = DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 4
+    s.tensor_parallel.enable = True
+    s.tensor_parallel.degree = 2
+    s.dp_degree = 1
+    compiled, params_b, _ = _compile_abstract(LlamaConfig.llama2_70b(), s)
+    assert 65 < params_b < 72, params_b
+    ma = compiled.memory_analysis()
+    # 69B * ~10B/param / 8 shards ~= 86GB/device on this 8-device mesh
+    assert 70 < ma.argument_size_in_bytes / 1e9 < 100
