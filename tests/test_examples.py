@@ -24,6 +24,7 @@ def run_example(name, *args):
     ("qat_mnist_style.py", ("--steps", "10")),
     ("generate_text.py", ()),
     ("serve_model.py", ("--steps", "120")),
+    ("long_context_sp.py", ("--steps", "4", "--seq", "256")),
 ])
 def test_example_runs(script, args):
     proc = run_example(script, *args)
