@@ -14,6 +14,7 @@ Usage: python bench.py [--smoke] [--steps N]
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -44,6 +45,13 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="tiny fast config")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--remat-policy", default=None,
+                    help="override cfg.remat_policy (sweep tool)")
+    ap.add_argument("--lm-head-mode", default=None,
+                    choices=["dense", "fused", "chunked", "auto"],
+                    help="override cfg.lm_head_mode (sweep tool)")
     args = ap.parse_args()
 
     import jax
@@ -66,15 +74,29 @@ def main():
     else:
         # ~1B-param Llama (the largest that fits one v5e chip in bf16 with
         # fp32 AdamW moments). Pallas kernels (flash attention, fused
-        # rms_norm/rope/softmax-xent) dispatch automatically on TPU.
-        # Measured round-2 sweep (this chip): nothing_saveable @953M
-        # mfu=0.52 > dots_saveable @271M mfu=0.32 — the bigger matmuls beat
-        # the recompute cost; dots_saveable OOMs at this size.
+        # rms_norm/rope, fused lm-head⊗xent) dispatch automatically on TPU.
+        # Measured round-4 sweep (this chip): the fused linear⊗xent head
+        # (logits never materialized) frees enough HBM that bs4 +
+        # save_mlp_dots_attn (skip recomputing the mlp gate/up matmuls and
+        # the flash fwd) beats r3's bs8 + nothing_saveable 18.2k vs 17.5k
+        # tok/s (MFU 0.602 vs 0.583); bs8 variants of the partial-save
+        # policies and bs5 still OOM, and the dense head at this config
+        # measures 16.6k (XLA spills near capacity).
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=2048,
-            dtype="bfloat16", remat=True, remat_policy="nothing_saveable")
-        batch, seq = 8, 2048
+            dtype="bfloat16", remat=True, remat_policy="save_mlp_dots_attn",
+            lm_head_mode="fused")
+        batch, seq = 4, 2048
+    if args.batch:
+        batch = args.batch
+    if args.seq:
+        seq = args.seq
+        cfg = dataclasses.replace(cfg, max_seq_len=max(cfg.max_seq_len, seq))
+    if args.remat_policy:
+        cfg = dataclasses.replace(cfg, remat_policy=args.remat_policy)
+    if args.lm_head_mode:
+        cfg = dataclasses.replace(cfg, lm_head_mode=args.lm_head_mode)
 
     paddle_tpu.seed(0)
     model = LlamaForCausalLM(cfg)

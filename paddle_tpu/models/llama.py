@@ -58,6 +58,11 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     remat: bool = True
     remat_policy: str = "nothing_saveable"
+    # LM-head loss path: "dense" (matmul + XLA-fused xent), "fused"
+    # (Pallas linear⊗xent, [B,T,V] logits never materialized — the
+    # memory-bound choice), "chunked", or "auto" (fused when supported
+    # on TPU). See nn.functional.linear_cross_entropy.
+    lm_head_mode: str = "dense"
     # initializer std (llama uses 0.02-ish scaled)
     init_std: float = 0.02
 
@@ -193,7 +198,10 @@ class LlamaMLP(Module):
                            dtype=dtype, key=keys[2], pspec=P("tp", "fsdp"))
 
     def __call__(self, x):
-        return self.down(F.swiglu(self.up(x), self.gate(x)))
+        # tags for the "save_mlp_dots" remat policy (no-op otherwise)
+        up = jax.ad_checkpoint.checkpoint_name(self.up(x), "mlp_up")
+        gate = jax.ad_checkpoint.checkpoint_name(self.gate(x), "mlp_gate")
+        return self.down(F.swiglu(up, gate))
 
 
 class LlamaBlock(Module):
@@ -216,7 +224,8 @@ class LlamaBlock(Module):
         # tag for the "save_attn_out" remat policy (no-op otherwise)
         attn_out = jax.ad_checkpoint.checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
-        x = x + self.mlp(self.mlp_norm(x))
+        x = x + jax.ad_checkpoint.checkpoint_name(
+            self.mlp(self.mlp_norm(x)), "mlp_out")
         return x if new_cache is None else (x, new_cache)
 
 
@@ -329,7 +338,31 @@ class LlamaForCausalLM(Module):
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
         """Next-token cross entropy (labels = input shifted by caller or
-        equal to inputs for standard LM training on packed sequences)."""
+        equal to inputs for standard LM training on packed sequences).
+
+        With ``cfg.lm_head_mode != "dense"`` the head projection fuses
+        into the loss (``F.linear_cross_entropy``) so the [B, T, V]
+        logits never materialize. The loss then runs over all T rows
+        with the labels shifted left and the final position
+        ignore-masked — identical valid-row set (and mean) as the
+        ``logits[:, :-1]`` slice, but the row count stays a multiple of
+        the kernel row block."""
+        mode = getattr(self.config, "lm_head_mode", "dense")
+        if mode != "dense":
+            x = self.embed(input_ids)
+            x = self.blocks(x, training=training)
+            x = self.norm(x)
+            # tied embeddings: the [V, E] table transposes to the [E, V]
+            # kernel layout — one O(V·E) copy per step, still orders of
+            # magnitude below the O(N·V) logits the fusion removes
+            w = (self.lm_head.weight if self.lm_head is not None
+                 else self.embed.weight.T)
+            B = labels.shape[0]
+            lab_shift = jnp.concatenate(
+                [labels[:, 1:],
+                 jnp.full((B, 1), ignore_index, labels.dtype)], axis=1)
+            return F.linear_cross_entropy(
+                x, w, lab_shift, ignore_index=ignore_index, mode=mode)
         logits = self(input_ids, training=training)
         return F.cross_entropy(
             logits[:, :-1].astype(jnp.float32), labels[:, 1:],

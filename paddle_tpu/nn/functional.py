@@ -42,7 +42,8 @@ __all__ = [
     "glu", "swiglu",
     "softmax", "log_softmax", "one_hot", "embedding", "linear",
     "dropout", "layer_norm", "rms_norm", "group_norm", "batch_norm",
-    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "cross_entropy", "softmax_with_cross_entropy", "linear_cross_entropy",
+    "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
     "smooth_l1_loss", "nll_loss", "kl_div", "label_smooth",
     "scaled_dot_product_attention", "rotary_embedding", "apply_rotary",
@@ -386,6 +387,77 @@ def cross_entropy(logits, label, soft_label: bool = False,
     if reduction == "sum":
         return jnp.sum(loss)
     return loss
+
+
+def linear_cross_entropy(hidden, weight, label, ignore_index: int = -100,
+                         reduction: str = "mean", mode: str = "auto"):
+    """LM-head projection fused with softmax cross-entropy:
+    ``cross_entropy(hidden @ weight, label)`` without materializing the
+    [..., V] logits (reference fuses only softmax+xent,
+    ``operators/softmax_with_cross_entropy_op.cu``, and keeps the FC
+    output of the preceding ``mul_op`` resident; at LM vocab sizes that
+    logits tensor dominates activation memory).
+
+    ``hidden`` [..., E], ``weight`` [E, V], int ``label`` [...].
+
+    ``mode``:
+      - ``"fused"``  — Pallas vocab-tiled kernel (``ops/pallas/linear_xent``):
+        O(N) loss-path memory, ~10/6 the matmul FLOPs (both backward
+        kernels recompute their logits tile). Measured on v5e at bench
+        shape (N=16384, E=2048, V=32000, bf16): 66ms vs 41ms fwd+bwd —
+        slower op-level, but removes the ~4 GB logits+dlogits peak.
+      - ``"dense"``  — plain matmul + ``cross_entropy`` (XLA-fused lse).
+      - ``"chunked"``— pure-XLA scan over vocab tiles (same O(N) memory,
+        used off-TPU and as the honest competitor).
+      - ``"auto"``   — fused when supported on TPU, else dense. Choose
+        explicitly in memory-bound configs; dense is faster when the
+        logits fit comfortably.
+    """
+    e = hidden.shape[-1]
+    out_shape = label.shape
+    flat = hidden.reshape(-1, e)
+    lab = label.reshape(-1)
+    n = flat.shape[0]
+
+    loss = None
+    if mode in ("auto", "fused", "chunked"):
+        _pk = _pallas()
+        lmod = None
+        if _pk is not None:
+            from paddle_tpu.ops.pallas import linear_xent as lmod
+        if lmod is not None and mode != "chunked":
+            dmode = _pk._support.dispatch_mode()
+            # row-pad to the kernel block (ignore-masked rows are free:
+            # they select no label and carry a zero cotangent); below one
+            # block the kernel only needs sublane (8) alignment
+            bn = lmod._pick_bn(max(n, 1024), e)
+            target = bn if n >= bn else 8
+            pad = (-n) % target
+            if dmode != "off":
+                flat_p = (jnp.concatenate(
+                    [flat, jnp.zeros((pad, e), flat.dtype)]) if pad else flat)
+                lab_p = (jnp.concatenate(
+                    [lab, jnp.full((pad,), ignore_index, lab.dtype)])
+                    if pad else lab)
+                if lmod.supported(flat_p, weight, lab_p):
+                    loss = lmod.fused_linear_cross_entropy(
+                        flat_p, weight, lab_p,
+                        partitioned=dmode == "partitioned")[:n]
+        if loss is None and lmod is not None and mode in ("chunked",
+                                                         "fused"):
+            loss = lmod.chunked_linear_cross_entropy(flat, weight, lab)
+    if loss is None:
+        logits = (flat @ weight).astype(jnp.float32)
+        loss = softmax_with_cross_entropy(logits, lab,
+                                          ignore_index=ignore_index)
+    valid = lab != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(loss.dtype)), 1.0)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss.reshape(out_shape)
 
 
 def nll_loss(log_probs, label, reduction: str = "mean"):

@@ -40,6 +40,28 @@ REMAT_POLICIES = {
     # an order less than dots_saveable
     "save_attn_out":
         jax.checkpoint_policies.save_only_these_names("attn_out"),
+    # save ONLY the MLP gate/up projections (tagged in the blocks): the
+    # backward skips the two [E, F] matmuls — the FLOPs-densest slice of
+    # the layer recompute — at two [B, T, F] residuals per layer,
+    # several times less memory than full dots_saveable
+    "save_mlp_dots":
+        jax.checkpoint_policies.save_only_these_names("mlp_gate", "mlp_up"),
+    # mlp dots + the attention output: also skips re-running the flash
+    # forward in backward, at one more [B, T, E] residual per layer
+    "save_mlp_dots_attn":
+        jax.checkpoint_policies.save_only_these_names(
+            "mlp_gate", "mlp_up", "attn_out"),
+    # half-memory variant: one [B, T, F] residual per layer (backward
+    # still recomputes the gate matmul)
+    "save_mlp_up_attn":
+        jax.checkpoint_policies.save_only_these_names(
+            "mlp_up", "attn_out"),
+    # everything matmul-shaped: backward recomputes only norms + glu —
+    # the closest to dots_saveable that per-layer [B,T,F]+[B,T,E]
+    # residual budgets allow
+    "save_block_dots":
+        jax.checkpoint_policies.save_only_these_names(
+            "mlp_gate", "mlp_up", "mlp_out", "attn_out"),
 }
 
 

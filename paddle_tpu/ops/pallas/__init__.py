@@ -8,6 +8,9 @@
   (ref ``layer_norm_op.cu``, ``fused/skip_layernorm_op.cu``)
 - ``softmax_cross_entropy`` — fused [N, V] loss, probs never stored
   (ref ``softmax_with_cross_entropy_op.cu``, ``math/softmax.cu``)
+- ``fused_linear_cross_entropy`` — LM-head matmul ⊗ xent, the [N, V]
+  logits never stored (ref fuses only softmax+xent; this also folds the
+  preceding FC — the memory lever at real vocab sizes)
 - ``apply_rotary`` — fused RoPE rotation
 - ``adamw_update`` — fused optimizer update (ref ``optimizers/adam_op.cu``)
 
@@ -21,6 +24,9 @@ from paddle_tpu.ops.pallas.flash_attention import flash_attention
 from paddle_tpu.ops.pallas.norm import layer_norm, rms_norm
 from paddle_tpu.ops.pallas.rope import apply_rotary
 from paddle_tpu.ops.pallas.softmax_xent import softmax_cross_entropy
+from paddle_tpu.ops.pallas.linear_xent import (
+    chunked_linear_cross_entropy, fused_linear_cross_entropy,
+)
 from paddle_tpu.ops.pallas.adamw import adamw_update
 from paddle_tpu.ops.pallas.selective_scan import (
     selective_scan, supported as selective_scan_supported,
@@ -48,7 +54,8 @@ def reset_partition_stats() -> None:
 
 __all__ = [
     "flash_attention", "flash_attention_supported", "rms_norm", "layer_norm",
-    "softmax_cross_entropy", "apply_rotary", "adamw_update",
+    "softmax_cross_entropy", "fused_linear_cross_entropy",
+    "chunked_linear_cross_entropy", "apply_rotary", "adamw_update",
     "selective_scan", "selective_scan_supported",
     "force_interpret", "force_dispatch", "on_tpu", "dispatch_mode",
     "partition_stats", "reset_partition_stats",

@@ -452,6 +452,165 @@ def xent_dx():
 
 
 # ---------------------------------------------------------------------------
+# fused linear ⊗ cross-entropy — (h [n, e], w [e, v]) units
+# ---------------------------------------------------------------------------
+
+def _flce_plan(mesh, h_arg, w_arg):
+    """Rows shard from h dim0; vocab shards from w dim1 (Megatron tp
+    lm-head); the contracted e dim is forced replicated (the partitioner
+    all-gathers a ZeRO-sharded weight, exactly as the dense matmul path
+    would). ctx = (vaxes, vsizes, raxes, use_kernel)."""
+    X = _mod("linear_xent")
+    n, e = h_arg.shape
+    v = w_arg.shape[1]
+    hspec = _spec_entries(_sharding_of(h_arg), 2)
+    wspec = _spec_entries(_sharding_of(w_arg), 2)
+    used: set = set()
+    r = _valid_dim(mesh, hspec[0], n, used)
+    vv = _valid_dim(mesh, wspec[1], v, used)
+    n_local = n // _size(mesh, r) if r is not None else n
+    v_local = v // _size(mesh, vv) if vv is not None else v
+    itemsize = jnp.dtype(w_arg.dtype).itemsize
+    ok = (e % LANES == 0 and n_local % 8 == 0
+          and n_local % X._pick_bn(n_local, e) == 0
+          and X._pick_bv(e, v_local, itemsize) is not None
+          and X._pick_bv(e, v_local, itemsize, for_dw=True) is not None)
+    vsizes = tuple(mesh.shape[a] for a in _axes(vv))
+    return r, vv, (_axes(vv), vsizes, _axes(r), ok)
+
+
+def _flce_shift(lab_b, vaxes, vsizes, v_local):
+    """Global→local label shift for a vocab-sharded weight: subtract this
+    shard's column offset (row-major over the vocab axes). Out-of-range
+    rows (another shard's labels, or an ignore_index) select nothing."""
+    if not vaxes:
+        return lab_b
+    idx = jnp.int32(0)
+    for a, s in zip(vaxes, vsizes):
+        idx = idx * s + jax.lax.axis_index(a)
+    return lab_b - idx * v_local
+
+
+def _flce_fallback_fwd(h, w, lab_local):
+    logits = jnp.dot(h, w, preferred_element_type=jnp.float32)
+    n, v_local = logits.shape
+    lse = jax.nn.logsumexp(logits, axis=1, keepdims=True)
+    lab = lab_local[:, :1]
+    in_range = (lab >= 0) & (lab < v_local)
+    safe = jnp.clip(lab, 0, v_local - 1)
+    sel = jnp.take_along_axis(logits, safe, axis=1)
+    sel = jnp.where(in_range, sel, 0.0)
+    return (jnp.broadcast_to(lse, (n, LANES)),
+            jnp.broadcast_to(sel, (n, LANES)))
+
+
+def _flce_fallback_dlog(h, w, lab_local, lse_b, g_b):
+    logits = jnp.dot(h, w, preferred_element_type=jnp.float32)
+    p = jnp.exp(logits - lse_b[:, :1])
+    v_local = logits.shape[1]
+    col = jnp.arange(v_local, dtype=jnp.int32)[None, :]
+    onehot = (col == lab_local[:, :1]).astype(jnp.float32)
+    return (p - onehot) * g_b[:, :1]
+
+
+@functools.lru_cache(maxsize=None)
+def flce_fwd():
+    """(lse [n, 128], sel [n, 128]) from (h, w, lab). A sharded vocab
+    combines with the standard max/psum log-sum-exp merge; sel is a psum
+    (exactly one shard holds each in-range label)."""
+    X = _mod("linear_xent")
+
+    def fn(ctx, h, w, lab_b):
+        vaxes, vsizes, _, use_kernel = ctx if ctx is not None \
+            else ((), (), (), True)
+        lab_local = _flce_shift(lab_b, vaxes, vsizes, w.shape[1])
+        if use_kernel:
+            stats["flce_fwd:kernel"] += 1
+            lse, sel = X._fwd_call(h, w, lab_local)
+        else:
+            stats["flce_fwd:fallback"] += 1
+            lse, sel = _flce_fallback_fwd(h, w, lab_local)
+        if vaxes:
+            m = jax.lax.pmax(lse, vaxes)
+            lse = m + jnp.log(jax.lax.psum(jnp.exp(lse - m), vaxes))
+            sel = jax.lax.psum(sel, vaxes)
+        return lse, sel
+
+    def plan(mesh, arg_shapes):
+        r, vv, ctx = _flce_plan(mesh, arg_shapes[0], arg_shapes[1])
+        return ((P(r, None), P(None, vv), P(r, None)),
+                (P(r, None), P(r, None)), ctx)
+
+    return _build(fn, plan, "n e, e v, n l -> n l, n l",
+                  need_replication=("e", "l"), reduction=("v",))
+
+
+@functools.lru_cache(maxsize=None)
+def flce_dh():
+    """dHidden [n, e]: each vocab shard contributes its tile-recomputed
+    ``dlogits @ Wᵀ`` partial; psum over the vocab axes."""
+    X = _mod("linear_xent")
+
+    def fn(ctx, h, w, lab_b, lse_b, g_b):
+        vaxes, vsizes, _, use_kernel = ctx if ctx is not None \
+            else ((), (), (), True)
+        lab_local = _flce_shift(lab_b, vaxes, vsizes, w.shape[1])
+        if use_kernel:
+            stats["flce_dh:kernel"] += 1
+            dh = X._dh_call(h, w, lab_local, lse_b, g_b)
+        else:
+            stats["flce_dh:fallback"] += 1
+            dlog = _flce_fallback_dlog(h, w, lab_local, lse_b, g_b)
+            dh = jax.lax.dot_general(
+                dlog.astype(w.dtype), w, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(h.dtype)
+        if vaxes:
+            dh = jax.lax.psum(dh, vaxes)
+        return dh
+
+    def plan(mesh, arg_shapes):
+        r, vv, ctx = _flce_plan(mesh, arg_shapes[0], arg_shapes[1])
+        io = (P(r, None), P(None, vv), P(r, None), P(r, None), P(r, None))
+        return io, (P(r, None),), ctx
+
+    return _build(fn, plan, "n e, e v, n l, n l, n l -> n e",
+                  need_replication=("e", "l"), reduction=("v",))
+
+
+@functools.lru_cache(maxsize=None)
+def flce_dw():
+    """dW [e, v] (weight dtype): vocab-sharded output; row-sharded
+    inputs psum their partials over the row axes (f32 for the combine)."""
+    X = _mod("linear_xent")
+
+    def fn(ctx, h, w, lab_b, lse_b, g_b):
+        vaxes, vsizes, raxes, use_kernel = ctx if ctx is not None \
+            else ((), (), (), True)
+        lab_local = _flce_shift(lab_b, vaxes, vsizes, w.shape[1])
+        if use_kernel:
+            stats["flce_dw:kernel"] += 1
+            dw = X._dw_call(h, w, lab_local, lse_b, g_b)
+        else:
+            stats["flce_dw:fallback"] += 1
+            dlog = _flce_fallback_dlog(h, w, lab_local, lse_b, g_b)
+            dw = jax.lax.dot_general(
+                h, dlog.astype(h.dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(w.dtype)
+        if raxes:
+            dw = jax.lax.psum(dw.astype(jnp.float32),
+                              raxes).astype(w.dtype)
+        return dw
+
+    def plan(mesh, arg_shapes):
+        r, vv, ctx = _flce_plan(mesh, arg_shapes[0], arg_shapes[1])
+        io = (P(r, None), P(None, vv), P(r, None), P(r, None), P(r, None))
+        return io, (P(None, vv),), ctx
+
+    return _build(fn, plan, "n e, e v, n l, n l, n l -> e v",
+                  need_replication=("e", "l"), reduction=("n",))
+
+
+# ---------------------------------------------------------------------------
 # rotary embedding — [B, T, H, D] with [T, D/2] tables
 # ---------------------------------------------------------------------------
 
