@@ -52,7 +52,17 @@ def main():
     ap.add_argument("--lm-head-mode", default=None,
                     choices=["dense", "fused", "chunked", "auto"],
                     help="override cfg.lm_head_mode (sweep tool)")
+    ap.add_argument("--sustained", action="store_true",
+                    help="one long window (>=50 steps, 5-step sync chunks)"
+                         " reporting p50/p95 step time alongside the rate")
+    ap.add_argument("--compare", metavar="SHA", default=None,
+                    help="A/B: run this working tree AND a git worktree of"
+                         " SHA back-to-back (same default config each),"
+                         " print both results + the ratio")
     args = ap.parse_args()
+
+    if args.compare:
+        return run_compare(args)
 
     import jax
     import jax.numpy as jnp
@@ -133,20 +143,47 @@ def main():
         # Best-of-3 windows: the shared tunnel shows ~20% transient
         # run-to-run spread; the fastest window estimates true device
         # throughput (standard min-over-repetitions practice).
-        n_windows = 1 if args.smoke else 3
-        window_dts = []
-        for w in range(n_windows):
-            t0 = time.perf_counter()
-            for i in range(args.steps):
-                state, metrics = step(state, data,
-                                      jax.random.PRNGKey(100 + i))
-            float(metrics["loss"])
-            window_dts.append(time.perf_counter() - t0)
-        dt = min(window_dts)
-        # median alongside the min: the min estimates peak device
-        # throughput through the tunnel's ~20% spread, the median guards
-        # against regressions the min would mask
-        median_dt = sorted(window_dts)[len(window_dts) // 2]
+        p50_step = p95_step = None
+        if args.sustained:
+            # sustained mode (north-star regression protocol): one long
+            # window of >=50 steps synced every 5-step chunk — the
+            # long-window rate can't be flattered by a lucky window, and
+            # the chunk quantiles expose tunnel-transient tails
+            chunk = 5
+            n_chunks = max(10, args.steps // chunk)
+            chunk_dts = []
+            k = 0
+            for _ in range(n_chunks):
+                t0 = time.perf_counter()
+                for _ in range(chunk):
+                    state, metrics = step(state, data,
+                                          jax.random.PRNGKey(100 + k))
+                    k += 1
+                float(metrics["loss"])
+                chunk_dts.append(time.perf_counter() - t0)
+            dt = sum(chunk_dts)
+            median_dt = dt
+            args.steps = n_chunks * chunk
+            steps_sorted = sorted(d / chunk for d in chunk_dts)
+            p50_step = steps_sorted[len(steps_sorted) // 2]
+            p95_step = steps_sorted[
+                min(len(steps_sorted) - 1,
+                    int(round(0.95 * (len(steps_sorted) - 1))))]
+        else:
+            n_windows = 1 if args.smoke else 3
+            window_dts = []
+            for w in range(n_windows):
+                t0 = time.perf_counter()
+                for i in range(args.steps):
+                    state, metrics = step(state, data,
+                                          jax.random.PRNGKey(100 + i))
+                float(metrics["loss"])
+                window_dts.append(time.perf_counter() - t0)
+            dt = min(window_dts)
+            # median alongside the min: the min estimates peak device
+            # throughput through the tunnel's ~20% spread, the median
+            # guards against regressions the min would mask
+            median_dt = sorted(window_dts)[len(window_dts) // 2]
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * args.steps / dt
@@ -157,7 +194,8 @@ def main():
 
     result = {
         "metric": (f"llama-{n_params/1e6:.0f}M bf16 train throughput "
-                   f"(seq={seq}, bs={batch}, "
+                   f"({'sustained, ' if args.sustained else ''}seq={seq}, "
+                   f"bs={batch}, "
                    f"{'zero3' if n_chips > 1 else 'single-chip'}, "
                    f"{getattr(dev, 'device_kind', 'unknown')})"),
         "value": round(tokens_per_sec_chip, 1),
@@ -165,12 +203,86 @@ def main():
         "vs_baseline": round(mfu / A100_CLASS_MFU, 4),
     }
     print(json.dumps(result))
+    extra = ""
+    if p50_step is not None:
+        extra = (f"p50_step={p50_step*1e3:.1f}ms "
+                 f"p95_step={p95_step*1e3:.1f}ms ")
     median_tps = tokens_per_step * args.steps / median_dt / n_chips
     print(f"# mfu={mfu:.3f} steps/sec={args.steps/dt:.3f} "
           f"median_tokens_per_sec_chip={median_tps:.1f} "
-          f"median_mfu={mfu * dt / median_dt:.3f} "
+          f"median_mfu={mfu * dt / median_dt:.3f} {extra}"
           f"loss={float(metrics['loss']):.4f} params={n_params/1e6:.1f}M",
           file=sys.stderr)
+    return result
+
+
+def run_compare(args):
+    """A/B protocol (BASELINE.md: 'never compare across days'): bench the
+    current tree and a detached worktree of --compare SHA back-to-back in
+    the same session, each on its own default headline config, and print
+    one comparison JSON line. The reference's analogue is its op-benchmark
+    regression gate (``tools/check_op_benchmark_result.py``)."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sha = args.compare
+    fwd = ["--steps", str(args.steps), "--warmup", str(args.warmup)]
+    if args.smoke:
+        fwd.append("--smoke")
+    if args.sustained:
+        fwd.append("--sustained")
+    for flag, val in (("--batch", args.batch), ("--seq", args.seq),
+                      ("--remat-policy", args.remat_policy),
+                      ("--lm-head-mode", args.lm_head_mode)):
+        if val:
+            fwd.extend([flag, str(val)])
+
+    def run_one(cwd, label, argv):
+        proc = subprocess.run([sys.executable, os.path.join(cwd, "bench.py"),
+                               *argv], capture_output=True, text=True,
+                              cwd=cwd)
+        if (proc.returncode == 2 and "unrecognized arguments" in proc.stderr
+                and len(argv) > 4):
+            # older SHAs predate the sweep/sustained flags: fall back to
+            # the flags every bench.py revision understands and say so
+            sys.stderr.write(f"# [{label}] does not know "
+                             f"{' '.join(argv[4:])}; re-running with "
+                             "--steps/--warmup only\n")
+            return run_one(cwd, label, argv[:4])
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if line is None:
+            sys.stderr.write(proc.stdout + proc.stderr)
+            raise RuntimeError(f"bench at {label} produced no JSON line")
+        sys.stderr.write(f"# [{label}] {line}\n")
+        for ln in proc.stderr.splitlines():
+            if ln.startswith("#"):
+                sys.stderr.write(f"# [{label}] {ln[1:].strip()}\n")
+        return json.loads(line)
+
+    wt = os.path.join(repo, ".bench_worktrees", sha)
+    created = False
+    if not os.path.isdir(wt):
+        subprocess.run(["git", "worktree", "add", "--detach", wt, sha],
+                       check=True, cwd=repo,
+                       stdout=subprocess.DEVNULL)
+        created = True
+    try:
+        cur = run_one(repo, "HEAD", fwd)
+        old = run_one(wt, sha[:12], fwd)
+    finally:
+        if created:
+            subprocess.run(["git", "worktree", "remove", "--force", wt],
+                           cwd=repo, stdout=subprocess.DEVNULL)
+    ratio = cur["value"] / old["value"] if old["value"] else float("nan")
+    print(json.dumps({
+        "metric": f"A/B {cur['metric']} vs {sha[:12]}",
+        "value": round(ratio, 4),
+        "unit": "x (HEAD tokens/sec over baseline sha, same session)",
+        "vs_baseline": cur["vs_baseline"],
+        "head": cur["value"], "baseline_sha": old["value"],
+    }))
 
 
 if __name__ == "__main__":

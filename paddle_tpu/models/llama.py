@@ -250,10 +250,15 @@ class LlamaForCausalLM(Module):
                                pspec=P("fsdp", "tp")))
         self.config = cfg
 
-    def __call__(self, input_ids, training: bool = False):
+    def hidden_states(self, input_ids, training: bool = False):
+        """Trunk (embed → blocks → final norm) without the head
+        projection — shared by ``__call__`` and the fused-loss path."""
         x = self.embed(input_ids)
         x = self.blocks(x, training=training)
-        x = self.norm(x)
+        return self.norm(x)
+
+    def __call__(self, input_ids, training: bool = False):
+        x = self.hidden_states(input_ids, training=training)
         if self.lm_head is not None:
             return self.lm_head(x)
         return x @ self.embed.weight.T
@@ -349,9 +354,7 @@ class LlamaForCausalLM(Module):
         the kernel row block."""
         mode = getattr(self.config, "lm_head_mode", "dense")
         if mode != "dense":
-            x = self.embed(input_ids)
-            x = self.blocks(x, training=training)
-            x = self.norm(x)
+            x = self.hidden_states(input_ids, training=training)
             # tied embeddings: the [V, E] table transposes to the [E, V]
             # kernel layout — one O(V·E) copy per step, still orders of
             # magnitude below the O(N·V) logits the fusion removes

@@ -413,6 +413,10 @@ def linear_cross_entropy(hidden, weight, label, ignore_index: int = -100,
         explicitly in memory-bound configs; dense is faster when the
         logits fit comfortably.
     """
+    if mode not in ("auto", "fused", "chunked", "dense"):
+        raise ValueError(
+            f"linear_cross_entropy: unknown mode {mode!r} "
+            "(expected 'auto', 'fused', 'chunked' or 'dense')")
     e = hidden.shape[-1]
     out_shape = label.shape
     flat = hidden.reshape(-1, e)

@@ -110,10 +110,44 @@ def test_ignored_rows_have_zero_grad():
     assert float(jnp.max(jnp.abs(g[8:]))) > 0.0
 
 
+def test_row_padding_path():
+    """n = 44 is sublane-misaligned ((-44) % 8 == 4): the dispatch must
+    pad rows, and gradients must flow correctly through the [:n] slice
+    (padded rows are ignore-masked, so they contribute nothing)."""
+    rs = np.random.RandomState(7)
+    n, e, v = 44, 128, 256
+    h = jnp.asarray(rs.randn(n, e).astype(np.float32))
+    w = jnp.asarray(0.1 * rs.randn(e, v).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, v, n).astype(np.int32))
+
+    def loss_f(h, w):
+        return F.linear_cross_entropy(h, w, labels, mode="fused")
+
+    def loss_d(h, w):
+        return F.cross_entropy((h @ w).astype(jnp.float32), labels)
+
+    np.testing.assert_allclose(float(loss_f(h, w)), float(loss_d(h, w)),
+                               rtol=1e-5)
+    gf = jax.grad(loss_f, (0, 1))(h, w)
+    gd = jax.grad(loss_d, (0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gd[0]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gd[1]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_unknown_mode_raises():
+    h = jnp.zeros((8, 128), jnp.float32)
+    w = jnp.zeros((128, 256), jnp.float32)
+    lab = jnp.zeros((8,), jnp.int32)
+    with pytest.raises(ValueError, match="unknown mode"):
+        F.linear_cross_entropy(h, w, lab, mode="Fused")
+
+
 @pytest.mark.parametrize("mode", ["fused", "chunked", "dense"])
 def test_functional_modes_agree(mode):
     rs = np.random.RandomState(3)
-    b, t, e, v = 2, 20, 128, 256   # b·t = 40: exercises the row padding
+    b, t, e, v = 2, 20, 128, 256
     h = jnp.asarray(rs.randn(b, t, e).astype(np.float32))
     w = jnp.asarray(0.1 * rs.randn(e, v).astype(np.float32))
     labels = jnp.asarray(rs.randint(0, v, (b, t)).astype(np.int32))
