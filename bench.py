@@ -245,10 +245,12 @@ def run_compare(args):
         if (proc.returncode == 2 and "unrecognized arguments" in proc.stderr
                 and len(argv) > 4):
             # older SHAs predate the sweep/sustained flags: fall back to
-            # the flags every bench.py revision understands and say so
+            # the flags every bench.py revision understands; the caller
+            # re-runs HEAD on the SAME reduced flags so the ratio never
+            # mixes estimators/configs
             sys.stderr.write(f"# [{label}] does not know "
-                             f"{' '.join(argv[4:])}; re-running with "
-                             "--steps/--warmup only\n")
+                             f"{' '.join(argv[4:])}; falling back to "
+                             "--steps/--warmup only for BOTH sides\n")
             return run_one(cwd, label, argv[:4])
         line = next((ln for ln in reversed(proc.stdout.splitlines())
                      if ln.startswith("{")), None)
@@ -259,7 +261,7 @@ def run_compare(args):
         for ln in proc.stderr.splitlines():
             if ln.startswith("#"):
                 sys.stderr.write(f"# [{label}] {ln[1:].strip()}\n")
-        return json.loads(line)
+        return json.loads(line), argv
 
     wt = os.path.join(repo, ".bench_worktrees", sha)
     created = False
@@ -269,8 +271,10 @@ def run_compare(args):
                        stdout=subprocess.DEVNULL)
         created = True
     try:
-        cur = run_one(repo, "HEAD", fwd)
-        old = run_one(wt, sha[:12], fwd)
+        # baseline first: if it falls back to the common flag set, HEAD
+        # must run the identical protocol for the ratio to mean anything
+        old, used = run_one(wt, sha[:12], fwd)
+        cur, _ = run_one(repo, "HEAD", used)
     finally:
         if created:
             subprocess.run(["git", "worktree", "remove", "--force", wt],

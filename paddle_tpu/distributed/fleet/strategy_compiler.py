@@ -61,10 +61,12 @@ def _apply_pipeline_override(model, strategy: DistributedStrategy, mesh):
 
     S = strategy.pipeline.degree
     M = max(strategy.pipeline.num_microbatches, 1)
+    sp = strategy.sequence_parallel
+    seq_axis = "sp" if (sp.enable and sp.degree > 1) else None
 
     def fn(m):
         if isinstance(m, ScannedBlocks):
-            return pipeline_blocks(m, S, M, mesh=mesh)
+            return pipeline_blocks(m, S, M, mesh=mesh, seq_axis=seq_axis)
         return m
 
     return map_modules(fn, model)
@@ -141,33 +143,18 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             f"pipeline.schedule={pp_cfg.schedule!r}: only 'gpipe' and "
             "'1f1b' are implemented")
     use_1f1b = use_pp and pp_cfg.schedule == "1f1b"
-    if use_pp and (strategy.sequence_parallel.enable
-                   and strategy.sequence_parallel.degree > 1):
-        if strategy.sequence_parallel.mode == "ulysses":
-            # Re-probed r3: a *minimal* nested pp/ulysses shard_map now
-            # compiles, but the full pipelined train step (all_to_all
-            # inside the tick scan, under grad) still hard-aborts the
-            # process inside XLA ("Fatal Python error: Aborted") — keep
-            # the gate until the compiler handles it.
-            raise NotImplementedError(
-                "pipeline + Ulysses sequence parallelism: the nested "
-                "all_to_all aborts inside the XLA compiler today — use "
-                "sequence_parallel.mode='ring' with pipelines (parity-"
-                "tested), or Ulysses without pp")
-        # pp∘sp nests a shard_map (ring attention) inside a manual
-        # computation (the pipeline); the Shardy partitioner cannot lower
-        # nested manual axes yet — this step compiles under GSPMD instead.
-        # Scoped per-call (not a global flip): a sticky global would break
-        # *other* steps, e.g. plain-sp grads abort under GSPMD on CPU.
-        # (Tracked upstream; revisit when sdy supports nesting.)
-        use_gspmd = True
-    elif (use_pp and pp_cfg.schedule == "1f1b" and strategy.amp.enable):
-        # amp casts inside the 1F1B shard_map trip a Shardy lowering crash
-        # ("Invalid binary instruction opcode copy") — same scoped GSPMD
-        # fallback
-        use_gspmd = True
-    else:
-        use_gspmd = False
+    # pp∘sp composition: the pipeline shard_maps run manual over
+    # {pp, sp} and ring/Ulysses attention rides the already-manual sp
+    # axis directly — r3's scoped-GSPMD fallback and the pp∘Ulysses gate
+    # existed because the *nested* shard_map formulation crashes Shardy
+    # ("axis already bound by a parent sdy.manual_computation",
+    # tests/repros/shardy_nested_manual_sp.py) and, for Ulysses, aborted
+    # XLA outright; the joint-manual formulation needs neither. (The r3
+    # 1F1B∘AMP Shardy crash "Invalid binary instruction opcode copy" no
+    # longer reproduces on jax 0.9.0 — its fallback is retired too.)
+    pp_seq_axis = ("sp" if (use_pp and strategy.sequence_parallel.enable
+                            and strategy.sequence_parallel.degree > 1)
+                   else None)
     if use_1f1b:
         if loss_fn is not None:
             raise ValueError(
@@ -293,7 +280,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                 # the fp32 accumulation and could overflow scaled fp16)
                 return pipeline_1f1b.loss_and_grads(
                     m, batch, mesh, key=key, cotangent_scale=cot_scale,
-                    keep_fp32_grads=amp_enabled)
+                    keep_fp32_grads=amp_enabled, seq_axis=pp_seq_axis)
 
             with RecordEvent("forward_backward"):
                 if amp_enabled:
@@ -427,7 +414,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
 
     return CompiledTrainStep(step_fn, optimizer, scaler, mesh, param_specs,
                              state_specs, _data_spec, k_steps, donate,
-                             _prepare, use_gspmd=use_gspmd)
+                             _prepare)
 
 
 class CompiledTrainStep:
@@ -435,7 +422,7 @@ class CompiledTrainStep:
 
     def __init__(self, step_fn, optimizer, scaler, mesh, param_specs,
                  state_specs_fn, data_spec_fn, k_steps, donate,
-                 prepare_model=lambda m: m, use_gspmd: bool = False):
+                 prepare_model=lambda m: m):
         self._step_fn = step_fn
         self._optimizer = optimizer
         self._scaler = scaler
@@ -446,7 +433,6 @@ class CompiledTrainStep:
         self._k_steps = k_steps
         self._donate = donate
         self._prepare_model = prepare_model
-        self._use_gspmd = use_gspmd
         self._jitted = None
 
     @property
@@ -500,19 +486,11 @@ class CompiledTrainStep:
         """AOT-compile the train step over abstract (ShapeDtypeStruct)
         state/batch — full-size flagship configs compile and report XLA
         memory analysis without materializing any weights. Uses the SAME
-        jit wiring (shardings, donation, partitioner scoping) as
-        ``__call__``."""
+        jit wiring (shardings, donation) as ``__call__``."""
         if key is None:
             key = jax.ShapeDtypeStruct((2,), jnp.uint32)
         lowered = self._build_jit(abstract_state, abstract_batch).lower(
             abstract_state, abstract_batch, key)
-        if self._use_gspmd:
-            prev = jax.config.jax_use_shardy_partitioner
-            jax.config.update("jax_use_shardy_partitioner", False)
-            try:
-                return lowered.compile()
-            finally:
-                jax.config.update("jax_use_shardy_partitioner", prev)
         return lowered.compile()
 
     def __call__(self, state: TrainState, batch, key=None):
@@ -520,18 +498,7 @@ class CompiledTrainStep:
             key = rng.next_key()
         if self._jitted is None:
             self._jitted = self._build_jit(state, batch)
-        if self._use_gspmd:
-            # scoped partitioner switch: compile (first call) happens under
-            # GSPMD, restore immediately — the cached executable keeps its
-            # partitioning; other steps keep Shardy
-            prev = jax.config.jax_use_shardy_partitioner
-            jax.config.update("jax_use_shardy_partitioner", False)
-            try:
-                new_state, metrics = self._jitted(state, batch, key)
-            finally:
-                jax.config.update("jax_use_shardy_partitioner", prev)
-        else:
-            new_state, metrics = self._jitted(state, batch, key)
+        new_state, metrics = self._jitted(state, batch, key)
         if "check/grads_finite" in metrics:
             bad = [name for name in ("loss", "grads", "params")
                    if not bool(metrics[f"check/{name}_finite"])]
@@ -550,11 +517,21 @@ class CompiledTrainStep:
 
     def eval_step(self, model, batch, eval_fn):
         """Jitted eval helper (no grad, eval mode). The jit wrapper is
-        cached per eval_fn so repeated eval batches reuse the executable."""
+        cached per eval_fn — keyed on the function object itself (a
+        strong reference), never on ``id()``: an id can be reused by a
+        new function after the old one is collected, which would silently
+        serve the stale executable. Bounded LRU (a fresh closure per call
+        would otherwise grow the cache for the step's lifetime)."""
+        import collections
+
         if not hasattr(self, "_eval_cache"):
-            self._eval_cache = {}
-        jitted = self._eval_cache.get(id(eval_fn))
+            self._eval_cache = collections.OrderedDict()
+        jitted = self._eval_cache.get(eval_fn)
         if jitted is None:
             jitted = jax.jit(eval_fn)
-            self._eval_cache[id(eval_fn)] = jitted
+            self._eval_cache[eval_fn] = jitted
+            while len(self._eval_cache) > 8:
+                self._eval_cache.popitem(last=False)
+        else:
+            self._eval_cache.move_to_end(eval_fn)
         return jitted(model, batch)

@@ -129,10 +129,11 @@ class GPTForCausalLM(Module):
         head = (self.ln_f, self.lm_head)
 
         def head_loss_sum(head, h, labels):
+            # labels arrive next-token-shifted from the schedule (see
+            # llama.pipeline_parts): full-row loss, sp-boundary safe
             ln_f, lm_head = head
             logits = lm_head(ln_f(h)).astype(jnp.float32)
-            return F.cross_entropy(logits[:, :-1], labels[:, 1:],
-                                   reduction="sum")
+            return F.cross_entropy(logits, labels, reduction="sum")
 
         from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom \
             as loss_denom

@@ -139,7 +139,10 @@ class LlamaAttention(Module):
         k = self.wk(x).reshape(B, T, self.num_kv_heads, self.head_dim)
         v = self.wv(x).reshape(B, T, self.num_kv_heads, self.head_dim)
         if positions is None:
-            positions = jnp.arange(T)
+            # inside a manual-sp region (pipeline∘sp) the local T is one
+            # sequence slice: RoPE must rotate by absolute positions
+            from paddle_tpu.parallel.ring_attention import global_positions
+            positions = global_positions(T)
             if index is not None:
                 positions = positions + index
         cos, sin = F.rotary_embedding(positions, self.head_dim,
@@ -275,17 +278,20 @@ class LlamaForCausalLM(Module):
                 else (self.norm, self.lm_head))
 
         def head_loss_sum(head, h, labels):
-            """SUM of per-token losses for one microbatch (the pipeline
-            divides by the global valid count, so uneven ignore_index
-            distributions across microbatches stay exactly equivalent to
-            the full-batch mean of ``model.loss``)."""
+            """SUM of per-token losses for one microbatch. ``labels`` are
+            ALREADY next-token-shifted (and trailing-ignore-masked) by
+            the schedule — full-row loss here; a head-local shift would
+            drop the prediction at every sequence-parallel shard
+            boundary. The pipeline divides by the global valid count, so
+            uneven ignore_index distributions across microbatches/shards
+            stay exactly equivalent to the full-batch mean of
+            ``model.loss``."""
             norm, out = head
             if tied:
                 logits = (norm(h) @ out.T).astype(jnp.float32)
             else:
                 logits = out(norm(h)).astype(jnp.float32)
-            return F.cross_entropy(logits[:, :-1], labels[:, 1:],
-                                   reduction="sum")
+            return F.cross_entropy(logits, labels, reduction="sum")
 
         from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom \
             as loss_denom

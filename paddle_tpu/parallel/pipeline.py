@@ -55,7 +55,8 @@ class PipelinedBlocks(Module):
 
     def __init__(self, block, n_layers: int, num_stages: int,
                  num_microbatches: int, *, remat: bool = False,
-                 remat_policy: str = "nothing_saveable", mesh=None):
+                 remat_policy: str = "nothing_saveable", mesh=None,
+                 seq_axis: str | None = None):
         if n_layers % num_stages:
             raise ValueError(
                 f"n_layers={n_layers} not divisible by pp={num_stages}")
@@ -66,6 +67,13 @@ class PipelinedBlocks(Module):
         self.remat = bool(remat)
         self.remat_policy = remat_policy
         self.mesh = mesh
+        # sequence-parallel composition: the schedule's shard_map runs
+        # manual over {pp, seq_axis} so ring/Ulysses attention inside the
+        # stages uses the already-manual axis directly — a *nested*
+        # shard_map is rejected by Shardy ("axis already bound by a
+        # parent sdy.manual_computation";
+        # tests/repros/shardy_nested_manual_sp.py)
+        self.seq_axis = seq_axis
         self._spec_prefix = ("pp",)
 
     def __call__(self, x, training: bool = False):
@@ -106,10 +114,19 @@ class PipelinedBlocks(Module):
 
         def pp_body(block, x_mb):
             r = lax.axis_index("pp")
+            rank_key = None
+            if base_key is not None:
+                rank_key = jax.random.fold_in(base_key, r)
+                if self.seq_axis and mesh.shape.get(self.seq_axis, 1) > 1:
+                    # distinct dropout streams per sequence shard — the
+                    # same pp-rank key on every sp shard would draw
+                    # correlated masks across sequence slices
+                    rank_key = jax.random.fold_in(
+                        rank_key, lax.axis_index(self.seq_axis))
             state = jnp.zeros_like(x_mb[0])
             outs = jnp.zeros_like(x_mb)
             tick_keys = (jax.random.split(
-                jax.random.fold_in(base_key, r), n_ticks * L_local
+                rank_key, n_ticks * L_local
             ).reshape(n_ticks, L_local, -1) if base_key is not None else None)
 
             def tick(carry, t_and_keys):
@@ -137,11 +154,19 @@ class PipelinedBlocks(Module):
             # can run replicated/tp-sharded outside
             return C.broadcast(outs, src=S - 1, axis="pp")
 
+        axes = {"pp"}
+        x_spec = jax.sharding.PartitionSpec()
+        if self.seq_axis and mesh.shape.get(self.seq_axis, 1) > 1:
+            axes.add(self.seq_axis)
+            # [M, B/M, T, E]: the sequence dim sharded — each shard runs
+            # the schedule on its slice; attention modules bridge shards
+            # via ring/all_to_all collectives on the manual axis
+            x_spec = jax.sharding.PartitionSpec(
+                None, None, self.seq_axis, None)
         out = jax.shard_map(
-            pp_body, mesh=mesh, axis_names={"pp"},
-            in_specs=(jax.sharding.PartitionSpec("pp"),
-                      jax.sharding.PartitionSpec()),
-            out_specs=jax.sharding.PartitionSpec(),
+            pp_body, mesh=mesh, axis_names=axes,
+            in_specs=(jax.sharding.PartitionSpec("pp"), x_spec),
+            out_specs=x_spec,
             check_vma=False,
         )(self.block, x_mb)
         return out.reshape(B, T, E)
@@ -151,9 +176,11 @@ class PipelinedBlocks(Module):
 
 
 def pipeline_blocks(scanned: ScannedBlocks, num_stages: int,
-                    num_microbatches: int, mesh=None) -> PipelinedBlocks:
+                    num_microbatches: int, mesh=None,
+                    seq_axis: str | None = None) -> PipelinedBlocks:
     """Convert a ScannedBlocks (same stacked arrays, zero copy) into the
     pipelined executor — the strategy compiler's PipelineOptimizer move."""
     return PipelinedBlocks(
         scanned.block, scanned.n_layers, num_stages, num_microbatches,
-        remat=scanned.remat, remat_policy=scanned.remat_policy, mesh=mesh)
+        remat=scanned.remat, remat_policy=scanned.remat_policy, mesh=mesh,
+        seq_axis=seq_axis)

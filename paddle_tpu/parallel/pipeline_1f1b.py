@@ -69,10 +69,19 @@ def ring_buffer_slots(num_stages: int, num_microbatches: int) -> int:
 
 def loss_and_grads(model, batch, mesh, *, training: bool = True,
                    key=None, cotangent_scale=None,
-                   keep_fp32_grads: bool = False):
+                   keep_fp32_grads: bool = False,
+                   seq_axis: str | None = None):
     """Compute (loss, grads) for a pipeline-decomposable model under the
     1F1B schedule. ``model.blocks`` must already be the pipelined
     executor (strategy compiler applies the override first).
+
+    Labels are shifted next-token style HERE, globally (position ``t``
+    gets label ``t+1``; the final position is ignore-masked) — so
+    ``head_loss_fn(head, h, labels)`` receives labels aligned with
+    ``h``'s own positions and computes a full-row loss sum. Shifting
+    centrally is what makes sequence-parallel composition correct: with
+    the sequence sharded over ``seq_axis`` a head-local shift would lose
+    the prediction at every shard boundary.
 
     ``key``: dropout RNG; per-layer streams are derived from
     (stage, microbatch, layer) so the backward recompute replays the
@@ -82,20 +91,32 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
     accumulators instead of downcasting to the parameter dtype — set it
     when the caller maintains fp32 master weights (the AMP path), so the
     accumulated precision isn't rounded away (and a scaled-fp16 sum
-    can't overflow on the way out).
+    can't overflow on the way out). ``seq_axis``: run the schedule
+    manual over {pp, seq_axis} with the sequence dim sharded — ring /
+    Ulysses attention inside the stages then rides the already-manual
+    axis (Shardy rejects a nested shard_map:
+    tests/repros/shardy_nested_manual_sp.py).
     """
     (embed, pblocks, head, head_loss_fn, loss_denom,
      assemble) = model.pipeline_parts()
     S = pblocks.num_stages
     M = pblocks.num_microbatches
     ids, labels = batch["input_ids"], batch["labels"]
-    # head_loss_fn returns per-microbatch SUMS; dividing by the global
+    # next-token shift, global (see docstring); head_loss_fn returns
+    # per-microbatch SUMS over its rows; dividing by the global
     # valid-token count keeps loss/grads identical to the full-batch mean
     # even when ignore_index tokens are distributed unevenly across
-    # microbatches
+    # microbatches (or sequence shards)
+    # -100 is the contract's fixed ignore value (heads call cross_entropy
+    # with its default, default_loss_denom counts against it)
+    labels = jnp.concatenate(
+        [labels[:, 1:],
+         jnp.full((labels.shape[0], 1), -100, labels.dtype)],
+        axis=1)
     inv_denom = 1.0 / loss_denom(labels)
     if cotangent_scale is None:
         cotangent_scale = jnp.ones((), jnp.float32)
+    sp_on = bool(seq_axis) and mesh.shape.get(seq_axis, 1) > 1
 
     def embed_call(e):
         if key is not None:
@@ -126,6 +147,11 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
         # microbatch — tick-keyed streams would NOT replay
         stage_key = (jax.random.fold_in(key, r) if key is not None
                      else None)
+        if stage_key is not None and sp_on:
+            # distinct streams per sequence shard (correlated masks
+            # across sequence slices otherwise)
+            stage_key = jax.random.fold_in(stage_key,
+                                           lax.axis_index(seq_axis))
 
         def stage_fwd(blk, h, mb_idx):
             keys = (jax.random.split(
@@ -236,16 +262,31 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
         (h_saved, gblk, ghead, dx_mb, _, _, loss_acc), _ = lax.scan(
             tick, init, jnp.arange(N))
         # loss/dhead/dx live on specific stages; psum replicates (others
-        # contribute zeros)
-        loss = lax.psum(loss_acc, "pp")
-        ghead = jax.tree_util.tree_map(lambda g: lax.psum(g, "pp"), ghead)
+        # contribute zeros). Under manual sp every shard additionally
+        # holds a per-sequence-slice PARTIAL: loss and the head/block
+        # param grads sum over the sequence axis too; dx stays sharded
+        # (each shard owns its sequence slice of the cotangent).
+        loss_axes = ("pp", seq_axis) if sp_on else "pp"
+        loss = lax.psum(loss_acc, loss_axes)
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, loss_axes), ghead)
+        if sp_on:
+            gblk = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, seq_axis), gblk)
         dx_mb = lax.psum(dx_mb, "pp")
         return loss, gblk, ghead, dx_mb
 
+    axes = {"pp"}
+    seq_spec = P()
+    lab_spec = P()
+    if sp_on:
+        axes.add(seq_axis)
+        seq_spec = P(None, None, seq_axis, None)   # [M, B/M, T, E]
+        lab_spec = P(None, None, seq_axis)         # [M, B/M, T]
     loss, gblk, ghead, dx_mb = jax.shard_map(
-        pp_body, mesh=mesh, axis_names={"pp"},
-        in_specs=(P("pp"), P(), P(), P(), P(), P()),
-        out_specs=(P(), P("pp"), P(), P()),
+        pp_body, mesh=mesh, axis_names=axes,
+        in_specs=(P("pp"), P(), seq_spec, lab_spec, P(), P()),
+        out_specs=(P(), P("pp"), P(), seq_spec),
         check_vma=False,
     )(block, head, x_mb, labels_mb, jnp.asarray(inv_denom, jnp.float32),
       jnp.asarray(cotangent_scale, jnp.float32))
@@ -264,12 +305,14 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
 
 
 def default_loss_denom(labels, ignore_index: int = -100):
-    """Global valid-next-token count for shifted-label LM losses — the
-    shared denominator every ``pipeline_parts`` head uses so uneven
-    ignore_index distributions across microbatches stay exactly
-    equivalent to the full-batch mean loss."""
+    """Global valid-token count — the shared denominator every
+    ``pipeline_parts`` head uses so uneven ignore_index distributions
+    across microbatches (or sequence shards) stay exactly equivalent to
+    the full-batch mean loss. Receives the ALREADY-SHIFTED labels
+    (``loss_and_grads`` shifts next-token style and ignore-masks the
+    final position), so every position counts itself."""
     return jnp.maximum(
-        jnp.sum((labels[:, 1:] != ignore_index).astype(jnp.float32)), 1.0)
+        jnp.sum((labels != ignore_index).astype(jnp.float32)), 1.0)
 
 
 def _acc_zeros(p):

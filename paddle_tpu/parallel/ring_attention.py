@@ -30,7 +30,29 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["ring_attention", "ulysses_attention", "ring_self_attention",
-           "ulysses_self_attention"]
+           "ulysses_self_attention", "global_positions"]
+
+
+def global_positions(t_local: int, axis: str = "sp"):
+    """Absolute sequence positions for a [.., T_local, ..] activation.
+
+    Outside any manual region (or when ``axis`` is absent/automatic) the
+    local view IS the global sequence: plain ``arange``. Inside a
+    computation that is *manual* over ``axis`` (the pipeline shard_maps
+    run manual over {pp, sp} so ring/Ulysses need no nested shard_map —
+    Shardy rejects nested manual computations, see
+    tests/repros/shardy_nested_manual_sp.py) each shard holds the
+    ``axis_index``-th sequence slice, so positions offset by rank —
+    RoPE and other position encodings stay globally correct."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        am = None
+    if am is not None and am.shape and axis in am.shape:
+        types = dict(zip(am.axis_names, am.axis_types))
+        if types[axis] == jax.sharding.AxisType.Manual:
+            return lax.axis_index(axis) * t_local + jnp.arange(t_local)
+    return jnp.arange(t_local)
 
 
 def _repeat_kv(q, k, v):

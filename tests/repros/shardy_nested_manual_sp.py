@@ -1,0 +1,75 @@
+"""Minimal repro: Shardy rejects a shard_map nested inside another
+manual computation over a different axis.
+
+This is the upstream limitation that shaped the pp∘sp design
+(paddle_tpu.parallel.pipeline / pipeline_1f1b run manual over
+{pp, sp} jointly, and ring/Ulysses attention uses the already-manual
+axis instead of nesting a shard_map).
+
+Observed on jax 0.9.0 (CPU, 4 virtual devices):
+
+    ValueError: Cannot lower jaxpr with verifier errors:
+      'sdy.manual_computation' op operates on axis "pp" which is
+      already bound by a parent sdy.manual_computation op
+
+The same program lowers fine under GSPMD
+(jax_use_shardy_partitioner=False) — r3 shipped that as a scoped
+fallback; r4 removed the nesting instead. Two other r3 gates no longer
+reproduce on jax 0.9.0 and were retired outright:
+- 1F1B∘AMP under Shardy ("Invalid binary instruction opcode copy");
+- pp∘Ulysses ("Fatal Python error: Aborted" from a nested all_to_all
+  inside the tick scan under grad) — with the joint-manual formulation
+  the all_to_all is not nested and compiles under both partitioners.
+
+Run: python tests/repros/shardy_nested_manual_sp.py
+Exit status 0 means the upstream limitation still reproduces (or that
+nesting now works — a message says which; if nesting works, the nested
+formulation could simplify pipeline.py again).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+
+def main():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "sp"))
+
+    def inner(x):  # wants to run per-sp-shard inside the pp body
+        return x + jax.lax.axis_index("sp")
+
+    def pp_body(x):
+        am = jax.sharding.get_abstract_mesh()
+        nested = jax.shard_map(inner, mesh=am, axis_names={"sp"},
+                               in_specs=P("sp"), out_specs=P("sp"),
+                               check_vma=False)
+        return nested(x) + jax.lax.axis_index("pp")
+
+    f = jax.jit(jax.shard_map(pp_body, mesh=mesh, axis_names={"pp"},
+                              in_specs=P("pp"), out_specs=P("pp"),
+                              check_vma=False))
+    x = jnp.zeros((4, 4), jnp.float32)
+    try:
+        f(x).block_until_ready()
+    except ValueError as e:
+        assert "already bound by a parent" in str(e), e
+        print("reproduced: Shardy rejects the nested manual computation\n"
+              f"  {type(e).__name__}: {str(e)[:160]}")
+        return
+    print("nesting now lowers under Shardy — the joint-manual pp∘sp "
+          "formulation in parallel/pipeline*.py could be simplified back "
+          "to nested shard_maps")
+
+
+if __name__ == "__main__":
+    main()

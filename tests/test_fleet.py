@@ -480,11 +480,11 @@ def test_pipeline_composes_with_ring_attention(devices8):
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
 
 
-def test_pipeline_ulysses_still_gated(devices8):
-    """pp + Ulysses remains a clear NotImplementedError: the full
-    pipelined step's nested all_to_all still hard-aborts inside XLA
-    (re-probed r3 — a minimal nested case compiles, the tick-scan +
-    grad structure does not)."""
+def test_pipeline_ulysses_accepted(devices8):
+    """pp + Ulysses builds (r4): the joint-manual {pp, sp} formulation
+    removed the nested all_to_all that aborted XLA, so the r3 gate is
+    retired. Loss parity vs DP is covered by
+    test_seq_parallel.py::test_fleet_pp_seq_parallel_matches_dp."""
     s = DistributedStrategy()
     s.pipeline.enable = True
     s.pipeline.degree = 2
@@ -495,9 +495,9 @@ def test_pipeline_ulysses_still_gated(devices8):
     mesh = M.mesh_from_strategy(s)
     model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
     with M.MeshContext(mesh):
-        with pytest.raises(NotImplementedError, match="Ulysses"):
-            dist.fleet.build_train_step(model, optimizer=optim.SGD(1e-2),
-                                        strategy=s, mesh=mesh)
+        step = dist.fleet.build_train_step(model, optimizer=optim.SGD(1e-2),
+                                           strategy=s, mesh=mesh)
+    assert step is not None
 
 
 def test_ernie_pretraining_trains_hybrid(devices8):
@@ -585,20 +585,3 @@ def test_strategy_json_roundtrip_all_configs():
     assert s2.expert_parallel.degree == 8
     assert s2.pipeline.schedule == "1f1b"
     assert s2.parallel_degrees() == s.parallel_degrees()
-
-
-def test_pipeline_rejects_ulysses(devices8):
-    """pp + Ulysses aborts inside the XLA compiler (nested all_to_all);
-    the strategy compiler must refuse it loudly and point at ring mode."""
-    s = DistributedStrategy()
-    s.pipeline.enable = True
-    s.pipeline.degree = 2
-    s.sequence_parallel.enable = True
-    s.sequence_parallel.degree = 2
-    s.sequence_parallel.mode = "ulysses"
-    mesh = M.mesh_from_strategy(s)
-    model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
-    with M.MeshContext(mesh):
-        with pytest.raises(NotImplementedError, match="ring"):
-            dist.fleet.build_train_step(
-                model, optimizer=optim.AdamW(1e-3), strategy=s, mesh=mesh)
