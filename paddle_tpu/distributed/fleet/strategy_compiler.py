@@ -155,12 +155,33 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     pp_seq_axis = ("sp" if (use_pp and strategy.sequence_parallel.enable
                             and strategy.sequence_parallel.degree > 1)
                    else None)
+    pipe_head_loss = pipe_loss_denom = None
+    if (loss_fn is not None
+            and getattr(loss_fn, "_pipeline_head_loss", False)
+            and not use_1f1b):
+        raise ValueError(
+            "loss_fn is marked with pipeline_1f1b.head_loss (signature "
+            "fn(head, h, labels)) — that contract only applies to "
+            "pipeline.schedule='1f1b'; pass a generic "
+            "loss_fn(model, batch) for other strategies")
     if use_1f1b:
         if loss_fn is not None:
-            raise ValueError(
-                "1f1b computes the loss per-microbatch on the last stage "
-                "via model.pipeline_parts(); a custom loss_fn cannot be "
-                "honored — encode the loss in pipeline_parts instead")
+            if getattr(loss_fn, "_pipeline_head_loss", False):
+                # custom per-microbatch head loss (the arbitrary section
+                # program of section_worker.cc:44): runs on the last
+                # stage in place of pipeline_parts' default
+                pipe_head_loss = loss_fn
+                pipe_loss_denom = getattr(loss_fn, "_pipeline_denom",
+                                          None)
+                loss_fn = None
+            else:
+                raise ValueError(
+                    "1f1b computes the loss per-microbatch on the last "
+                    "stage; a generic loss_fn(model, batch) cannot be "
+                    "scheduled. Mark a per-microbatch head loss with "
+                    "paddle_tpu.parallel.pipeline_1f1b.head_loss("
+                    "fn(head, h, labels) -> sum) or encode the loss in "
+                    "model.pipeline_parts()")
         if not hasattr(model, "pipeline_parts"):
             raise ValueError(
                 f"pipeline.schedule='1f1b' needs "
@@ -267,9 +288,9 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             # streams from `key` so the backward's recompute replays the
             # forward's masks; AMP rides a jax.vjp through cast_model
             # (grads land on the fp32 masters) and fp16 loss scaling
-            # multiplies the backward seed. No state tape on this path
-            # (stateful layers inside pipelined blocks are not supported
-            # by the manual schedule).
+            # multiplies the backward seed. Stateful layers inside the
+            # pipelined blocks ride the returned tape (per-microbatch
+            # updates averaged inside the tick scan).
             from paddle_tpu.parallel import pipeline_1f1b
 
             cot_scale = (state.scaler.loss_scaling if use_scaler else None)
@@ -280,7 +301,9 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                 # the fp32 accumulation and could overflow scaled fp16)
                 return pipeline_1f1b.loss_and_grads(
                     m, batch, mesh, key=key, cotangent_scale=cot_scale,
-                    keep_fp32_grads=amp_enabled, seq_axis=pp_seq_axis)
+                    keep_fp32_grads=amp_enabled, seq_axis=pp_seq_axis,
+                    head_loss_fn=pipe_head_loss,
+                    loss_denom_fn=pipe_loss_denom)
 
             with RecordEvent("forward_backward"):
                 if amp_enabled:
@@ -293,7 +316,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                             enable=True, dtype=str(amp_dtype),
                             custom_white_list=amp_cfg.custom_white_list,
                             custom_black_list=amp_cfg.custom_black_list):
-                        loss, grads_c = pipe_loss_grads(
+                        loss, grads_c, tape = pipe_loss_grads(
                             amp_mod.cast_model(
                                 model, amp_dtype,
                                 keep_norms_fp32=amp_cfg.keep_norms_fp32))
@@ -302,8 +325,7 @@ def build_train_step(model, optimizer, loss_fn=None, *,
                                       if hasattr(p, "dtype") else g),
                         grads_c, model)
                 else:
-                    loss, grads = pipe_loss_grads(model)
-            tape = {}
+                    loss, grads, tape = pipe_loss_grads(model)
             grads, all_finite = (scaler.unscale(grads, state.scaler)
                                  if use_scaler else
                                  (grads, jnp.asarray(True)))
@@ -381,7 +403,14 @@ def build_train_step(model, optimizer, loss_fn=None, *,
             new_model = apply_updates(model, updates)
         if tape:
             from paddle_tpu.nn.stateful import merge_state
-            new_model = merge_state(new_model, tape)
+            merged = merge_state(new_model, tape)
+            # like the parameter update, state merges are gated on
+            # finiteness: a skipped overflow step must not bake inf/nan
+            # batch statistics into the running buffers forever
+            new_model = jax.tree_util.tree_map(
+                lambda n, o: (jnp.where(all_finite, n, o)
+                              if hasattr(n, "dtype") else n),
+                merged, new_model)
         if k_steps > 1:
             acc = jax.tree_util.tree_map(
                 lambda a: jnp.where(do_apply, jnp.zeros_like(a), a), acc)

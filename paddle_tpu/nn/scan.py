@@ -65,6 +65,70 @@ REMAT_POLICIES = {
 }
 
 
+def _unify_state_uids(blocks):
+    """Stacked blocks are ONE logical module: stateful submodules
+    (BatchNorm) carry a static per-instance ``_uid`` that would make the
+    block pytrees structurally unequal (stacking fails) — rewrite layers
+    1..N-1 to share layer 0's uids. The stacked state arrays then merge
+    through a single tape key per submodule (leaves [n_layers, ...])."""
+    from paddle_tpu.nn.stateful import map_modules
+
+    uids: list = []
+
+    def collect(m):
+        if hasattr(m, "_uid"):
+            uids.append(m._uid)
+        return m
+
+    map_modules(collect, blocks[0])
+    if not uids:
+        return blocks
+    out = [blocks[0]]
+    for b in blocks[1:]:
+        it = iter(uids)
+
+        def rewrite(m):
+            if hasattr(m, "_uid"):
+                return m.replace(_uid=next(it))
+            return m
+
+        out.append(map_modules(rewrite, b))
+    return out
+
+
+def mask_tick_tape(tape: dict, valid, num_microbatches: int) -> dict:
+    """Per-tick tape contribution for a pipeline schedule: average over
+    the microbatches (equal 1/M weight), zero on idle/bubble ticks.
+    Summing the tick-scan outputs then yields the microbatch mean."""
+    return jax.tree_util.tree_map(
+        lambda v: jnp.where(valid, v / num_microbatches,
+                            jnp.zeros_like(v)), tape)
+
+
+def reduce_tick_tapes(tapes: dict, seq_axis=None) -> dict:
+    """Fold the stacked per-tick tapes ([n_ticks, L_local, ...]) into
+    one stage tape; statistics are token-means, so a manual sequence
+    axis averages across its shards."""
+    tape = jax.tree_util.tree_map(lambda v: jnp.sum(v, axis=0), tapes)
+    if seq_axis is not None:
+        tape = jax.tree_util.tree_map(
+            lambda v: lax.pmean(v, seq_axis), tape)
+    return tape
+
+
+def _reemit_tape(tape: dict) -> None:
+    """Forward layer-stacked state updates (collected as scan outputs,
+    leaves [n_layers, ...]) to the ambient tape, if one is active. The
+    stacked arrays line up with the stacked block buffers, so
+    ``nn.merge_state`` on the model works unchanged."""
+    if not tape:
+        return
+    from paddle_tpu.nn.stateful import record_state
+
+    for uid, updates in tape.items():
+        record_state(uid, **updates)
+
+
 class ScannedBlocks(Module):
     """N structurally-identical blocks, parameters stacked on a leading
     layer axis, forward = scan.
@@ -79,6 +143,7 @@ class ScannedBlocks(Module):
                  remat: bool = False, remat_policy: str = "nothing_saveable",
                  layer_axis: str | None = None):
         blocks = [builder(i) for i in range(n_layers)]
+        blocks = _unify_state_uids(blocks)
         self.block = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *blocks)
         self.n_layers = int(n_layers)
@@ -91,13 +156,19 @@ class ScannedBlocks(Module):
         stream_key = rng.stream_key() if training else None
 
         def body(carry, layer_and_key):
+            # stateful layers (BatchNorm) record onto a tape scoped to
+            # THIS layer call (stateful.tape_call); returning it as a
+            # scan output keeps the values valid outside the scan (an
+            # ambient tape written from inside the scan body would leak
+            # tracers)
+            from paddle_tpu.nn.stateful import tape_call
             layer, key = layer_and_key
             if key is not None:
                 with rng.stream(key):
-                    y = layer(carry, *args, training=training, **kwargs)
-            else:
-                y = layer(carry, *args, training=training, **kwargs)
-            return y, None
+                    return tape_call(layer, carry, *args,
+                                     training=training, **kwargs)
+            return tape_call(layer, carry, *args, training=training,
+                             **kwargs)
 
         if self.remat:
             policy = REMAT_POLICIES[self.remat_policy]
@@ -106,7 +177,8 @@ class ScannedBlocks(Module):
 
         keys = (jax.random.split(stream_key, self.n_layers)
                 if stream_key is not None else None)
-        x, _ = lax.scan(body, x, (self.block, keys))
+        x, tape = lax.scan(body, x, (self.block, keys))
+        _reemit_tape(tape)
         return x
 
     def scan_with(self, x, per_layer, **kwargs):

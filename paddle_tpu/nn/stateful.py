@@ -42,6 +42,16 @@ def state_tape():
         _tape_var.reset(token)
 
 
+def tape_call(fn, *args, **kwargs):
+    """Run ``fn`` under a fresh tape and return ``(result, tape_dict)``
+    — the shared per-layer step for scan-based executors (ScannedBlocks
+    and both pipeline schedules): state updates ride out of the scan as
+    outputs instead of leaking scan-body tracers onto an ambient tape."""
+    with state_tape() as t:
+        y = fn(*args, **kwargs)
+    return y, dict(t)
+
+
 def record_state(uid: int, **updates) -> bool:
     """Record new state arrays for the module with the given uid. Returns
     False if no tape is active (eval mode / user skipped the tape)."""
@@ -81,14 +91,25 @@ def map_modules(fn, tree):
 
 def merge_state(model, tape: dict):
     """Return a copy of ``model`` with taped state merged in (matched by
-    each stateful module's static ``_uid``)."""
+    each stateful module's static ``_uid``). New state is cast to the
+    dtype the module currently stores — under AMP the forward records
+    compute-dtype (bf16) statistics, but the master buffers (and the
+    TrainState layout jit donation depends on) stay in their storage
+    dtype."""
     if not tape:
         return model
 
     def fn(m):
         uid = getattr(m, "_uid", None)
         if uid is not None and uid in tape:
-            return m.replace(**tape[uid])
+            updates = {}
+            for k, v in tape[uid].items():
+                cur = getattr(m, k, None)
+                if (hasattr(v, "astype") and hasattr(cur, "dtype")
+                        and v.dtype != cur.dtype):
+                    v = v.astype(cur.dtype)
+                updates[k] = v
+            return m.replace(**updates)
         return m
 
     return map_modules(fn, model)

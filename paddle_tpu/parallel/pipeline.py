@@ -97,20 +97,23 @@ class PipelinedBlocks(Module):
         n_ticks = M + S - 1
 
         def stage_fn(block, h, keys):
-            # run this stage's L/S blocks sequentially
+            # run this stage's L/S blocks sequentially; stateful layers
+            # record per-layer tapes which ride out as scan outputs
+            # (leaves [L/S, ...]) — see nn.scan._reemit_tape
             def bstep(c, layer_and_key):
+                from paddle_tpu.nn.stateful import tape_call
                 layer, key = layer_and_key
                 if key is not None:
                     with _rng.stream(key):
-                        return layer(c, training=training), None
-                return layer(c, training=training), None
+                        return tape_call(layer, c, training=training)
+                return tape_call(layer, c, training=training)
 
             if self.remat:
                 bstep = jax.checkpoint(
                     bstep, policy=REMAT_POLICIES[self.remat_policy],
                     prevent_cse=False)
-            h, _ = lax.scan(bstep, h, (block, keys))
-            return h
+            h, tape = lax.scan(bstep, h, (block, keys))
+            return h, tape
 
         def pp_body(block, x_mb):
             r = lax.axis_index("pp")
@@ -135,7 +138,13 @@ class PipelinedBlocks(Module):
                 feed = lax.dynamic_index_in_dim(
                     x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
                 h_in = jnp.where(r == 0, feed, state)
-                y = stage_fn(block, h_in, keys)
+                y, tape_t = stage_fn(block, h_in, keys)
+                # this stage processes microbatch t-r: average the M
+                # valid ticks' state updates (idle/bubble ticks masked)
+                from paddle_tpu.nn.scan import mask_tick_tape
+                mb = t - r
+                tape_t = mask_tick_tape(
+                    tape_t, jnp.logical_and(mb >= 0, mb < M), M)
                 # drain position: microbatch t-(S-1) finishes on last stage
                 ot = t - (S - 1)
                 cur = lax.dynamic_index_in_dim(
@@ -146,13 +155,18 @@ class PipelinedBlocks(Module):
                     outs, mine, jnp.clip(ot, 0, M - 1), 0)
                 # send_v2/recv_v2: ring-shift activations to the next stage
                 state = C.send_next(y, "pp")
-                return (state, outs), None
+                return (state, outs), tape_t
 
-            (state, outs), _ = lax.scan(tick, (state, outs),
-                                        (jnp.arange(n_ticks), tick_keys))
+            (state, outs), tapes = lax.scan(tick, (state, outs),
+                                            (jnp.arange(n_ticks), tick_keys))
+            from paddle_tpu.nn.scan import reduce_tick_tapes
+            sp_live = (self.seq_axis
+                       if self.seq_axis
+                       and mesh.shape.get(self.seq_axis, 1) > 1 else None)
+            tape = reduce_tick_tapes(tapes, sp_live)
             # results live on the last stage; broadcast once so the head
             # can run replicated/tp-sharded outside
-            return C.broadcast(outs, src=S - 1, axis="pp")
+            return C.broadcast(outs, src=S - 1, axis="pp"), tape
 
         axes = {"pp"}
         x_spec = jax.sharding.PartitionSpec()
@@ -163,12 +177,16 @@ class PipelinedBlocks(Module):
             # via ring/all_to_all collectives on the manual axis
             x_spec = jax.sharding.PartitionSpec(
                 None, None, self.seq_axis, None)
-        out = jax.shard_map(
+        out, tape = jax.shard_map(
             pp_body, mesh=mesh, axis_names=axes,
             in_specs=(jax.sharding.PartitionSpec("pp"), x_spec),
-            out_specs=x_spec,
+            # tape leaves are per-stage [L/S, ...] layer stacks — "pp"
+            # reassembles the full layer axis (pytree-prefix spec)
+            out_specs=(x_spec, jax.sharding.PartitionSpec("pp")),
             check_vma=False,
         )(self.block, x_mb)
+        from paddle_tpu.nn.scan import _reemit_tape
+        _reemit_tape(tape)
         return out.reshape(B, T, E)
 
     def layer(self, i: int) -> Module:

@@ -58,7 +58,8 @@ from paddle_tpu.core import rng as _rng
 from paddle_tpu.nn.scan import REMAT_POLICIES
 from paddle_tpu.parallel import collective as C
 
-__all__ = ["loss_and_grads", "ring_buffer_slots"]
+__all__ = ["loss_and_grads", "ring_buffer_slots", "head_loss",
+           "default_loss_denom"]
 
 
 def ring_buffer_slots(num_stages: int, num_microbatches: int) -> int:
@@ -67,10 +68,35 @@ def ring_buffer_slots(num_stages: int, num_microbatches: int) -> int:
     return min(num_microbatches, 2 * num_stages - 1)
 
 
+def head_loss(fn, denom=None):
+    """Mark a custom loss for the 1F1B schedule — the analogue of the
+    reference's arbitrary per-microbatch section programs
+    (``section_worker.cc:44``).
+
+    ``fn(head, h, labels) -> scalar`` must return the per-microbatch
+    loss SUM over its rows, where ``head`` is the model's
+    ``pipeline_parts()`` head stage, ``h`` the last-stage hidden states
+    of one microbatch and ``labels`` that microbatch's labels — ALREADY
+    next-token-shifted and trailing-ignore-masked by the schedule.
+    ``denom(labels) -> scalar`` is the global normalizer (defaults to
+    the valid-token count); the schedule computes
+    ``loss = Σ_microbatch fn(...) / denom(labels)``.
+
+    Pass the marked function as ``build_train_step(loss_fn=...)`` with
+    ``pipeline.schedule='1f1b'`` — an unmarked generic
+    ``loss_fn(model, batch)`` cannot be scheduled per-microbatch and is
+    rejected with a pointer here.
+    """
+    fn._pipeline_head_loss = True
+    fn._pipeline_denom = denom
+    return fn
+
+
 def loss_and_grads(model, batch, mesh, *, training: bool = True,
                    key=None, cotangent_scale=None,
                    keep_fp32_grads: bool = False,
-                   seq_axis: str | None = None):
+                   seq_axis: str | None = None,
+                   head_loss_fn=None, loss_denom_fn=None):
     """Compute (loss, grads) for a pipeline-decomposable model under the
     1F1B schedule. ``model.blocks`` must already be the pipelined
     executor (strategy compiler applies the override first).
@@ -96,9 +122,23 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
     Ulysses attention inside the stages then rides the already-manual
     axis (Shardy rejects a nested shard_map:
     tests/repros/shardy_nested_manual_sp.py).
+    ``head_loss_fn`` / ``loss_denom_fn``: override the model's
+    ``pipeline_parts()`` loss with a custom per-microbatch head loss
+    (see :func:`head_loss`).
+
+    Returns ``(loss, grads, tape)``. ``tape`` carries the state updates
+    of stateful layers inside the pipelined blocks (BatchNorm running
+    stats): each microbatch's forward records onto a per-layer tape
+    inside the tick scan, the per-microbatch entries are averaged (the
+    standard microbatch-BN semantics — per-microbatch statistics EMA'd
+    with equal weight) and stacked over the layer axis, giving
+    ``{uid: {name: [L, ...]}}`` ready for ``nn.merge_state`` on the
+    stacked block params. Empty for stateless models.
     """
-    (embed, pblocks, head, head_loss_fn, loss_denom,
+    (embed, pblocks, head, model_head_loss, model_loss_denom,
      assemble) = model.pipeline_parts()
+    head_loss_fn = head_loss_fn or model_head_loss
+    loss_denom = loss_denom_fn or model_loss_denom
     S = pblocks.num_stages
     M = pblocks.num_microbatches
     ids, labels = batch["input_ids"], batch["labels"]
@@ -154,23 +194,28 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
                                            lax.axis_index(seq_axis))
 
         def stage_fwd(blk, h, mb_idx):
+            """Returns (h_out, tape): the tape is each layer's stateful
+            updates (BatchNorm running stats etc.), recorded inside the
+            layer scan and stacked [L_local, ...] — {} for stateless
+            blocks, so the fast path is unchanged."""
             keys = (jax.random.split(
                 jax.random.fold_in(stage_key, mb_idx), L_local)
                 if stage_key is not None else None)
 
             def bstep(c, layer_and_key):
+                from paddle_tpu.nn.stateful import tape_call
                 if keys is not None:
                     layer, lk = layer_and_key
                     with _rng.stream(lk):
-                        return layer(c, training=training), None
-                return layer_and_key(c, training=training), None
+                        return tape_call(layer, c, training=training)
+                return tape_call(layer_and_key, c, training=training)
 
             if remat:
                 bstep = jax.checkpoint(bstep, policy=policy,
                                        prevent_cse=False)
             xs = (blk, keys) if keys is not None else blk
-            h, _ = lax.scan(bstep, h, xs)
-            return h
+            h, tape = lax.scan(bstep, h, xs)
+            return h, tape
 
         mb_shape = x_mb.shape[1:]
         # gradient accumulators are fp32 regardless of the compute dtype:
@@ -199,7 +244,11 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             # ---- forward sub-tick: microbatch f ----
             feed = lax.dynamic_index_in_dim(x_mb, fc, 0, keepdims=False)
             h_in = jnp.where(r == 0, feed, state_f)
-            y = stage_fwd(blk, h_in, fc)
+            y, tape_f = stage_fwd(blk, h_in, fc)
+            # per-microbatch state updates, averaged over microbatches
+            # (masked ticks contribute zeros)
+            from paddle_tpu.nn.scan import mask_tick_tape
+            tape_f = mask_tick_tape(tape_f, do_f, M)
             slot_prev = lax.dynamic_index_in_dim(h_saved, fc % K, 0,
                                                  keepdims=False)
             h_saved = lax.dynamic_update_index_in_dim(
@@ -240,7 +289,8 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             dy = jnp.where(r == S - 1, dy_own, state_b)
             h_b = lax.dynamic_index_in_dim(h_saved, bc % K, 0,
                                            keepdims=False)
-            _, svjp = jax.vjp(lambda bl, h: stage_fwd(bl, h, bc), blk, h_b)
+            _, svjp, _ = jax.vjp(lambda bl, h: stage_fwd(bl, h, bc),
+                                 blk, h_b, has_aux=True)
             gb, dh_in = svjp(dy.astype(x_mb.dtype))
             gblk = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(do_b, _acc_cast(g),
@@ -257,10 +307,13 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             state_f = C.send_next(y, "pp")
             state_b = C.recv_prev(dh_in, "pp")
             return (h_saved, gblk, ghead, dx_mb, state_f, state_b,
-                    loss_acc), None
+                    loss_acc), tape_f
 
-        (h_saved, gblk, ghead, dx_mb, _, _, loss_acc), _ = lax.scan(
+        (h_saved, gblk, ghead, dx_mb, _, _, loss_acc), tapes = lax.scan(
             tick, init, jnp.arange(N))
+        # microbatch-averaged stateful updates for THIS stage's layers
+        from paddle_tpu.nn.scan import reduce_tick_tapes
+        tape = reduce_tick_tapes(tapes, seq_axis if sp_on else None)
         # loss/dhead/dx live on specific stages; psum replicates (others
         # contribute zeros). Under manual sp every shard additionally
         # holds a per-sequence-slice PARTIAL: loss and the head/block
@@ -274,7 +327,7 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             gblk = jax.tree_util.tree_map(
                 lambda g: lax.psum(g, seq_axis), gblk)
         dx_mb = lax.psum(dx_mb, "pp")
-        return loss, gblk, ghead, dx_mb
+        return loss, gblk, ghead, dx_mb, tape
 
     axes = {"pp"}
     seq_spec = P()
@@ -283,10 +336,13 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
         axes.add(seq_axis)
         seq_spec = P(None, None, seq_axis, None)   # [M, B/M, T, E]
         lab_spec = P(None, None, seq_axis)         # [M, B/M, T]
-    loss, gblk, ghead, dx_mb = jax.shard_map(
+    # the tape out-spec is a pytree prefix: every leaf is a [L_local,...]
+    # stack of this stage's layer states — P("pp") reassembles the full
+    # [L, ...] layer axis, exactly like the block grads
+    loss, gblk, ghead, dx_mb, tape = jax.shard_map(
         pp_body, mesh=mesh, axis_names=axes,
         in_specs=(P("pp"), P(), seq_spec, lab_spec, P(), P()),
-        out_specs=(P(), P("pp"), P(), seq_spec),
+        out_specs=(P(), P("pp"), P(), seq_spec, P("pp")),
         check_vma=False,
     )(block, head, x_mb, labels_mb, jnp.asarray(inv_denom, jnp.float32),
       jnp.asarray(cotangent_scale, jnp.float32))
@@ -301,7 +357,7 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             ghead, head)
     (dembed,) = embed_vjp(dx_mb.reshape(x.shape).astype(x.dtype))
     grads = assemble(dembed, gblk, ghead)
-    return loss, grads
+    return loss, grads, tape
 
 
 def default_loss_denom(labels, ignore_index: int = -100):

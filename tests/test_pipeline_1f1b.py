@@ -234,9 +234,9 @@ def test_1f1b_dropout_replay(devices8):
         run = jax.jit(lambda m, k: pipeline_1f1b.loss_and_grads(
             m, batch, mesh, key=k))
         k0 = jax.random.PRNGKey(0)
-        loss_a, grads_a = run(model, k0)
-        loss_b, _ = run(model, k0)
-        loss_c, _ = run(model, jax.random.PRNGKey(1))
+        loss_a, grads_a, _ = run(model, k0)
+        loss_b, _, _ = run(model, k0)
+        loss_c, _, _ = run(model, jax.random.PRNGKey(1))
         assert float(loss_a) == float(loss_b)          # deterministic
         assert float(loss_a) != float(loss_c)          # dropout active
 
@@ -251,7 +251,7 @@ def test_1f1b_dropout_replay(devices8):
                 if hasattr(p, "dtype")
                 and jnp.issubdtype(p.dtype, jnp.floating) else p,
                 model, grads_a)
-            l, _ = run(m2, k0)
+            l, _, _ = run(m2, k0)
             return float(l)
 
         fd = (loss_at(+1.0) - loss_at(-1.0)) / (2 * eps)
@@ -261,3 +261,194 @@ def test_1f1b_dropout_replay(devices8):
             if hasattr(g, "dtype") and jnp.issubdtype(g.dtype,
                                                       jnp.floating)))
         assert abs(fd - gsq) / (abs(gsq) + 1e-6) < 2e-2, (fd, gsq)
+
+
+# ---------------------------------------------------------------------------
+# r4 generality: custom head loss + stateful (BatchNorm) blocks
+# ---------------------------------------------------------------------------
+
+def _smoothed_loss_fns(eps=0.1, vocab=256):
+    """The same label-smoothed CE expressed both ways: as a generic
+    (model, batch) loss for DP/GPipe, and as a per-microbatch 1F1B head
+    loss (labels arrive pre-shifted there)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.parallel import pipeline_1f1b as P1
+
+    def smooth_ce(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+        uni = -jnp.mean(logp, axis=-1)
+        per = (1 - eps) * nll + eps * uni
+        return jnp.where(valid, per, 0.0)
+
+    def generic(m, batch, training=True):
+        logits = m(batch["input_ids"], training=training)
+        labels = batch["labels"]
+        lab = jnp.concatenate(
+            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100,
+                                     labels.dtype)], axis=1)
+        per = smooth_ce(logits, lab)
+        return jnp.sum(per) / jnp.maximum(
+            jnp.sum((lab != -100).astype(jnp.float32)), 1.0)
+
+    @P1.head_loss
+    def head(head_p, h, labels):
+        norm, out = head_p
+        logits = out(norm(h)).astype(jnp.float32)
+        return jnp.sum(smooth_ce(logits, labels))
+
+    return generic, head
+
+
+def test_1f1b_custom_head_loss_matches_dp(devices8):
+    """A user loss (label-smoothed CE) threads into the 1F1B last stage
+    via the head_loss marker and matches the same loss computed
+    generically under DP — the reference's arbitrary-section-program
+    capability (section_worker.cc:44)."""
+    generic, head = _smoothed_loss_fns()
+
+    def run(strategy, loss_fn):
+        paddle_tpu.seed(42)
+        model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
+        mesh = M.mesh_from_strategy(strategy)
+        with M.MeshContext(mesh):
+            step = dist.fleet.build_train_step(
+                model, optimizer=optim.AdamW(1e-2), loss_fn=loss_fn,
+                strategy=strategy, mesh=mesh)
+            state = step.init_state(model)
+            batch = step.shard_batch(make_batch())
+            losses = []
+            for i in range(4):
+                state, metrics = step(state, batch, jax.random.PRNGKey(i))
+                losses.append(float(metrics["loss"]))
+        return losses
+
+    l_dp = run(DistributedStrategy(), generic)
+    l_1f = run(_pp_strategy("1f1b"), head)
+    np.testing.assert_allclose(l_dp, l_1f, rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_generic_loss_fn_still_rejected(devices8):
+    s = _pp_strategy("1f1b")
+    mesh = M.mesh_from_strategy(s)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
+    with M.MeshContext(mesh):
+        with pytest.raises(ValueError, match="head_loss"):
+            dist.fleet.build_train_step(
+                model, optimizer=optim.SGD(1e-2),
+                loss_fn=lambda m, b, training=True: 0.0,
+                strategy=s, mesh=mesh)
+
+
+class _BNBlock(paddle_tpu.nn.Module):
+    """Residual Linear+BatchNorm block (stateful: running stats)."""
+
+    def __init__(self, e, key=None):
+        from paddle_tpu import nn
+        from paddle_tpu.core import rng as _rng
+        k1, _ = _rng.split_key(key)
+        self.fc = nn.Linear(e, e, key=k1)
+        self.bn = nn.BatchNorm1D(e, data_format="NHWC", momentum=0.8)
+
+    def __call__(self, x, training: bool = False):
+        return x + jax.nn.relu(self.bn(self.fc(x), training=training))
+
+
+class _BNToyLM(paddle_tpu.nn.Module):
+    """Pipeline-decomposable toy LM with stateful blocks."""
+
+    def __init__(self, vocab=64, e=32, n_layers=4, key=None):
+        from paddle_tpu import nn
+        from paddle_tpu.core import rng as _rng
+        keys = _rng.split_key(key, 2 + n_layers)
+        self.embed = nn.Embedding(vocab, e, key=keys[0])
+        from paddle_tpu.nn.scan import ScannedBlocks
+        self.blocks = ScannedBlocks(
+            lambda i: _BNBlock(e, key=keys[2 + i]), n_layers)
+        self.head = nn.Linear(e, vocab, key=keys[1])
+        self.vocab = vocab
+
+    def loss(self, input_ids, labels, training: bool = True):
+        import paddle_tpu.nn.functional as F
+        x = self.embed(input_ids)
+        x = self.blocks(x, training=training)
+        logits = self.head(x).astype(jnp.float32)
+        lab = jnp.concatenate(
+            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100,
+                                     labels.dtype)], axis=1)
+        return F.cross_entropy(logits, lab)
+
+    def pipeline_parts(self):
+        import paddle_tpu.nn.functional as F
+
+        def head_loss_sum(head, h, labels):
+            return F.cross_entropy(head(h).astype(jnp.float32), labels,
+                                   reduction="sum")
+
+        from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom
+        model = self
+
+        def assemble(dembed, dblocks, dhead):
+            g = jax.tree_util.tree_map(jnp.zeros_like, model)
+            return g.replace(embed=dembed, head=dhead,
+                             blocks=g.blocks.replace(block=dblocks))
+
+        return (self.embed, self.blocks, self.head, head_loss_sum,
+                default_loss_denom, assemble)
+
+
+_STATEFUL_RUNS: dict = {}
+
+
+def _run_stateful(schedule):
+    """Train the BN toy 3 steps under one executor; cached per schedule
+    so the parametrized checks and the cross-executor comparison don't
+    re-run the compile+train work."""
+    if schedule in _STATEFUL_RUNS:
+        return _STATEFUL_RUNS[schedule]
+    paddle_tpu.seed(7)
+    model = _BNToyLM()
+    if schedule == "dp":
+        s = DistributedStrategy()
+    else:
+        s = _pp_strategy(schedule, microbatches=1)
+    mesh = M.mesh_from_strategy(s)
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=optim.SGD(1e-2), strategy=s, mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch(make_batch(vocab=64))
+        rm0 = np.asarray(state.model.blocks.block.bn.running_mean)
+        losses = []
+        for i in range(3):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            losses.append(float(metrics["loss"]))
+    rm = np.asarray(state.model.blocks.block.bn.running_mean)
+    rv = np.asarray(state.model.blocks.block.bn.running_var)
+    _STATEFUL_RUNS[schedule] = (losses, rm0, rm, rv)
+    return _STATEFUL_RUNS[schedule]
+
+
+@pytest.mark.parametrize("schedule", ["dp", "gpipe", "1f1b"])
+def test_stateful_blocks_update_running_stats(devices8, schedule):
+    """BatchNorm inside (scanned / GPipe'd / 1F1B'd) blocks: running
+    stats must update through the executor's tape path."""
+    _, rm0, rm, rv = _run_stateful(schedule)
+    assert rm.shape[0] == 4          # stacked per layer
+    assert np.all(np.isfinite(rm)) and np.all(np.isfinite(rv))
+    assert np.abs(rm - rm0).max() > 1e-6, "stats never updated"
+
+
+def test_stateful_blocks_match_across_executors(devices8):
+    """With M=1 microbatch all three executors see the full batch, so
+    losses AND merged running stats must agree exactly (per-microbatch
+    statistics only differ for M>1 — standard microbatch-BN
+    semantics)."""
+    dp = _run_stateful("dp")
+    for sched in ("gpipe", "1f1b"):
+        other = _run_stateful(sched)
+        np.testing.assert_allclose(dp[0], other[0], rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(dp[2], other[2], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(dp[3], other[3], rtol=1e-4, atol=1e-5)
