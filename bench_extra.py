@@ -219,9 +219,18 @@ def _det_bench(dist, M, optim, mesh, rs):
         return m.loss(batch["x"], batch["boxes"], batch["labels"],
                       training=training)
 
+    # scoped bf16 AMP (r4): backbone/neck/head convs autocast to bf16 and
+    # BatchNorm emits its input dtype (f32 statistics math), while
+    # model.loss pins decode/TAL/VFL/DFL/GIoU fp32 via amp.suspend —
+    # measured 175.8 vs 136.4 img/s fp32 (1.29x) with step-1 loss parity
+    # 0.4%; r3's whole-model autocast measured 9.3 img/s (15x SLOWER)
+    ds = dist.DistributedStrategy()
+    ds.amp.enable = True
+    ds.amp.dtype = "bfloat16"
     with M.MeshContext(mesh):
         step = dist.fleet.build_train_step(
-            det, optimizer=optim.AdamW(1e-4), loss_fn=det_loss, mesh=mesh)
+            det, optimizer=optim.AdamW(1e-4), loss_fn=det_loss,
+            strategy=ds, mesh=mesh)
         state = step.init_state(det)
         data = step.shard_batch({"x": dimgs, "boxes": jnp.asarray(gtb),
                                  "labels": jnp.asarray(gtl)})

@@ -24,8 +24,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.nn.stateful import map_modules
 
-__all__ = ["auto_cast", "active_dtype", "decorate", "cast_model",
-           "master_weights", "GradScaler", "ScalerState",
+__all__ = ["auto_cast", "suspend", "active_dtype", "decorate",
+           "cast_model", "master_weights", "GradScaler", "ScalerState",
            "WHITE_LIST", "BLACK_LIST"]
 
 # Ops that are numerically safe (and fast) in low precision — mirrors the
@@ -54,14 +54,35 @@ _amp_var: ContextVar[_AmpState | None] = ContextVar("ptpu_amp", default=None)
 def auto_cast(enable: bool = True, dtype: str = "bfloat16",
               custom_white_list=(), custom_black_list=()):
     """Autocast context (reference ``paddle.amp.auto_cast``). Inside, the
-    white-listed functional ops cast their floating inputs to ``dtype``."""
+    white-listed functional ops cast their floating inputs to ``dtype``.
+    ``enable=False`` *clears* any ambient autocast (the reference's
+    AutoCastGuard(false) fp32-pinning pattern) — equivalent to
+    :func:`suspend`."""
     if not enable:
-        yield
+        token = _amp_var.set(None)
+        try:
+            yield
+        finally:
+            _amp_var.reset(token)
         return
     state = _AmpState(jnp.dtype(dtype),
                       WHITE_LIST | frozenset(custom_white_list),
                       BLACK_LIST | frozenset(custom_black_list))
     token = _amp_var.set(state)
+    try:
+        yield
+    finally:
+        _amp_var.reset(token)
+
+
+@contextlib.contextmanager
+def suspend():
+    """fp32 region inside an active autocast — the reference's
+    AutoCastGuard(false) (``imperative/amp_auto_cast.h:56``). Models pin
+    precision-critical subgraphs (e.g. a detector's label assignment and
+    losses) while the surrounding step keeps autocasting; no-op when
+    autocast is inactive."""
+    token = _amp_var.set(None)
     try:
         yield
     finally:

@@ -99,8 +99,13 @@ class BatchNorm(Module):
             )
         else:
             mean, var = self.running_mean, self.running_var
+        # statistics/affine math in f32 (mean/var/weight are f32), but
+        # emit the input's dtype: under bf16 autocast a conv→bn→act→conv
+        # chain then stays bf16 end-to-end instead of ping-ponging the
+        # full feature map through f32 at every norm (measured on the
+        # ppyoloe detector: the bounce costs ~2x of the AMP win)
         return F.batch_norm(x, mean, var, self.weight, self.bias,
-                            self.epsilon, self.data_format)
+                            self.epsilon, self.data_format).astype(x.dtype)
 
 
 class BatchNorm1D(BatchNorm):

@@ -390,9 +390,27 @@ class PPYOLOE(Module):
         return V.distance2bbox(points[None], dist)
 
     def loss(self, images, gt_boxes, gt_labels, training: bool = True):
-        cfg = self.config
+        """Scoped mixed precision: only the network forward
+        (backbone/neck/head convs — the FLOPs) rides an ambient
+        ``amp.auto_cast``; decode, TAL assignment (top-k/IoU) and the
+        VFL/DFL/GIoU losses below are pinned fp32 via ``amp.suspend``.
+        Whole-model autocast measured 15× SLOWER than fp32 on a v5e
+        (BASELINE.md r3): per-op cast boundaries inside the assignment
+        break XLA fusion; the head outputs are small, so casting once
+        here is free."""
+        from paddle_tpu import amp as _amp
+
         cls_logits, reg_dist, points, strides = self(
             images, training=training)
+        with _amp.suspend():
+            cls_logits = cls_logits.astype(jnp.float32)
+            reg_dist = reg_dist.astype(jnp.float32)
+            return self._loss_tail(cls_logits, reg_dist, points, strides,
+                                   gt_boxes, gt_labels)
+
+    def _loss_tail(self, cls_logits, reg_dist, points, strides,
+                   gt_boxes, gt_labels):
+        cfg = self.config
         pred_boxes = self._decode(reg_dist, points, strides)
         pred_scores = jax.nn.sigmoid(cls_logits)
 

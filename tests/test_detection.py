@@ -301,3 +301,28 @@ def test_ppyoloe_predict_fixed_shape():
     # scores in [0, 1], labels in range
     assert (valid_rows[:, 1] >= 0).all() and (valid_rows[:, 1] <= 1).all()
     assert (valid_rows[:, 0] < 4).all()
+
+
+def test_detector_loss_scoped_amp_parity():
+    """Under an ambient bf16 autocast the detector scopes itself: convs
+    run bf16 but decode/TAL/losses are pinned fp32 (amp.suspend), so the
+    loss stays within bf16-forward tolerance of the fp32 loss (r3's
+    whole-model autocast was both 15x slower and numerically looser)."""
+    import paddle_tpu
+    from paddle_tpu import amp
+    from paddle_tpu.vision.models import ppyoloe_tiny
+
+    paddle_tpu.seed(0)
+    det = ppyoloe_tiny(num_classes=8)
+    rs = np.random.RandomState(3)
+    imgs = jnp.asarray(rs.randn(2, 3, 64, 64).astype(np.float32) * 0.1)
+    gtb = jnp.asarray(
+        np.array([[[4, 4, 30, 30], [20, 10, 60, 50]],
+                  [[8, 8, 40, 40], [0, 0, 0, 0]]], np.float32))
+    gtl = jnp.asarray(np.array([[1, 3], [5, -1]], np.int32))
+
+    ref = float(det.loss(imgs, gtb, gtl, training=False))
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        got = float(det.loss(imgs, gtb, gtl, training=False))
+    assert np.isfinite(got)
+    np.testing.assert_allclose(got, ref, rtol=2e-2)
