@@ -59,11 +59,8 @@ def auto_cast(enable: bool = True, dtype: str = "bfloat16",
     AutoCastGuard(false) fp32-pinning pattern) — equivalent to
     :func:`suspend`."""
     if not enable:
-        token = _amp_var.set(None)
-        try:
+        with suspend():
             yield
-        finally:
-            _amp_var.reset(token)
         return
     state = _AmpState(jnp.dtype(dtype),
                       WHITE_LIST | frozenset(custom_white_list),

@@ -19,6 +19,9 @@ from paddle_tpu.io.export import (
     save_inference_model,
 )
 from paddle_tpu.io.auto_checkpoint import TrainEpochRange, train_epoch_range
+from paddle_tpu.io.fs import (
+    FS, FSService, LocalFS, WireFS, fs_for_path, register_fs,
+)
 from paddle_tpu.io.serving import InferenceClient, InferenceServer
 from paddle_tpu.io.crypto import (
     load_state_dict_encrypted, save_state_dict_encrypted, generate_key,
@@ -29,4 +32,6 @@ __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
            "export_function", "save_inference_model", "load_inference_model",
            "Predictor", "TrainEpochRange", "train_epoch_range",
            "save_state_dict_encrypted", "load_state_dict_encrypted",
-           "generate_key", "InferenceServer", "InferenceClient"]
+           "generate_key", "InferenceServer", "InferenceClient",
+           "FS", "LocalFS", "WireFS", "FSService", "fs_for_path",
+           "register_fs"]

@@ -13,6 +13,14 @@ TPU design: the epoch state is an explicit pytree (TrainState), so
 number; restore is resharding-aware (orbax lays shards back onto the
 current mesh), so a resume can even change topology — something the
 reference's per-rank scope dumps cannot do.
+
+``directory`` may be a REMOTE URL (``io.fs`` scheme, e.g.
+``ptfs://host:port/run42``) — the reference's HDFS-keyed elastic story:
+saves stage locally and upload the completed step (synchronously —
+durability is the point), and a relaunched trainer on a *fresh node*
+(empty local cache) pulls the latest complete remote step and resumes.
+Job identity comes from ``PADDLE_JOB_ID`` or a stable hash of the URL
+(``io.fs.default_job_id``).
 """
 
 from __future__ import annotations

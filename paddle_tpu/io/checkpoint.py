@@ -60,13 +60,44 @@ def set_state_dict(model, state: dict[str, np.ndarray]):
 
 
 def save_state_dict(model, path: str) -> None:
+    """``path`` may be remote (``scheme://…`` per ``io.fs``): the file is
+    written to a temp location and uploaded."""
+    from paddle_tpu.io import fs as fs_mod
+
+    if fs_mod.is_remote_path(path):
+        import tempfile
+
+        target = path if path.endswith(".npz") else path + ".npz"
+        with tempfile.TemporaryDirectory(prefix="ptpu_sd_") as tmp:
+            local = os.path.join(tmp, "state.npz")
+            np.savez(local, **state_dict(model))
+            fs = fs_mod.fs_for_path(path)
+            try:
+                fs.upload(local, target)
+            finally:
+                getattr(fs, "close", lambda: None)()
+        return
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     np.savez(path if path.endswith(".npz") else path + ".npz",
              **state_dict(model))
 
 
 def load_state_dict(model, path: str):
+    from paddle_tpu.io import fs as fs_mod
+
     p = path if path.endswith(".npz") else path + ".npz"
+    if fs_mod.is_remote_path(path):
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ptpu_sd_") as tmp:
+            local = os.path.join(tmp, "state.npz")
+            fs = fs_mod.fs_for_path(path)
+            try:
+                fs.download(p, local)
+            finally:
+                getattr(fs, "close", lambda: None)()
+            with np.load(local) as data:
+                return set_state_dict(model, dict(data))
     with np.load(p) as data:
         return set_state_dict(model, dict(data))
 
@@ -76,12 +107,30 @@ def load_state_dict(model, path: str):
 # ---------------------------------------------------------------------------
 
 _manager_cache: dict[str, Any] = {}
+_stager_cache: dict[str, Any] = {}
+
+
+def _stage_for(directory: str):
+    """RemoteCheckpointDir for a remote URL (cached), else None — orbax
+    only ever writes the local staging dir; completed steps are
+    uploaded/pulled through the ``io.fs`` backend (the reference's
+    HDFS staging pattern, ``fleet/utils/fs.py`` +
+    ``auto_checkpoint.py:71``)."""
+    from paddle_tpu.io import fs as fs_mod
+
+    if not fs_mod.is_remote_path(directory):
+        return None
+    if directory not in _stager_cache:
+        _stager_cache[directory] = fs_mod.RemoteCheckpointDir(directory)
+    return _stager_cache[directory]
 
 
 def _get_manager(directory: str, max_to_keep: int = 5):
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
+    stage = _stage_for(directory)
+    directory = (stage.local_dir if stage is not None
+                 else os.path.abspath(directory))
     if directory not in _manager_cache:
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, enable_async_checkpointing=True)
@@ -105,19 +154,38 @@ def _flatten_named(tree):
 
 def save_checkpoint(tree, directory: str, step: int,
                     max_to_keep: int = 5) -> None:
-    """Async sharded save of an arbitrary pytree at ``step``."""
+    """Async sharded save of an arbitrary pytree at ``step``. A remote
+    ``directory`` (``scheme://…``) stages locally; the completed step is
+    uploaded synchronously (durability beats async there — the point of
+    a remote checkpoint is surviving the node)."""
     import orbax.checkpoint as ocp
 
     flat, _ = _flatten_named(tree)
     mgr = _get_manager(directory, max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(flat))
+    stage = _stage_for(directory)
+    if stage is not None:
+        mgr.wait_until_finished()
+        stage.push(step)
+        stage.prune(max_to_keep)
 
 
 def load_checkpoint(tree, directory: str, step: int | None = None):
     """Restore into the structure (and shardings) of ``tree``; returns the
-    restored pytree. ``step=None`` loads the latest."""
+    restored pytree. ``step=None`` loads the latest (for a remote
+    directory: the latest *complete* remote step, pulled into the local
+    cache first — a fresh node resumes with an empty cache)."""
     import orbax.checkpoint as ocp
 
+    stage = _stage_for(directory)
+    if stage is not None:
+        if step is None:
+            step = stage.pull_latest()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {directory}")
+        else:
+            # fetch() enforces the .complete marker + atomic cache fill
+            stage.fetch(step)
     mgr = _get_manager(directory)
     if step is None:
         step = mgr.latest_step()
@@ -131,10 +199,20 @@ def load_checkpoint(tree, directory: str, step: int | None = None):
 
 
 def wait_until_finished(directory: str) -> None:
-    mgr = _manager_cache.get(os.path.abspath(directory))
+    stage = _stage_for(directory)
+    key = (stage.local_dir if stage is not None
+           else os.path.abspath(directory))
+    mgr = _manager_cache.get(key)
     if mgr is not None:
         mgr.wait_until_finished()
 
 
 def latest_step(directory: str) -> int | None:
+    """Latest step (remote directories: the latest complete remote step
+    — consulted BEFORE the local cache, so a relaunched node with an
+    empty or stale cache still resumes correctly)."""
+    stage = _stage_for(directory)
+    if stage is not None:
+        steps = stage.remote_steps()
+        return steps[-1] if steps else None
     return _get_manager(directory).latest_step()

@@ -1,0 +1,437 @@
+"""Pluggable checkpoint filesystems.
+
+Reference: ``python/paddle/distributed/fleet/utils/fs.py`` — the
+``FS``/``LocalFS``/``HDFSClient`` hierarchy the reference's
+auto-checkpoint persists through (``fluid/incubate/checkpoint/
+auto_checkpoint.py:71`` keys job state on HDFS by job id). This is the
+TPU-stack reading: the same interface surface (``ls_dir``, ``is_exist``,
+``upload``/``download``, ``need_upload_download`` …), a scheme registry
+so checkpoint paths select their backend by URL, and — since this stack
+ships no Hadoop — a real remote backend over the repo's own TCP frame
+protocol (``core/wire.py``, the substrate the PS/heter/inference
+services already share): run ``FSService(root)`` on a storage node and
+point checkpoints at ``ptfs://host:port/run42``.
+
+``RemoteCheckpointDir`` is the staging pattern the reference uses with
+HDFS (local cache dir + upload after save, download on resume), keyed by
+job id, used by ``io.auto_checkpoint`` and the orbax tier of
+``io.checkpoint``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Callable
+
+from paddle_tpu.core.wire import FrameClient, FrameService
+
+__all__ = ["FS", "LocalFS", "WireFS", "FSService", "register_fs",
+           "fs_for_path", "is_remote_path", "RemoteCheckpointDir"]
+
+
+class FS:
+    """Filesystem interface (reference ``fleet/utils/fs.py`` FS ABC)."""
+
+    def ls_dir(self, path: str) -> tuple[list[str], list[str]]:
+        """→ (subdir names, file names)."""
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_file(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def mv(self, src: str, dst: str) -> None:
+        raise NotImplementedError
+
+    def touch(self, path: str) -> None:
+        raise NotImplementedError
+
+    def upload(self, local_path: str, remote_path: str) -> None:
+        """Copy a local file or directory tree into this filesystem."""
+        raise NotImplementedError
+
+    def download(self, remote_path: str, local_path: str) -> None:
+        """Copy a file or directory tree from this filesystem to local."""
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        """True when checkpoint writers must stage locally and
+        upload/download (the reference's HDFS answer); False when the
+        path is directly addressable by local IO."""
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Direct local IO (reference LocalFS)."""
+
+    def ls_dir(self, path):
+        if not os.path.isdir(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst):
+        shutil.move(src, dst)
+
+    def touch(self, path):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "ab"):
+            pass
+
+    def upload(self, local_path, remote_path):
+        self._copy(local_path, remote_path)
+
+    def download(self, remote_path, local_path):
+        self._copy(remote_path, local_path)
+
+    @staticmethod
+    def _copy(src, dst):
+        if os.path.isdir(src):
+            shutil.copytree(src, dst, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(dst)),
+                        exist_ok=True)
+            shutil.copy2(src, dst)
+
+    def need_upload_download(self):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TCP-backed remote FS over the shared frame protocol
+# ---------------------------------------------------------------------------
+
+_OPS = {"ls": 1, "stat": 2, "read": 3, "write": 4, "mkdirs": 5,
+        "delete": 6, "mv": 7, "touch": 8}
+
+# Files cross the wire in bounded chunks (read takes offset/length,
+# write takes an append flag) so a multi-GB orbax shard never
+# materializes as one frame on either side.
+CHUNK_BYTES = 64 * 1024 * 1024
+
+
+class FSService(FrameService):
+    """File service rooted at a directory — the storage-node side of
+    ``ptfs://``. Paths are confined to the root (``..`` escapes are
+    rejected); bind beyond loopback only on trusted networks (the same
+    posture as the PS services)."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _resolve(self, rel: str) -> str:
+        p = os.path.abspath(os.path.join(self.root, rel.lstrip("/")))
+        if p != self.root and not p.startswith(self.root + os.sep):
+            raise ValueError(f"path escapes FS root: {rel!r}")
+        return p
+
+    def _dispatch(self, sock, op, header, payload) -> bool:
+        from paddle_tpu.core.wire import send_frame
+
+        try:
+            path = self._resolve(header.get("path", ""))
+            if op == _OPS["ls"]:
+                dirs, files = LocalFS().ls_dir(path)
+                send_frame(sock, 0, {"dirs": dirs, "files": files})
+            elif op == _OPS["stat"]:
+                send_frame(sock, 0, {
+                    "exists": os.path.exists(path),
+                    "is_dir": os.path.isdir(path),
+                    "is_file": os.path.isfile(path)})
+            elif op == _OPS["read"]:
+                offset = int(header.get("offset", 0))
+                length = min(int(header.get("length", CHUNK_BYTES)),
+                             CHUNK_BYTES)
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(length)
+                send_frame(sock, 0,
+                           {"nbytes": len(data),
+                            "eof": offset + len(data) >= size}, data)
+            elif op == _OPS["write"]:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                mode = "ab" if header.get("append") else "wb"
+                with open(path, mode) as f:
+                    f.write(payload)
+                send_frame(sock, 0, {})
+            elif op == _OPS["mkdirs"]:
+                os.makedirs(path, exist_ok=True)
+                send_frame(sock, 0, {})
+            elif op == _OPS["delete"]:
+                LocalFS().delete(path)
+                send_frame(sock, 0, {})
+            elif op == _OPS["mv"]:
+                dst = self._resolve(header["dst"])
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.move(path, dst)
+                send_frame(sock, 0, {})
+            elif op == _OPS["touch"]:
+                LocalFS().touch(path)
+                send_frame(sock, 0, {})
+            else:
+                send_frame(sock, 1, {"error": f"unknown op {op}"})
+            return True
+        except Exception as e:  # surfaced client-side as RuntimeError
+            send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})
+            return True
+
+
+class WireFS(FS):
+    """Client for ``ptfs://host:port/...`` paths."""
+
+    scheme = "ptfs"
+
+    def __init__(self, endpoint: str):
+        self._client = FrameClient(endpoint, _OPS, service="ptfs")
+        self.endpoint = endpoint
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        """``ptfs://host:port/rel`` → (endpoint, rel)."""
+        rest = path[len("ptfs://"):]
+        ep, _, rel = rest.partition("/")
+        return ep, rel
+
+    def _rel(self, path: str) -> str:
+        if path.startswith("ptfs://"):
+            ep, rel = self._split(path)
+            if ep != self.endpoint:
+                raise ValueError(
+                    f"path endpoint {ep} != client endpoint "
+                    f"{self.endpoint}")
+            return rel
+        return path
+
+    def ls_dir(self, path):
+        h, _ = self._client._request("ls", {"path": self._rel(path)})
+        return h["dirs"], h["files"]
+
+    def _stat(self, path):
+        h, _ = self._client._request("stat", {"path": self._rel(path)})
+        return h
+
+    def is_dir(self, path):
+        return self._stat(path)["is_dir"]
+
+    def is_file(self, path):
+        return self._stat(path)["is_file"]
+
+    def is_exist(self, path):
+        return self._stat(path)["exists"]
+
+    def mkdirs(self, path):
+        self._client._request("mkdirs", {"path": self._rel(path)})
+
+    def delete(self, path):
+        self._client._request("delete", {"path": self._rel(path)})
+
+    def mv(self, src, dst):
+        self._client._request("mv", {"path": self._rel(src),
+                                     "dst": self._rel(dst)})
+
+    def touch(self, path):
+        self._client._request("touch", {"path": self._rel(path)})
+
+    def upload(self, local_path, remote_path):
+        rel = self._rel(remote_path)
+        if os.path.isdir(local_path):
+            self.mkdirs(rel)
+            for name in sorted(os.listdir(local_path)):
+                self.upload(os.path.join(local_path, name),
+                            f"{rel}/{name}")
+            return
+        with open(local_path, "rb") as f:
+            append = False
+            while True:
+                data = f.read(CHUNK_BYTES)
+                if not data and append:
+                    break
+                self._client._request(
+                    "write", {"path": rel, "nbytes": len(data),
+                              "append": append}, data)
+                append = True
+                if len(data) < CHUNK_BYTES:
+                    break
+
+    def download(self, remote_path, local_path):
+        rel = self._rel(remote_path)
+        st = self._stat(rel)
+        if st["is_dir"]:
+            os.makedirs(local_path, exist_ok=True)
+            dirs, files = self.ls_dir(rel)
+            for name in dirs + files:
+                self.download(f"{rel}/{name}",
+                              os.path.join(local_path, name))
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)),
+                    exist_ok=True)
+        with open(local_path, "wb") as f:
+            offset = 0
+            while True:
+                h, data = self._client._request(
+                    "read", {"path": rel, "offset": offset,
+                             "length": CHUNK_BYTES})
+                f.write(data)
+                offset += len(data)
+                if h.get("eof", True):
+                    break
+
+    def need_upload_download(self):
+        return True
+
+    def close(self):
+        self._client.close()
+
+
+# ---------------------------------------------------------------------------
+# scheme registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[[str], FS]] = {}
+
+
+def register_fs(scheme: str, factory: Callable[[str], FS]) -> None:
+    """Register ``factory(path) -> FS`` for ``scheme://`` paths — the
+    hook for GCS/S3/fsspec-style backends in richer environments."""
+    _REGISTRY[scheme] = factory
+
+
+register_fs("ptfs", lambda path: WireFS(WireFS._split(path)[0]))
+
+
+def is_remote_path(path: str) -> bool:
+    return "://" in path
+
+
+def fs_for_path(path: str) -> FS:
+    """Backend for a checkpoint path: ``scheme://`` selects a registered
+    remote FS; everything else is LocalFS."""
+    if is_remote_path(path):
+        scheme = path.split("://", 1)[0]
+        if scheme not in _REGISTRY:
+            raise ValueError(
+                f"no filesystem registered for scheme {scheme!r} "
+                f"(known: {sorted(_REGISTRY)}); register_fs() one")
+        return _REGISTRY[scheme](path)
+    return LocalFS()
+
+
+# ---------------------------------------------------------------------------
+# remote checkpoint staging (the reference's HDFS cache-dir pattern)
+# ---------------------------------------------------------------------------
+
+def default_job_id(seed: str) -> str:
+    """Job identity for checkpoint keying: ``PADDLE_JOB_ID`` when the
+    launcher provides one (reference auto_checkpoint ``g_train_epoch_
+    range.name`` ← job env), else a stable hash of the checkpoint URL so
+    every worker of the same run agrees without coordination."""
+    env = os.environ.get("PADDLE_JOB_ID")
+    if env:
+        return env
+    return hashlib.sha1(seed.encode()).hexdigest()[:16]
+
+
+class RemoteCheckpointDir:
+    """Local staging mirror of a remote checkpoint directory.
+
+    Writers (orbax) only ever see ``local_dir``; completed step dirs are
+    uploaded with a ``.complete`` marker (a partially uploaded step is
+    never resumable), resume pulls the latest *complete* remote step
+    into the cache, and pruning applies max_to_keep remotely too.
+    """
+
+    def __init__(self, remote_url: str, *, job_id: str | None = None,
+                 cache_root: str | None = None):
+        self.remote_url = remote_url.rstrip("/")
+        self.fs = fs_for_path(remote_url)
+        self.job_id = job_id or default_job_id(self.remote_url)
+        cache_root = cache_root or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "staging")
+        self.local_dir = os.path.join(cache_root, self.job_id)
+        os.makedirs(self.local_dir, exist_ok=True)
+
+    def _remote(self, *parts) -> str:
+        return "/".join((self.remote_url,) + tuple(str(p) for p in parts))
+
+    def remote_steps(self) -> list[int]:
+        if not self.fs.is_exist(self.remote_url):
+            return []
+        dirs, files = self.fs.ls_dir(self.remote_url)
+        done = {f[:-len(".complete")] for f in files
+                if f.endswith(".complete")}
+        return sorted(int(d) for d in dirs if d.isdigit() and d in done)
+
+    def pull_latest(self) -> int | None:
+        """Download the newest complete remote step into the cache (if
+        the cache doesn't already hold it); → step or None."""
+        steps = self.remote_steps()
+        if not steps:
+            return None
+        self.fetch(steps[-1])
+        return steps[-1]
+
+    def fetch(self, step: int) -> None:
+        """Ensure ``step`` is in the local cache. Refuses steps without
+        their remote ``.complete`` marker, and downloads into a temp dir
+        renamed into place — an interrupted download can never be
+        mistaken for a complete cached step on the next resume (the
+        local mirror of the upload-side marker invariant)."""
+        local_step = os.path.join(self.local_dir, str(step))
+        if os.path.isdir(local_step):
+            return
+        if not self.fs.is_exist(self._remote(f"{step}.complete")):
+            raise FileNotFoundError(
+                f"remote step {step} at {self.remote_url} has no "
+                ".complete marker (partial upload?) — not resumable")
+        tmp = local_step + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        self.fs.download(self._remote(step), tmp)
+        os.rename(tmp, local_step)
+
+    def push(self, step: int) -> None:
+        local_step = os.path.join(self.local_dir, str(step))
+        self.fs.upload(local_step, self._remote(step))
+        self.fs.touch(self._remote(f"{step}.complete"))
+
+    def prune(self, max_to_keep: int) -> None:
+        steps = self.remote_steps()
+        for old in steps[:-max_to_keep] if max_to_keep else []:
+            self.fs.delete(self._remote(old))
+            self.fs.delete(self._remote(f"{old}.complete"))
