@@ -407,28 +407,69 @@ class RemoteCheckpointDir:
         self.fetch(steps[-1])
         return steps[-1]
 
+    def _marker_remote(self, step: int) -> str:
+        return self._remote(f"{step}.complete")
+
+    def _marker_local(self, step: int) -> str:
+        return os.path.join(self.local_dir, f"{step}.complete")
+
+    def _read_remote_marker(self, step: int) -> bytes | None:
+        if not self.fs.is_exist(self._marker_remote(step)):
+            return None
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ptpu_mk_") as tmp:
+            local = os.path.join(tmp, "marker")
+            self.fs.download(self._marker_remote(step), local)
+            with open(local, "rb") as f:
+                return f.read()
+
     def fetch(self, step: int) -> None:
-        """Ensure ``step`` is in the local cache. Refuses steps without
-        their remote ``.complete`` marker, and downloads into a temp dir
-        renamed into place — an interrupted download can never be
-        mistaken for a complete cached step on the next resume (the
-        local mirror of the upload-side marker invariant)."""
-        local_step = os.path.join(self.local_dir, str(step))
-        if os.path.isdir(local_step):
-            return
-        if not self.fs.is_exist(self._remote(f"{step}.complete")):
+        """Ensure ``step`` is in the local cache AND matches the remote.
+        Refuses steps without their remote ``.complete`` marker;
+        downloads into a temp dir renamed into place (an interrupted
+        download can never be mistaken for a complete cached step); and
+        validates a pre-existing cached dir against the marker's upload
+        token — a stale cache from an earlier run at the same URL (same
+        hashed job id) is re-downloaded, not silently resumed."""
+        marker = self._read_remote_marker(step)
+        if marker is None:
             raise FileNotFoundError(
                 f"remote step {step} at {self.remote_url} has no "
                 ".complete marker (partial upload?) — not resumable")
+        local_step = os.path.join(self.local_dir, str(step))
+        mk = self._marker_local(step)
+        if os.path.isdir(local_step):
+            if os.path.isfile(mk):
+                with open(mk, "rb") as f:
+                    if f.read() == marker:
+                        return
+            # cached dir from a different upload (or pre-marker cache)
+            shutil.rmtree(local_step, ignore_errors=True)
         tmp = local_step + ".tmp"
         shutil.rmtree(tmp, ignore_errors=True)
         self.fs.download(self._remote(step), tmp)
         os.rename(tmp, local_step)
+        with open(mk, "wb") as f:
+            f.write(marker)
 
     def push(self, step: int) -> None:
+        """Upload the completed local step. The remote step dir is
+        cleared first (a crashed earlier push may have left partial
+        files; merging two saves under one marker would corrupt the
+        checkpoint), then marked complete with a unique upload token —
+        the token is what lets ``fetch`` detect stale caches."""
+        import uuid
+
         local_step = os.path.join(self.local_dir, str(step))
+        self.fs.delete(self._remote(step))
         self.fs.upload(local_step, self._remote(step))
-        self.fs.touch(self._remote(f"{step}.complete"))
+        token = f"{uuid.uuid4().hex}\n".encode()
+        tokenfile = os.path.join(self.local_dir, f"{step}.token")
+        with open(tokenfile, "wb") as f:
+            f.write(token)
+        self.fs.upload(tokenfile, self._marker_remote(step))
+        os.replace(tokenfile, self._marker_local(step))
 
     def prune(self, max_to_keep: int) -> None:
         steps = self.remote_steps()

@@ -59,6 +59,7 @@ __all__ = [
     "adaptive_avg_pool1d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "conv1d", "conv3d",
     "assign", "fc", "upsample", "square_error_cost", "log_loss",
+    "affine_channel",
     "dice_loss", "sigmoid_focal_loss", "npair_loss", "diag_embed",
     "instance_norm", "data_norm", "bilinear", "bilinear_tensor_product",
     "row_conv", "spectral_norm", "conv1d_transpose", "conv2d_transpose",
@@ -303,6 +304,18 @@ def label_smooth(label, epsilon: float = 0.1):
 
 def clip(x, min=None, max=None):
     return jnp.clip(x, min, max)
+
+
+def affine_channel(x, scale, bias=None, data_format: str = "NCHW"):
+    """Per-channel affine y = scale_c · x + bias_c (reference
+    ``operators/affine_channel_op.cc`` — the folded-BN inference form)."""
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    y = x * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
 
 
 # ---------------------------------------------------------------------------
@@ -686,18 +699,56 @@ def _pool(x, kernel_size, stride, padding, data_format, init, op):
     return lax.reduce_window(x, init, op, window, strides, pads)
 
 
+def _adaptive_windows(dim: int, out: int):
+    """Static per-bin gather windows for torch/paddle adaptive pooling:
+    bin i covers input [floor(i·D/O), ceil((i+1)·D/O)). Non-divisible
+    sizes give uneven (possibly overlapping) bins — encoded as a fixed
+    [out, W] index table + validity mask (W = widest bin), which keeps
+    shapes static for XLA (the reference's adaptive attr,
+    ``operators/pool_op.cc``, recomputes bounds per output element on
+    the fly; here they are compile-time constants)."""
+    import numpy as np
+
+    i = np.arange(out)
+    starts = (i * dim) // out
+    ends = -((-(i + 1) * dim) // out)          # ceil((i+1)*dim/out)
+    w = int((ends - starts).max())
+    idx = starts[:, None] + np.arange(w)[None, :]
+    mask = idx < ends[:, None]
+    return (jnp.asarray(np.minimum(idx, dim - 1)),
+            jnp.asarray(mask), w)
+
+
+def _adaptive_pool_axis(x, axis: int, out: int, op: str):
+    """General adaptive pool along one axis via the static window
+    gather; reduces to the exact divisible case when bins are even."""
+    dim = x.shape[axis]
+    idx, mask, w = _adaptive_windows(dim, out)
+    g = jnp.take(x, idx.reshape(-1), axis=axis)
+    g = g.reshape(x.shape[:axis] + (out, w) + x.shape[axis + 1:])
+    mshape = [1] * g.ndim
+    mshape[axis], mshape[axis + 1] = out, w
+    m = mask.reshape(mshape)
+    if op == "max":
+        return jnp.max(jnp.where(m, g, -jnp.inf), axis=axis + 1)
+    s = jnp.sum(jnp.where(m, g, 0), axis=axis + 1)
+    counts = jnp.sum(mask, axis=1).astype(x.dtype).reshape(
+        [out if a == axis else 1 for a in range(s.ndim)])
+    return s / counts
+
+
 def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
     out = _pair(output_size)
     if data_format == "NCHW":
-        h, w = x.shape[2], x.shape[3]
+        axes, (h, w) = (2, 3), (x.shape[2], x.shape[3])
     else:
-        h, w = x.shape[1], x.shape[2]
-    if h % out[0] or w % out[1]:
-        raise ValueError("adaptive_avg_pool2d requires divisible sizes on TPU "
-                         "(static shapes); got "
-                         f"{(h, w)} -> {out}")
-    k = (h // out[0], w // out[1])
-    return avg_pool2d(x, k, stride=k, padding=0, data_format=data_format)
+        axes, (h, w) = (1, 2), (x.shape[1], x.shape[2])
+    if h % out[0] == 0 and w % out[1] == 0:
+        k = (h // out[0], w // out[1])
+        return avg_pool2d(x, k, stride=k, padding=0,
+                          data_format=data_format)
+    y = _adaptive_pool_axis(x, axes[0], out[0], "avg")
+    return _adaptive_pool_axis(y, axes[1], out[1], "avg")
 
 
 def pad(x, paddings, mode: str = "constant", value: float = 0.0):
@@ -990,15 +1041,16 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0):
 def _adaptive_pool_nd(x, nd, output_size, op):
     out = _tuple_n(output_size, nd)
     spatial = x.shape[2:]
-    for size, dim in zip(out, spatial):
-        if dim % size:
-            raise NotImplementedError(
-                f"adaptive pool needs input {dim} divisible by output "
-                f"{size} (XLA static windows)")
-    k = tuple(dim // size for size, dim in zip(out, spatial))
-    if op == "max":
-        return _pool_nd(x, nd, k, k, 0, -jnp.inf, lax.max)
-    return _pool_nd(x, nd, k, k, 0, 0.0, lax.add, count_avg=True)
+    if all(dim % size == 0 for size, dim in zip(out, spatial)):
+        # even bins: one fused reduce_window
+        k = tuple(dim // size for size, dim in zip(out, spatial))
+        if op == "max":
+            return _pool_nd(x, nd, k, k, 0, -jnp.inf, lax.max)
+        return _pool_nd(x, nd, k, k, 0, 0.0, lax.add, count_avg=True)
+    # uneven bins (any output size): per-axis static window gathers
+    for d in range(nd):
+        x = _adaptive_pool_axis(x, 2 + d, out[d], op)
+    return x
 
 
 def adaptive_avg_pool1d(x, output_size):

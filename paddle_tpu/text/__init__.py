@@ -9,8 +9,9 @@ local ``data_file`` paths (zero-egress) and a synthetic
 from paddle_tpu.text.datasets import (
     Conll05st, Imdb, Imikolov, MovieLens, RandomTextDataset, UCIHousing,
     WMT14,
+    WMT16,
 )
 from paddle_tpu.text.vocab import Vocab, simple_tokenize
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "MovieLens",
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "MovieLens",
            "Conll05st", "RandomTextDataset", "Vocab", "simple_tokenize"]

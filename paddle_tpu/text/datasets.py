@@ -20,7 +20,7 @@ import numpy as np
 from paddle_tpu.data.dataset import Dataset
 from paddle_tpu.text.vocab import Vocab, simple_tokenize
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "MovieLens",
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "MovieLens",
            "Conll05st", "RandomTextDataset"]
 
 
@@ -191,6 +191,107 @@ class WMT14(Dataset):
             eos = self.trg_vocab.stoi[self.EOS]
             self.data.append((sid, np.array([bos] + tid, np.int64),
                               np.array(tid + [eos], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(Dataset):
+    """EN↔DE translation (reference ``wmt16.py``): the tar holds
+    tab-separated parallel lines at ``wmt16/{train,test,val}`` and
+    optional frequency-sorted dictionaries at ``wmt16/{en,de}.dict``
+    (built from the training split when absent, reference
+    ``_build_dict``). Samples are (src_ids with bos/eos,
+    trg_ids_with_bos, trg_ids_with_eos); ``lang`` picks which column is
+    the source ("en" → en→de)."""
+
+    BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file: str, mode: str = "train",
+                 src_dict_size: int = 30000, trg_dict_size: int = 30000,
+                 lang: str = "en"):
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"mode should be train/test/val, got {mode!r}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang!r}")
+        _require_file(data_file, "WMT16")
+        src_col = 0 if lang == "en" else 1
+        pairs: list[tuple[list[str], list[str]]] = []
+        train_pairs: list[tuple[list[str], list[str]]] = []
+        dicts: dict[str, list[str]] = {}
+        with tarfile.open(data_file) as tf:
+            members = {os.path.basename(m.name): m
+                       for m in tf.getmembers()
+                       if os.path.basename(m.name) in
+                       ("train", "test", "val", "en.dict", "de.dict")}
+            for key in ("en.dict", "de.dict"):
+                if key in members:
+                    dicts[key[:-5]] = (
+                        tf.extractfile(members[key]).read().decode()
+                        .split())
+
+            def parse(name):
+                rows = []
+                text = tf.extractfile(members[name]).read().decode()
+                for line in text.splitlines():
+                    cols = line.strip().split("\t")
+                    if len(cols) == 2:
+                        rows.append((cols[src_col].split(),
+                                     cols[1 - src_col].split()))
+                return rows
+
+            if mode in members:
+                pairs = parse(mode)
+            if mode == "train":
+                train_pairs = pairs
+            elif (("en" not in dicts or "de" not in dicts)
+                    and "train" in members):
+                # only pay for tokenizing the (large) train split when a
+                # vocabulary actually has to be built from it
+                train_pairs = parse("train")
+
+        def vocab_for(key, col, size):
+            if key in dicts:
+                tokens = dicts[key][:size]
+            else:
+                # reference _build_dict: frequency-sorted from train
+                return Vocab.build(
+                    (p[col] for p in (train_pairs or pairs)),
+                    max_size=size, unk_token=self.UNK,
+                    bos_token=self.BOS, eos_token=self.EOS)
+            return Vocab(tokens, unk_token=self.UNK, bos_token=self.BOS,
+                         eos_token=self.EOS)
+
+        self._lang = lang
+        src_key = lang
+        trg_key = "de" if lang == "en" else "en"
+        self.src_vocab = vocab_for(src_key, 0, src_dict_size)
+        self.trg_vocab = vocab_for(trg_key, 1, trg_dict_size)
+        bos = self.trg_vocab.stoi[self.BOS]
+        eos = self.trg_vocab.stoi[self.EOS]
+        sbos = self.src_vocab.stoi[self.BOS]
+        seos = self.src_vocab.stoi[self.EOS]
+        self.data = []
+        for s, t in pairs:
+            # reference wraps the SOURCE in <s>…<e> too (wmt16.py
+            # _load_data), unlike wmt14
+            sid = np.array([sbos] + self.src_vocab.encode(s) + [seos],
+                           np.int64)
+            tid = self.trg_vocab.encode(t)
+            self.data.append((sid, np.array([bos] + tid, np.int64),
+                              np.array(tid + [eos], np.int64)))
+
+    def get_dict(self, lang: str, reverse: bool = False):
+        """Word dict for a language (reference API). ``reverse`` →
+        id→word."""
+        vocab = self.src_vocab if lang == getattr(self, "_lang", "en") \
+            else self.trg_vocab
+        if reverse:
+            return dict(enumerate(vocab.itos))
+        return dict(vocab.stoi)
 
     def __getitem__(self, idx):
         return self.data[idx]

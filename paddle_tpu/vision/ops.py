@@ -11,13 +11,18 @@ Implemented (reference file cited per function): yolo_box, prior_box,
 anchor_generator, box_coder (encode/decode), box_clip, iou_similarity,
 box_iou_xyxy, bipartite_match, matrix_nms, multiclass_nms, roi_align,
 distance2bbox/bbox2distance (the anchor-free PP-YOLOE transforms),
-generate_anchor_points.
+generate_anchor_points, deform_conv2d (v1/v2, r4).
 
 Deliberately not ported: the RCNN proposal pipeline
 (``generate_proposals_op.cc``, ``collect/distribute_fpn_proposals_op.cc``)
 — subsumed by the anchor-free detectors this framework ships
-(PP-YOLOE-class); and the polygon ops (``polygon_box_transform_op.cc``,
-OCR-specific host-side geometry).
+(PP-YOLOE-class); the position-sensitive ROI pools
+(``psroi_pool_op.cc``, ``prroi_pool_op.cc``) — R-FCN-era heads with no
+consumer in the shipped model zoo, and ``roi_align`` (implemented)
+covers the ROI-feature-extraction role in every post-R-FCN detector —
+anyone porting R-FCN can express psroi_pool as ``roi_align`` over the
+position-sensitive channel groups; and the polygon ops
+(``polygon_box_transform_op.cc``, OCR-specific host-side geometry).
 """
 
 from __future__ import annotations
@@ -34,7 +39,7 @@ __all__ = [
     "yolo_box", "prior_box", "anchor_generator", "box_coder", "box_clip",
     "iou_similarity", "box_iou_xyxy", "bipartite_match", "matrix_nms",
     "multiclass_nms", "roi_align", "distance2bbox", "bbox2distance",
-    "generate_anchor_points",
+    "generate_anchor_points", "deform_conv2d",
 ]
 
 
@@ -550,3 +555,107 @@ def roi_align(features, rois, roi_batch_idx, output_size,
         return jnp.mean(vals, axis=(2, 4))                    # [C, ph, pw]
 
     return jax.vmap(per_roi)(ys, xs, roi_batch_idx)           # [R, C, ph, pw]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None):
+    """Deformable convolution v1/v2 (reference
+    ``paddle/fluid/operators/deformable_conv_op.cu`` /
+    ``deformable_conv_v1_op.cu``; API ``paddle.vision.ops.deform_conv2d``).
+
+    The reference hand-writes a CUDA ``deformable_im2col`` that walks
+    every output pixel; the TPU-native form is the same math as pure
+    tensor ops — build the offset sampling grid, bilinear-gather the
+    deformable im2col patches, and contract them with the weights on the
+    MXU:
+
+        out[b, o, y, x] = Σ_{c,k} w[o, c, k] ·
+            bilinear(x[b, c], p0(y, x, k) + Δp[b, k, y, x]) (· m[b, k, y, x])
+
+    ``x`` [B, Cin, H, W]; ``offset`` [B, 2·dg·K, Ho, Wo] ordered (dy, dx)
+    per kernel tap (reference layout); optional v2 ``mask``
+    [B, dg·K, Ho, Wo]; ``weight`` [Cout, Cin/groups, kh, kw]. With zero
+    offsets and unit mask this is exactly ``F.conv2d`` (tested).
+    Out-of-image samples read as zero, matching the CUDA kernel's
+    bounds check.
+    """
+    from paddle_tpu.nn.functional import _amp_inputs
+
+    # same AMP contract as the standard convs: inputs autocast to the
+    # ambient dtype (the bilinear offsets/weights stay f32 — coordinates
+    # are precision-sensitive and tiny)
+    x, weight, bias = _amp_inputs("conv2d", x, weight, bias)
+    B, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    K = kh * kw
+    dg = deformable_groups
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw_ = (dilation, dilation) if isinstance(dilation, int) else dilation
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw_ * (kw - 1) + 1)) // sw + 1
+    if Cin % dg:
+        raise ValueError(f"Cin={Cin} not divisible by "
+                         f"deformable_groups={dg}")
+
+    # base sampling positions p0 + kernel-tap displacement, per output
+    # pixel and tap: [Ho, Wo, K]
+    ys = jnp.arange(Ho) * sh - ph
+    xs = jnp.arange(Wo) * sw - pw
+    kyy, kxx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw_,
+                            indexing="ij")
+    base_y = ys[:, None, None] + kyy.reshape(-1)[None, None, :]
+    base_x = xs[None, :, None] + kxx.reshape(-1)[None, None, :]
+
+    off = offset.reshape(B, dg, K, 2, Ho, Wo)
+    py = base_y[None, None] + off[:, :, :, 0].transpose(0, 1, 3, 4, 2)
+    px = base_x[None, None] + off[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+    # py/px: [B, dg, Ho, Wo, K] float sample coordinates
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def gather(chan_x, iy, ix):
+        """chan_x [B, dg, Cg, H, W]; iy/ix [B, dg, Ho, Wo, K] int →
+        samples [B, dg, Cg, Ho, Wo, K], zero outside the image."""
+        valid = ((iy >= 0) & (iy < H) & (ix >= 0) & (ix < W))
+        flat = (jnp.clip(iy, 0, H - 1) * W
+                + jnp.clip(ix, 0, W - 1)).astype(jnp.int32)
+        xf = chan_x.reshape(B, dg, -1, H * W)
+        # vmap the per-(batch, group) gather; index arrays broadcast
+        # over the channel dim
+        g = jax.vmap(jax.vmap(
+            lambda cx, ind: jnp.take(cx, ind.reshape(-1), axis=-1)
+        ))(xf, flat)
+        g = g.reshape(chan_x.shape[:3] + flat.shape[2:])
+        return jnp.where(valid[:, :, None], g, 0.0)
+
+    xg = x.reshape(B, dg, Cin // dg, H, W)
+    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+    v00 = gather(xg, y0i, x0i)
+    v01 = gather(xg, y0i, x0i + 1)
+    v10 = gather(xg, y0i + 1, x0i)
+    v11 = gather(xg, y0i + 1, x0i + 1)
+    # combine in the compute dtype so a bf16 autocast stays bf16 into
+    # the einsum (f32 corner weights would promote everything back)
+    wy_ = wy[:, :, None].astype(v00.dtype)
+    wx_ = wx[:, :, None].astype(v00.dtype)
+    samples = ((1 - wy_) * (1 - wx_) * v00 + (1 - wy_) * wx_ * v01
+               + wy_ * (1 - wx_) * v10 + wy_ * wx_ * v11)
+    if mask is not None:                         # v2 modulation
+        m = mask.reshape(B, dg, K, Ho, Wo).transpose(0, 1, 3, 4, 2)
+        samples = samples * m[:, :, None]
+
+    # contract the deformable im2col with the weights on the MXU
+    cols = samples.reshape(B, Cin, Ho, Wo, K)
+    wmat = weight.reshape(groups, Cout // groups, Cin_g, K)
+    cols_g = cols.reshape(B, groups, Cin // groups, Ho, Wo, K)
+    out = jnp.einsum("bgchwk,gock->bgohw", cols_g, wmat,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(B, Cout, Ho, Wo).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

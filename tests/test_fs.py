@@ -192,3 +192,38 @@ def test_incomplete_remote_step_not_resumable(remote, tmp_path):
     assert stage.pull_latest() is None
     with pytest.raises(FileNotFoundError, match="complete"):
         stage.fetch(0)
+
+
+def test_stale_cache_detected_and_redownloaded(remote, tmp_path):
+    """Same URL, new run (operator wiped the remote and re-saved): a
+    node with the OLD run's staging cache must re-download, not silently
+    resume obsolete weights — the upload token in the .complete marker
+    is the version identity."""
+    srv, url = remote
+    cache = str(tmp_path / "cache")
+    stage = fs_mod.RemoteCheckpointDir(f"{url}/runX", cache_root=cache)
+
+    def save_step(value):
+        local = os.path.join(stage.local_dir, "0")
+        fs_mod.LocalFS().delete(local)
+        os.makedirs(local)
+        with open(os.path.join(local, "w.bin"), "wb") as f:
+            f.write(bytes([value]) * 8)
+        stage.push(0)
+
+    save_step(1)
+    # second "run" at the same URL from another node: wipe remote, save new
+    stage2 = fs_mod.RemoteCheckpointDir(f"{url}/runX",
+                                        cache_root=str(tmp_path / "c2"))
+    stage2.fs.delete(stage2._remote(0))
+    stage2.fs.delete(stage2._marker_remote(0))
+    local2 = os.path.join(stage2.local_dir, "0")
+    os.makedirs(local2)
+    with open(os.path.join(local2, "w.bin"), "wb") as f:
+        f.write(bytes([5]) * 8)
+    stage2.push(0)
+
+    # original node still has value-1 cached; fetch must resync to 5
+    stage.fetch(0)
+    with open(os.path.join(stage.local_dir, "0", "w.bin"), "rb") as f:
+        assert f.read() == bytes([5]) * 8

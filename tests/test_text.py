@@ -167,3 +167,43 @@ def test_random_text_dataset_with_loader():
     assert len(batches) == 4
     assert batches[0].shape == (8, 16)
     assert (batches[0] < 50).all()
+
+
+def test_wmt16(tmp_path):
+    from paddle_tpu.text import WMT16
+
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        # tab-separated EN\tDE parallel lines (reference wmt16 layout)
+        _add_text(tf, "wmt16/train",
+                  "the cat\tdie katze\nthe dog\tder hund\n")
+        _add_text(tf, "wmt16/val", "a cat\teine katze\n")
+        _add_text(tf, "wmt16/en.dict", "<s>\n<e>\n<unk>\nthe\ncat\ndog\na\n")
+        _add_text(tf, "wmt16/de.dict",
+                  "<s>\n<e>\n<unk>\ndie\nkatze\nder\nhund\neine\n")
+    ds = WMT16(str(path), mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    # source wrapped in <s>...<e> (wmt16 semantics, unlike wmt14)
+    assert ds.src_vocab.decode(src.tolist()) == ["<s>", "the", "cat", "<e>"]
+    assert ds.trg_vocab.decode([int(trg_in[0])]) == ["<s>"]
+    assert ds.trg_vocab.decode([int(trg_out[-1])]) == ["<e>"]
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+    # de -> en direction swaps the columns
+    ds_de = WMT16(str(path), mode="train", lang="de")
+    src_de, _, trg_out_de = ds_de[0]
+    assert ds_de.src_vocab.decode(src_de.tolist()) == [
+        "<s>", "die", "katze", "<e>"]
+
+    # val split + built-from-train dictionaries when the tar ships none
+    path2 = tmp_path / "wmt16_nodict.tar.gz"
+    with tarfile.open(path2, "w:gz") as tf:
+        _add_text(tf, "wmt16/train",
+                  "the cat\tdie katze\nthe dog\tder hund\n")
+        _add_text(tf, "wmt16/val", "the cat\tdie katze\n")
+    ds_val = WMT16(str(path2), mode="val")
+    assert len(ds_val) == 1
+    src, _, _ = ds_val[0]
+    assert ds_val.src_vocab.decode(src.tolist()) == [
+        "<s>", "the", "cat", "<e>"]

@@ -6,8 +6,11 @@ import os
 import pickle
 import tarfile
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
+
+import paddle_tpu.nn.functional as F
 
 from paddle_tpu.vision import transforms as T
 from paddle_tpu.vision.datasets import (
@@ -174,3 +177,151 @@ def test_fashion_mnist_is_mnist_format(tmp_path):
     # FashionMNIST shares the idx loader; absent files raise cleanly
     with pytest.raises(FileNotFoundError):
         FashionMNIST(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# deformable conv (r4, reference deformable_conv_op.cu) + general
+# adaptive pooling (reference pool_op.cc adaptive attr)
+# ---------------------------------------------------------------------------
+
+class TestDeformConv2d:
+    def _data(self, B=2, C=4, Cout=6, H=7, W=9, dg=1, seed=0):
+        rs = np.random.RandomState(seed)
+        x = jnp.asarray(rs.randn(B, C, H, W).astype(np.float32))
+        w = jnp.asarray(0.3 * rs.randn(Cout, C, 3, 3).astype(np.float32))
+        b = jnp.asarray(rs.randn(Cout).astype(np.float32))
+        off = jnp.asarray(
+            0.7 * rs.randn(B, 2 * dg * 9, H, W).astype(np.float32))
+        return x, w, b, off
+
+    def test_zero_offset_equals_conv2d(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x, w, b, _ = self._data()
+        got = deform_conv2d(x, jnp.zeros((2, 18, 7, 9)), w, b,
+                            stride=1, padding=1)
+        want = F.conv2d(x, w, b, stride=1, padding=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_integer_offset_equals_shifted_conv_interior(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x, w, _, _ = self._data()
+        one = jnp.ones((2, 18, 7, 9), jnp.float32)
+        got = deform_conv2d(x, one, w, None, stride=1, padding=1)
+        x_s = jnp.pad(x, ((0, 0), (0, 0), (0, 1), (0, 1)))[:, :, 1:, 1:]
+        want = F.conv2d(x_s, w, None, stride=1, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(got[:, :, 2:-2, 2:-2]),
+            np.asarray(want[:, :, 2:-2, 2:-2]), rtol=1e-5, atol=1e-5)
+
+    def test_bilinear_linearity(self):
+        """offset +0.5 must equal the mean of offsets 0 and +1 — the
+        bilinear interpolation identity, everywhere incl. borders."""
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x, w, _, _ = self._data()
+        z = jnp.zeros((2, 18, 7, 9), jnp.float32)
+        half = z.at[:, 0::2].set(0.5)
+        oney = z.at[:, 0::2].set(1.0)
+        gh = deform_conv2d(x, half, w, None, 1, 1)
+        g0 = deform_conv2d(x, z, w, None, 1, 1)
+        g1 = deform_conv2d(x, oney, w, None, 1, 1)
+        np.testing.assert_allclose(np.asarray(gh),
+                                   np.asarray(0.5 * (g0 + g1)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mask_modulation_and_groups(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x, w, b, off = self._data(dg=2)
+        m = jnp.asarray(np.random.RandomState(1).rand(
+            2, 2 * 9, 7, 9).astype(np.float32))
+        out = deform_conv2d(x, off, w, b, 1, 1, deformable_groups=2,
+                            mask=m)
+        assert out.shape == (2, 6, 7, 9)
+        assert np.all(np.isfinite(np.asarray(out)))
+        # mask=0 kills everything but the bias
+        out0 = deform_conv2d(x, off, w, b, 1, 1, deformable_groups=2,
+                             mask=jnp.zeros_like(m))
+        np.testing.assert_allclose(
+            np.asarray(out0),
+            np.broadcast_to(np.asarray(b).reshape(1, -1, 1, 1),
+                            out0.shape), atol=1e-6)
+
+    def test_fd_gradients(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+        from tests.op_test import check_grad
+
+        x, w, _, off = self._data(B=1, C=2, Cout=2, H=5, W=5)
+
+        def fn(x, off, w):
+            return deform_conv2d(x, off, w, None, stride=1, padding=1)
+
+        check_grad(fn, [x, off, w], wrt=(0, 1, 2))
+
+    def test_stride_padding_dilation(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        x, w, _, _ = self._data(H=9, W=9)
+        z = jnp.zeros((2, 18, 5, 5), jnp.float32)   # Ho=Wo=5 @ stride 2
+        got = deform_conv2d(x, z, w, None, stride=2, padding=1)
+        want = F.conv2d(x, w, None, stride=2, padding=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestGeneralAdaptivePool:
+    """Non-divisible output sizes (torch/paddle bin semantics:
+    bin i = [floor(i·D/O), ceil((i+1)·D/O)))."""
+
+    @staticmethod
+    def _ref_pool1d(row, out, op):
+        import math as _m
+
+        vals = []
+        d = len(row)
+        for i in range(out):
+            lo = (i * d) // out
+            hi = _m.ceil((i + 1) * d / out)
+            seg = row[lo:hi]
+            vals.append(max(seg) if op == "max" else sum(seg) / len(seg))
+        return np.array(vals, np.float32)
+
+    @pytest.mark.parametrize("dim,out", [(10, 3), (7, 5), (5, 5), (9, 4)])
+    @pytest.mark.parametrize("op", ["avg", "max"])
+    def test_1d_matches_reference(self, dim, out, op):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, dim).astype(np.float32)
+        fn = (F.adaptive_avg_pool1d if op == "avg"
+              else F.adaptive_max_pool1d)
+        got = np.asarray(fn(jnp.asarray(x), out))
+        want = np.stack([
+            np.stack([self._ref_pool1d(list(x[b, c]), out, op)
+                      for c in range(3)]) for b in range(2)])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_2d_non_divisible(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 3, 7, 10).astype(np.float32)
+        got = np.asarray(F.adaptive_avg_pool2d(jnp.asarray(x), (3, 4)))
+        assert got.shape == (2, 3, 3, 4)
+        # every output bin is a mean of its reference window
+        want_00 = x[:, :, 0:3, 0:3].mean(axis=(2, 3))   # ceil(7/3)=3, ceil(10/4)=3
+        np.testing.assert_allclose(got[:, :, 0, 0], want_00, rtol=1e-5)
+        got_max = np.asarray(F.adaptive_max_pool2d(jnp.asarray(x), (3, 4)))
+        np.testing.assert_allclose(
+            got_max[:, :, 0, 0], x[:, :, 0:3, 0:3].max(axis=(2, 3)),
+            rtol=1e-5)
+
+    def test_nhwc_and_divisible_fast_path(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(2, 8, 6, 3).astype(np.float32)    # NHWC
+        got = np.asarray(F.adaptive_avg_pool2d(jnp.asarray(x), (4, 3),
+                                               data_format="NHWC"))
+        assert got.shape == (2, 4, 3, 3)
+        x2 = rs.randn(1, 2, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(F.adaptive_avg_pool2d(jnp.asarray(x2), (2, 2))),
+            x2.reshape(1, 2, 2, 4, 2, 4).mean(axis=(3, 5)), rtol=1e-5)
