@@ -20,7 +20,8 @@ import sys
 
 def _ensure_devices(n: int = 8) -> bool:
     """Re-exec on a virtual n-device CPU mesh if needed. Returns True in
-    the child/ready process, False in the parent that delegated."""
+    the child/ready process; the parent that delegated never returns —
+    it raises SystemExit with the child's exit code."""
     import jax
 
     if len(jax.devices()) >= 4 or os.environ.get("_PTPU_SP_CHILD") == "1":

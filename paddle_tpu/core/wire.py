@@ -47,7 +47,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
                payload: bytes = b"") -> None:
     hj = json.dumps(header).encode()
-    sock.sendall(struct.pack("<ii", code, len(hj)) + hj + payload)
+    # prefix+header in one small send, payload separately — concatenating
+    # would copy the (up to 2 GiB) payload per frame
+    sock.sendall(struct.pack("<ii", code, len(hj)) + hj)
+    if payload:
+        sock.sendall(payload)
 
 
 def recv_frame(sock: socket.socket,

@@ -462,6 +462,11 @@ class RemoteCheckpointDir:
         import uuid
 
         local_step = os.path.join(self.local_dir, str(step))
+        # marker comes down FIRST: from the moment the step data may be
+        # inconsistent until the new marker lands, the step must read as
+        # "not resumable" to every other node (a crash mid-push must not
+        # leave an old marker certifying wiped/partial data)
+        self.fs.delete(self._marker_remote(step))
         self.fs.delete(self._remote(step))
         self.fs.upload(local_step, self._remote(step))
         token = f"{uuid.uuid4().hex}\n".encode()

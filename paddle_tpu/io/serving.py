@@ -147,7 +147,11 @@ class InferenceClient(FrameClient):
         rheader, rpayload = self._request(
             "infer", {"model": model, "inputs": specs,
                       "nbytes": len(payload)}, payload)
-        return _unpack_arrays(rheader["outputs"], rpayload)
+        # copy out of the frombuffer views: results a caller may mutate
+        # must not be read-only aliases of the reply buffer (server-side
+        # unpack stays zero-copy — Predictor only reads)
+        return [np.array(a) for a in
+                _unpack_arrays(rheader["outputs"], rpayload)]
 
     def list_models(self) -> dict:
         return self._request("list_models", {})[0]["models"]

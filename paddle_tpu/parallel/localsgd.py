@@ -170,7 +170,9 @@ class LocalSGDTrainStep:
     def _sched_device(self, fresh: bool = False):
         """Schedule scalars as device arrays; ``fresh=True`` gives the
         pristine start-of-training values (for init_state) rather than the
-        wrapper's current mutated ones."""
+        wrapper's current mutated ones. The current-schedule arrays are
+        cached and refreshed only when the host schedule actually changes
+        (sync boundaries) — not re-uploaded every step."""
         unset = -1.0
         if fresh:
             k0 = self._init_k if self._adaptive else self.k_steps
@@ -180,15 +182,30 @@ class LocalSGDTrainStep:
                 "loss0": jnp.asarray(unset, jnp.float32),
                 "lr0": jnp.asarray(unset, jnp.float32),
             }
-        return {
+        return self._sched_for(self._last_sync)
+
+    def _sched_for(self, last_sync: int):
+        """Current-schedule device arrays with an explicit ``last_sync``
+        — the step carries these into the checkpointable state, so a
+        sync step passes its own (prospective) sync point WITHOUT
+        mutating the host mirrors before dispatch (exception safety:
+        a failed step leaves the host cadence untouched)."""
+        unset = -1.0
+        key = (self.k_steps, last_sync, self._loss0, self._lr0)
+        cached = getattr(self, "_sched_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sched = {
             "k_steps": jnp.asarray(self.k_steps, jnp.int32),
-            "last_sync": jnp.asarray(self._last_sync, jnp.int32),
+            "last_sync": jnp.asarray(last_sync, jnp.int32),
             "loss0": jnp.asarray(
                 self._loss0 if self._loss0 is not None else unset,
                 jnp.float32),
             "lr0": jnp.asarray(
                 self._lr0 if self._lr0 is not None else unset, jnp.float32),
         }
+        self._sched_cache = (key, sched)
+        return sched
 
     def _reseed(self, state):
         """Adopt the sync schedule of a state this wrapper did not produce
@@ -320,11 +337,16 @@ class LocalSGDTrainStep:
             }
         next_step = self._host_step + 1
         do_sync = self._should_sync(next_step)
+        # the carried state records this step's sync point; host mirrors
+        # commit only after the dispatch succeeds — an exception in the
+        # step must not desync the host cadence from the (unchanged)
+        # device state. (The wrapper is a host-side scheduler and, like
+        # the reference trainer loop, not safe for concurrent callers.)
+        sched = self._sched_for(next_step if do_sync else self._last_sync)
+        state, metrics = self._jitted[do_sync](state, batch, key, sched)
         if do_sync:
             self._last_sync = next_step
             self.sync_history.append(next_step)
-        state, metrics = self._jitted[do_sync](state, batch, key,
-                                               self._sched_device())
         self._host_step = next_step
         if do_sync and self._adaptive:
             # blocks on the replica-averaged loss — only at sync points,

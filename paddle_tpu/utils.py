@@ -72,6 +72,19 @@ def run_check(verbose: bool = True) -> bool:
     return True
 
 
+_DEPRECATION_PREFIX = "paddle_tpu: "
+_deprecation_filter_installed = False
+
+
+def _ensure_deprecation_filter():
+    global _deprecation_filter_installed
+    if not _deprecation_filter_installed:
+        warnings.filterwarnings(
+            "default", category=DeprecationWarning,
+            message="^" + _DEPRECATION_PREFIX.replace(" ", r"\ "))
+        _deprecation_filter_installed = True
+
+
 def deprecated(since: str = "", update_to: str = "", reason: str = ""):
     """Mark an API deprecated (reference ``utils/deprecated.py``):
     warns once per call site with the migration hint."""
@@ -86,14 +99,16 @@ def deprecated(since: str = "", update_to: str = "", reason: str = ""):
             msg += f"; use {update_to} instead"
 
         # Python hides DeprecationWarning outside __main__ by default;
-        # an explicit "default" filter for our messages keeps the hint
-        # visible once per call site, which is this decorator's contract.
-        warnings.filterwarnings("default", category=DeprecationWarning,
-                                message=r".*\bis deprecated\b.*")
+        # one module-level filter scoped to THIS package's message
+        # prefix keeps the hint visible once per call site without
+        # re-enabling unrelated libraries' DeprecationWarnings or
+        # prepending a filter per decorated function.
+        _ensure_deprecation_filter()
 
         @functools.wraps(fn)
         def inner(*args, **kwargs):
-            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            warnings.warn(_DEPRECATION_PREFIX + msg, DeprecationWarning,
+                          stacklevel=2)
             return fn(*args, **kwargs)
 
         inner.__doc__ = (f"[deprecated] {msg}\n\n" + (fn.__doc__ or ""))
