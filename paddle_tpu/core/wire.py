@@ -47,11 +47,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def send_frame(sock: socket.socket, code: int, header: dict[str, Any],
                payload: bytes = b"") -> None:
     hj = json.dumps(header).encode()
-    # prefix+header in one small send, payload separately — concatenating
-    # would copy the (up to 2 GiB) payload per frame
-    sock.sendall(struct.pack("<ii", code, len(hj)) + hj)
-    if payload:
-        sock.sendall(payload)
+    prefix = struct.pack("<ii", code, len(hj)) + hj
+    if not payload:
+        sock.sendall(prefix)
+        return
+    # one gathered write: no concatenation copy of the (up to 2 GiB)
+    # payload, and no Nagle write-write-read stall from a separate small
+    # prefix segment (this protocol is strictly request-then-reply)
+    buffers = [prefix, payload]
+    while buffers:
+        sent = sock.sendmsg(buffers)
+        while buffers and sent >= len(buffers[0]):
+            sent -= len(buffers[0])
+            buffers.pop(0)
+        if buffers and sent:
+            buffers[0] = memoryview(buffers[0])[sent:]
 
 
 def recv_frame(sock: socket.socket,

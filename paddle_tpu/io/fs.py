@@ -477,7 +477,9 @@ class RemoteCheckpointDir:
         os.replace(tokenfile, self._marker_local(step))
 
     def prune(self, max_to_keep: int) -> None:
+        # marker first (as in push): a crash between the deletes must
+        # leave an unlisted step, never a marker certifying wiped data
         steps = self.remote_steps()
         for old in steps[:-max_to_keep] if max_to_keep else []:
+            self.fs.delete(self._marker_remote(old))
             self.fs.delete(self._remote(old))
-            self.fs.delete(self._remote(f"{old}.complete"))

@@ -35,6 +35,8 @@ class GPTConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     init_std: float = 0.02
+    # LM-head loss path — see LlamaConfig.lm_head_mode / F.linear_cross_entropy
+    lm_head_mode: str = "dense"
 
     @classmethod
     def gpt3_6_7b(cls) -> "GPTConfig":
@@ -75,14 +77,21 @@ class GPTBlock(Module):
         self.head_dim = E // cfg.num_heads
 
     def __call__(self, x, training: bool = False):
+        import jax.ad_checkpoint
+
         B, T, E = x.shape
         h = self.ln1(x)
         qkv = self.wqkv(h).reshape(B, T, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         a = F.scaled_dot_product_attention(q, k, v, causal=True)
-        x = x + self.drop(self.wo(a.reshape(B, T, E)), training=training)
+        # tags for the partial-save remat policies (no-op otherwise)
+        attn_out = jax.ad_checkpoint.checkpoint_name(
+            self.wo(a.reshape(B, T, E)), "attn_out")
+        x = x + self.drop(attn_out, training=training)
         h = self.ln2(x)
-        h = self.fc2(F.gelu(self.fc1(h), approximate=True))
+        up = jax.ad_checkpoint.checkpoint_name(
+            F.gelu(self.fc1(h), approximate=True), "mlp_up")
+        h = jax.ad_checkpoint.checkpoint_name(self.fc2(up), "mlp_out")
         return x + self.drop(h, training=training)
 
 
@@ -107,15 +116,28 @@ class GPTForCausalLM(Module):
                               pspec=P("fsdp", "tp"))
         self.config = cfg
 
-    def __call__(self, input_ids, training: bool = False):
+    def hidden_states(self, input_ids, training: bool = False):
         T = input_ids.shape[1]
         x = self.embed(input_ids) + self.pos_embed(jnp.arange(T))
         x = self.drop(x, training=training)
         x = self.blocks(x, training=training)
-        return self.lm_head(self.ln_f(x))
+        return self.ln_f(x)
+
+    def __call__(self, input_ids, training: bool = False):
+        return self.lm_head(self.hidden_states(input_ids,
+                                               training=training))
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
+        mode = getattr(self.config, "lm_head_mode", "dense")
+        if mode != "dense":
+            # fused lm-head⊗xent: the [B, T, 50304] logits never
+            # materialize (shared path with Llama)
+            x = self.hidden_states(input_ids, training=training)
+            return F.next_token_linear_loss(x, self.lm_head.weight,
+                                            labels,
+                                            ignore_index=ignore_index,
+                                            mode=mode)
         logits = self(input_ids, training=training)
         return F.cross_entropy(
             logits[:, :-1].astype(jnp.float32), labels[:, 1:],

@@ -366,12 +366,9 @@ class LlamaForCausalLM(Module):
             # magnitude below the O(N·V) logits the fusion removes
             w = (self.lm_head.weight if self.lm_head is not None
                  else self.embed.weight.T)
-            B = labels.shape[0]
-            lab_shift = jnp.concatenate(
-                [labels[:, 1:],
-                 jnp.full((B, 1), ignore_index, labels.dtype)], axis=1)
-            return F.linear_cross_entropy(
-                x, w, lab_shift, ignore_index=ignore_index, mode=mode)
+            return F.next_token_linear_loss(x, w, labels,
+                                            ignore_index=ignore_index,
+                                            mode=mode)
         logits = self(input_ids, training=training)
         return F.cross_entropy(
             logits[:, :-1].astype(jnp.float32), labels[:, 1:],

@@ -43,6 +43,7 @@ __all__ = [
     "softmax", "log_softmax", "one_hot", "embedding", "linear",
     "dropout", "layer_norm", "rms_norm", "group_norm", "batch_norm",
     "cross_entropy", "softmax_with_cross_entropy", "linear_cross_entropy",
+    "next_token_linear_loss",
     "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
     "smooth_l1_loss", "nll_loss", "kl_div", "label_smooth",
@@ -475,6 +476,23 @@ def linear_cross_entropy(hidden, weight, label, ignore_index: int = -100,
     if reduction == "sum":
         return jnp.sum(loss)
     return loss.reshape(out_shape)
+
+
+def next_token_linear_loss(hidden, weight, labels, ignore_index: int = -100,
+                           mode: str = "auto"):
+    """Causal-LM head loss over ``hidden`` [B, T, E] with SAME-position
+    ``labels`` [B, T]: shifts the labels left one step (position t
+    predicts token t+1) and ignore-masks the final position, then runs
+    :func:`linear_cross_entropy`. Running over all T rows with a shifted
+    mask is mean-equivalent to the dense ``logits[:, :-1]`` slice while
+    keeping the row count kernel-aligned — the shared head-loss path of
+    the Llama/GPT families."""
+    lab_shift = jnp.concatenate(
+        [labels[:, 1:],
+         jnp.full((labels.shape[0], 1), ignore_index, labels.dtype)],
+        axis=1)
+    return linear_cross_entropy(hidden, weight, lab_shift,
+                                ignore_index=ignore_index, mode=mode)
 
 
 def nll_loss(log_probs, label, reduction: str = "mean"):

@@ -253,6 +253,25 @@ class TestPartitioned:
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_gpt_loss_fused_path_matches_dense():
+    import dataclasses
+
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, dtype="float32",
+                    remat=False)
+    model = GPTForCausalLM(cfg)
+    rs = np.random.RandomState(5)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 16)).astype(np.int32))
+    dense = model.loss(ids, ids, training=False)
+    model.config = dataclasses.replace(cfg, lm_head_mode="chunked")
+    fused = model.loss(ids, ids, training=False)
+    np.testing.assert_allclose(float(fused), float(dense), rtol=1e-5)
+
+
 def test_supported_gates():
     h = jnp.zeros((24, 128), jnp.float32)
     w = jnp.zeros((128, 384), jnp.float32)
