@@ -128,12 +128,21 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     use_fp16_ar = far_cfg.enable
     if use_fp16_ar:
         deg = strategy.parallel_degrees()
+        # zero-1/2 compose (params replicated over the manual data axes;
+        # only optimizer state is sharded — parity-tested). tp stays
+        # rejected: probed r4 — with no axis_names the shard_map is
+        # manual over ALL axes and would silently all-gather the Megatron
+        # shards (replicated compute), and the correct partial-manual
+        # form (axis_names={dp, fsdp}, tp automatic) hard-aborts XLA CPU
+        # today. pp/sp nest their own manual schedules; zero-3 shards
+        # params over the very axes the reduction is manual over.
         bad = [a for a in ("tp", "pp", "sp") if deg.get(a, 1) > 1]
         if bad or (strategy.sharding.enable and strategy.sharding.stage >= 3):
             raise ValueError(
                 "fp16_allreduce compresses the data-parallel gradient "
                 f"reduction only; incompatible with {bad or 'zero-3'} "
-                "(those reductions are partitioned by XLA)")
+                "(those reductions are partitioned by XLA; zero-1/2 "
+                "compose)")
         wire_dtype = jnp.dtype(far_cfg.dtype)
 
     pp_cfg = strategy.pipeline

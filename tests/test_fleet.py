@@ -463,6 +463,35 @@ def test_fp16_allreduce_matches_fp32_reduction(devices8):
     assert losses[-1] < losses[0]
 
 
+def test_fp16_allreduce_composes_with_zero2(devices8):
+    """zero-1/2 compose with the compressed reduction (params stay
+    replicated over the manual data axes; only optimizer state is
+    sharded). tp stays gated: the correct partial-manual formulation
+    aborts XLA CPU today and the all-manual one would silently
+    replicate the Megatron shards (probed r4; see the strategy-compiler
+    comment)."""
+    ref, _, _ = run_steps(DistributedStrategy(), lr=1e-3)
+    s = DistributedStrategy()
+    s.sharding.enable = True
+    s.sharding.stage = 2
+    s.sharding.degree = 2
+    s.fp16_allreduce.enable = True
+    losses, _, _ = run_steps(s, lr=1e-3)
+    np.testing.assert_allclose(losses, ref, rtol=2e-2)
+    assert losses[-1] < losses[0]
+
+    s_tp = DistributedStrategy()
+    s_tp.tensor_parallel.enable = True
+    s_tp.tensor_parallel.degree = 2
+    s_tp.fp16_allreduce.enable = True
+    mesh = M.mesh_from_strategy(s_tp)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_layers=4))
+    with M.MeshContext(mesh):
+        with pytest.raises(ValueError, match="incompatible"):
+            dist.fleet.build_train_step(
+                model, optimizer=optim.SGD(1e-2), strategy=s_tp, mesh=mesh)
+
+
 def test_pipeline_composes_with_ring_attention(devices8):
     """pp=2 x sp=2 x dp=2: ring attention inside the pipeline's manual
     shard_map (the nested-manual composition that needs the abstract-mesh
