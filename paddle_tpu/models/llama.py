@@ -135,9 +135,17 @@ class LlamaAttention(Module):
         ``python/paddle/nn/layer/transformer.py`` recompiles per length
         under jit)."""
         B, T, E = x.shape
-        q = self.wq(x).reshape(B, T, self.num_heads, self.head_dim)
-        k = self.wk(x).reshape(B, T, self.num_kv_heads, self.head_dim)
-        v = self.wv(x).reshape(B, T, self.num_kv_heads, self.head_dim)
+        # tags for the "save_block_dots_qkv" remat policy (no-op
+        # otherwise): saving the projections lets the attention VJP
+        # recompute start from q/k/v instead of re-running the matmuls
+        q = jax.ad_checkpoint.checkpoint_name(
+            self.wq(x), "qkv").reshape(B, T, self.num_heads, self.head_dim)
+        k = jax.ad_checkpoint.checkpoint_name(
+            self.wk(x), "qkv").reshape(B, T, self.num_kv_heads,
+                                       self.head_dim)
+        v = jax.ad_checkpoint.checkpoint_name(
+            self.wv(x), "qkv").reshape(B, T, self.num_kv_heads,
+                                       self.head_dim)
         if positions is None:
             # inside a manual-sp region (pipeline∘sp) the local T is one
             # sequence slice: RoPE must rotate by absolute positions

@@ -62,6 +62,11 @@ REMAT_POLICIES = {
     "save_block_dots":
         jax.checkpoint_policies.save_only_these_names(
             "mlp_gate", "mlp_up", "mlp_out", "attn_out"),
+    # + the q/k/v projections: the attention VJP recomputes from the
+    # saved projections instead of re-running the three matmuls
+    "save_block_dots_qkv":
+        jax.checkpoint_policies.save_only_these_names(
+            "mlp_gate", "mlp_up", "mlp_out", "attn_out", "qkv"),
 }
 
 
