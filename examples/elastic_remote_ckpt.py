@@ -1,0 +1,124 @@
+"""Elastic training that survives NODE loss, not just process loss.
+
+The reference persists auto-checkpoint state to HDFS keyed by job id
+(``fluid/incubate/checkpoint/auto_checkpoint.py``, ``fleet/utils/fs.py``)
+so a restarted pod resumes instead of redoing. The paddle_tpu analogue:
+point ``TrainEpochRange`` at a REMOTE checkpoint URL (``io.fs`` scheme —
+here the built-in ``ptfs://`` TCP filesystem, in production a storage
+node or any ``register_fs``-registered backend). Saves stage locally and
+upload the completed step; a relaunched trainer on a FRESH machine
+(empty staging cache) pulls the latest complete step and fast-forwards.
+
+This script plays all three roles in one process:
+1. a "storage node" (FSService rooted in a temp dir),
+2. trainer run A: trains half the epochs, saving through ptfs://,
+3. trainer run B: simulates node loss (wipes run A's staging cache +
+   uses a different cache root), resumes from the remote, finishes.
+
+Run: python examples/elastic_remote_ckpt.py [--epochs 6 --steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--steps", type=int, default=20, help="steps/epoch")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.io import FSService, TrainEpochRange
+    from paddle_tpu.io import checkpoint as ckpt
+    from paddle_tpu.nn import functional as F
+
+    work = tempfile.mkdtemp(prefix="elastic_demo_")
+    storage = os.path.join(work, "storage_node")
+    caches = [os.path.join(work, "node_a_cache"),
+              os.path.join(work, "node_b_cache")]
+
+    # --- the storage node: any box reachable over TCP ------------------
+    srv = FSService(storage).start()
+    url = f"ptfs://{srv.endpoint}/demo-job"
+    print(f"storage node serving {storage!r} at {url}")
+
+    # --- a tiny classification task ------------------------------------
+    rs = np.random.RandomState(0)
+    Xn = rs.randn(256, 16).astype(np.float32)
+    X = jnp.asarray(Xn)
+    Y = jnp.asarray((Xn[:, 0] > 0).astype(np.int32))   # learnable target
+
+    def make_state():
+        paddle_tpu.seed(0)
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 2))
+        opt = optim.AdamW(1e-2)
+        return {"net": net, "opt": opt.init(net)}, opt
+
+    def train(node: int, epochs: int, label: str):
+        """One trainer lifetime on "node_<node>" (its own staging
+        cache, as a distinct machine would have)."""
+        os.environ["PADDLE_JOB_ID"] = "demo-job-42"   # shared identity
+        # per-node staging location (each real machine has its own);
+        # reset_remote_cache() plays the process restart
+        os.environ["PADDLE_CKPT_CACHE_ROOT"] = caches[node]
+        ckpt.reset_remote_cache()
+        state, opt = make_state()
+
+        @jax.jit
+        def step(state):
+            def loss_fn(net):
+                return F.cross_entropy(net(X), Y)
+            loss, g = jax.value_and_grad(loss_fn)(state["net"])
+            net, ostate = opt.apply_gradients(state["net"], g,
+                                              state["opt"])
+            return {"net": net, "opt": ostate}, loss
+
+        r = TrainEpochRange(epochs, url, state=state, save_interval=1)
+        print(f"[{label}] resumed={r.resumed} start_epoch={r.start_epoch}")
+        loss = float("nan")
+        for epoch in r:
+            s = r.state
+            for _ in range(args.steps):
+                s, loss = step(s)
+            r.state = s
+            print(f"[{label}] epoch {epoch}: loss={float(loss):.4f}")
+        r.flush()
+        return r
+
+    try:
+        # --- run A: completes half the job, then the "node dies" ------
+        half = max(args.epochs // 2, 1)
+        train(0, half, "node A")
+        shutil.rmtree(caches[0], ignore_errors=True)  # node A is GONE
+        from paddle_tpu.io import fs as fs_mod
+        probe = fs_mod.fs_for_path(url)
+        surviving = probe.ls_dir(url)[0]
+        probe.close()
+        print(f"node A lost (staging cache wiped); remote step dirs "
+              f"survive on the storage node: {surviving}")
+
+        # --- run B: fresh machine, empty cache — resumes remotely -----
+        r = train(1, args.epochs, "node B")
+        assert r.resumed and r.start_epoch == half, (r.resumed,
+                                                     r.start_epoch)
+        print(f"node B resumed at epoch {r.start_epoch} from {url} and "
+              f"finished the job — elastic across node loss")
+    finally:
+        ckpt.reset_remote_cache()
+        srv.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

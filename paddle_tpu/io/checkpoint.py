@@ -110,6 +110,16 @@ _manager_cache: dict[str, Any] = {}
 _stager_cache: dict[str, Any] = {}
 
 
+def reset_remote_cache() -> None:
+    """Drop the cached remote stagers (closing their connections) and
+    orbax managers — the supported way to simulate/act out a fresh node
+    (a new process has empty caches anyway)."""
+    for stage in _stager_cache.values():
+        stage.close()
+    _stager_cache.clear()
+    _manager_cache.clear()
+
+
 def _stage_for(directory: str):
     """RemoteCheckpointDir for a remote URL (cached), else None — orbax
     only ever writes the local staging dir; completed steps are

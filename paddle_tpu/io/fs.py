@@ -382,10 +382,21 @@ class RemoteCheckpointDir:
         self.remote_url = remote_url.rstrip("/")
         self.fs = fs_for_path(remote_url)
         self.job_id = job_id or default_job_id(self.remote_url)
-        cache_root = cache_root or os.path.join(
-            os.path.expanduser("~"), ".cache", "paddle_tpu", "staging")
+        # staging location, in priority order: explicit arg, the
+        # PADDLE_CKPT_CACHE_ROOT env (the supported per-node override —
+        # tests and the elastic example use it), XDG-ish default
+        cache_root = (cache_root
+                      or os.environ.get("PADDLE_CKPT_CACHE_ROOT")
+                      or os.path.join(os.path.expanduser("~"), ".cache",
+                                      "paddle_tpu", "staging"))
         self.local_dir = os.path.join(cache_root, self.job_id)
         os.makedirs(self.local_dir, exist_ok=True)
+
+    def close(self) -> None:
+        """Release the backend connection (WireFS holds a TCP socket)."""
+        closer = getattr(self.fs, "close", None)
+        if closer is not None:
+            closer()
 
     def _remote(self, *parts) -> str:
         return "/".join((self.remote_url,) + tuple(str(p) for p in parts))

@@ -127,14 +127,9 @@ def test_auto_checkpoint_remote_resume_fresh_node(remote, tmp_path,
     cache2 = tmp_path / "node2_cache"
 
     def stager_at(cache):
-        ckpt._stager_cache.clear()
-        ckpt._manager_cache.clear()
-        monkeypatch.setattr(
-            fs_mod.RemoteCheckpointDir, "__init__",
-            lambda self, remote_url, job_id=None, cache_root=None, \
-                _orig=fs_mod.RemoteCheckpointDir.__init__: _orig(
-                    self, remote_url, job_id=job_id,
-                    cache_root=str(cache)))
+        # the supported per-node override + process-restart simulation
+        ckpt.reset_remote_cache()
+        monkeypatch.setenv("PADDLE_CKPT_CACHE_ROOT", str(cache))
 
     stager_at(cache1)
     state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(0)}
