@@ -27,7 +27,7 @@ from paddle_tpu.core.module import Module, named_parameters, path_str
 
 __all__ = ["state_dict", "set_state_dict", "save_state_dict",
            "load_state_dict", "save_checkpoint", "load_checkpoint",
-           "wait_until_finished"]
+           "wait_until_finished", "reset_remote_cache"]
 
 
 # ---------------------------------------------------------------------------
@@ -113,9 +113,17 @@ _stager_cache: dict[str, Any] = {}
 def reset_remote_cache() -> None:
     """Drop the cached remote stagers (closing their connections) and
     orbax managers — the supported way to simulate/act out a fresh node
-    (a new process has empty caches anyway)."""
+    (a new process has empty caches anyway). Managers are drained and
+    closed first so an in-flight async local save can't still be
+    writing when a successor manager opens the same directory."""
     for stage in _stager_cache.values():
         stage.close()
+    for mgr in _manager_cache.values():
+        try:
+            mgr.wait_until_finished()
+            mgr.close()
+        except Exception:
+            pass   # draining a dead manager must not block the reset
     _stager_cache.clear()
     _manager_cache.clear()
 
