@@ -1,0 +1,28 @@
+"""Shared model-zoo pieces."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+
+
+def causal_lm_loss(model, head_weight, input_ids, labels,
+                   ignore_index: int = -100, training: bool = True):
+    """Next-token loss dispatch shared by the decoder-only families
+    (Llama/GPT/Mamba). ``cfg.lm_head_mode != "dense"`` fuses the head
+    projection into the loss (``F.next_token_linear_loss`` — the
+    [B, T, V] logits never materialize); otherwise the model's dense
+    ``__call__`` + sliced cross-entropy runs. ``head_weight`` is the
+    [E, V] projection (tied models pass ``embed.weight.T`` — unused,
+    hence DCE'd, on the dense path)."""
+    mode = getattr(model.config, "lm_head_mode", "dense")
+    if mode != "dense":
+        x = model.hidden_states(input_ids, training=training)
+        return F.next_token_linear_loss(x, head_weight, labels,
+                                        ignore_index=ignore_index,
+                                        mode=mode)
+    logits = model(input_ids, training=training)
+    return F.cross_entropy(
+        logits[:, :-1].astype(jnp.float32), labels[:, 1:],
+        ignore_index=ignore_index)

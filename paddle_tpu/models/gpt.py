@@ -129,19 +129,9 @@ class GPTForCausalLM(Module):
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
-        mode = getattr(self.config, "lm_head_mode", "dense")
-        if mode != "dense":
-            # fused lm-head⊗xent: the [B, T, 50304] logits never
-            # materialize (shared path with Llama)
-            x = self.hidden_states(input_ids, training=training)
-            return F.next_token_linear_loss(x, self.lm_head.weight,
-                                            labels,
-                                            ignore_index=ignore_index,
-                                            mode=mode)
-        logits = self(input_ids, training=training)
-        return F.cross_entropy(
-            logits[:, :-1].astype(jnp.float32), labels[:, 1:],
-            ignore_index=ignore_index)
+        from paddle_tpu.models._common import causal_lm_loss
+        return causal_lm_loss(self, self.lm_head.weight, input_ids,
+                              labels, ignore_index, training)
 
     def pipeline_parts(self):
         """1F1B decomposition (``parallel/pipeline_1f1b.py``): token+pos

@@ -360,24 +360,13 @@ class LlamaForCausalLM(Module):
         equal to inputs for standard LM training on packed sequences).
 
         With ``cfg.lm_head_mode != "dense"`` the head projection fuses
-        into the loss (``F.linear_cross_entropy``) so the [B, T, V]
-        logits never materialize. The loss then runs over all T rows
-        with the labels shifted left and the final position
-        ignore-masked — identical valid-row set (and mean) as the
-        ``logits[:, :-1]`` slice, but the row count stays a multiple of
-        the kernel row block."""
-        mode = getattr(self.config, "lm_head_mode", "dense")
-        if mode != "dense":
-            x = self.hidden_states(input_ids, training=training)
-            # tied embeddings: the [V, E] table transposes to the [E, V]
-            # kernel layout — one O(V·E) copy per step, still orders of
-            # magnitude below the O(N·V) logits the fusion removes
-            w = (self.lm_head.weight if self.lm_head is not None
-                 else self.embed.weight.T)
-            return F.next_token_linear_loss(x, w, labels,
-                                            ignore_index=ignore_index,
-                                            mode=mode)
-        logits = self(input_ids, training=training)
-        return F.cross_entropy(
-            logits[:, :-1].astype(jnp.float32), labels[:, 1:],
-            ignore_index=ignore_index)
+        into the loss so the [B, T, V] logits never materialize (shared
+        dispatch: ``models._common.causal_lm_loss``). Tied embeddings
+        pass the transposed [V, E] table — one O(V·E) copy per step,
+        orders of magnitude below the O(N·V) logits the fusion
+        removes."""
+        from paddle_tpu.models._common import causal_lm_loss
+        w = (self.lm_head.weight if self.lm_head is not None
+             else self.embed.weight.T)
+        return causal_lm_loss(self, w, input_ids, labels, ignore_index,
+                              training)

@@ -43,6 +43,9 @@ class MambaConfig:
     # chunked scan: peak memory drops T/chunk (see selective_scan); None =
     # one-shot scan (fine for short T, OOMs for T in the thousands)
     scan_chunk_size: int | None = 128
+    # LM-head loss path — see LlamaConfig.lm_head_mode (tied embeddings:
+    # the fused kernel reads the transposed table)
+    lm_head_mode: str = "dense"
 
     @property
     def inner_size(self) -> int:
@@ -199,14 +202,17 @@ class MambaForCausalLM(Module):
         self.norm = RMSNorm(cfg.hidden_size, dtype=dtype)
         self.config = cfg
 
-    def __call__(self, input_ids, training: bool = False):
+    def hidden_states(self, input_ids, training: bool = False):
         x = self.embed(input_ids)
         x = self.blocks(x, training=training)
-        x = self.norm(x)
+        return self.norm(x)
+
+    def __call__(self, input_ids, training: bool = False):
+        x = self.hidden_states(input_ids, training=training)
         return x @ self.embed.weight.T       # tied embeddings
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
-        logits = self(input_ids, training=training)
-        return F.cross_entropy(logits[:, :-1].astype(jnp.float32),
-                               labels[:, 1:], ignore_index=ignore_index)
+        from paddle_tpu.models._common import causal_lm_loss
+        return causal_lm_loss(self, self.embed.weight.T, input_ids,
+                              labels, ignore_index, training)

@@ -272,6 +272,26 @@ def test_gpt_loss_fused_path_matches_dense():
     np.testing.assert_allclose(float(fused), float(dense), rtol=1e-5)
 
 
+def test_mamba_tied_loss_fused_path_matches_dense():
+    """Tied-embedding models route the fused path through the
+    transposed table."""
+    import dataclasses
+
+    from paddle_tpu.models import MambaConfig, MambaForCausalLM
+
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    cfg = MambaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      dtype="float32", scan_chunk_size=None)
+    model = MambaForCausalLM(cfg)
+    rs = np.random.RandomState(6)
+    ids = jnp.asarray(rs.randint(0, 256, (2, 16)).astype(np.int32))
+    dense = model.loss(ids, ids, training=False)
+    model.config = dataclasses.replace(cfg, lm_head_mode="chunked")
+    fused = model.loss(ids, ids, training=False)
+    np.testing.assert_allclose(float(fused), float(dense), rtol=1e-5)
+
+
 def test_supported_gates():
     h = jnp.zeros((24, 128), jnp.float32)
     w = jnp.zeros((128, 384), jnp.float32)
