@@ -49,6 +49,9 @@ class MoEConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # "auto" → gather dispatch unless the mesh has a real ep axis
+    # (see nn/moe.py module docstring for the two dispatch forms)
+    dispatch_mode: str = "auto"
     # kept for LlamaAttention compatibility
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -82,7 +85,8 @@ class MoEBlock(Module):
                           cfg.num_experts, top_k=cfg.top_k,
                           capacity_factor=cfg.capacity_factor,
                           init_std=cfg.init_std,
-                          num_layers=cfg.num_layers, dtype=dtype, key=k2)
+                          num_layers=cfg.num_layers, dtype=dtype,
+                          dispatch_mode=cfg.dispatch_mode, key=k2)
 
     def __call__(self, x, training: bool = False):
         x = x + self.attn(self.attn_norm(x), training=training)

@@ -4,14 +4,32 @@ New capability beyond the reference snapshot (SURVEY.md §2.3.8 lists
 MoE/expert parallelism as absent upstream), built on the same mesh
 substrate as the other strategies.
 
-TPU-native design — GShard-style dense dispatch, not gather/scatter:
-token→expert routing is expressed as two einsums against a one-hot
-dispatch tensor, so every shape is static (XLA requirement) and the
-dispatch/combine contractions lower onto the MXU. Experts are stacked
-weights with a leading expert axis sharded ``P("ep", ...)``; a sharding
-constraint on the ``[E, C, H]`` expert buffers makes XLA insert the
-token all_to_all over ``ep`` — the hand-written NCCL AllToAll of
-GPU MoE frameworks, derived by the partitioner instead.
+TPU-native design — two dispatch modes sharing one routing core:
+
+- ``einsum`` (GShard dense dispatch): token→expert routing expressed as
+  two einsums against a one-hot dispatch tensor, so every shape is
+  static and the dispatch/combine contractions lower onto the MXU.
+  Experts are stacked weights with a leading expert axis sharded
+  ``P("ep", ...)``; a sharding constraint on the ``[E, C, H]`` expert
+  buffers makes XLA insert the token all_to_all over ``ep`` — the
+  hand-written NCCL AllToAll of GPU MoE frameworks, derived by the
+  partitioner instead. This is the mode that makes expert parallelism
+  work, but the dispatch/combine contractions cost ``O(N²·k·cf·H)``
+  matmul FLOPs — at large per-device token counts they rival the expert
+  matmuls themselves — and materialize two ``[N, E, C]`` one-hots.
+- ``gather`` (index dispatch): the same routing decisions expressed as
+  a row-index inverse map — a tiny int scatter builds ``slot→token``,
+  a row gather packs ``[E, C, H]`` expert inputs, and combine is a
+  k-row gather + weighted sum. Shapes stay static (capacity padding is
+  unchanged); the quadratic one-hot contractions and both ``[N, E, C]``
+  tensors disappear, replaced by bandwidth-bound row moves (the
+  embedding-lookup pattern XLA handles natively). This is the fast path
+  when experts are local (no ``ep`` axis, or ep size 1).
+
+``dispatch_mode="auto"`` picks ``gather`` unless the ambient mesh has a
+real ``ep`` axis (where the einsum form's derived all_to_all is load-
+bearing). Both modes produce identical routing (same capacity/drop
+semantics, same gates) — parity-tested in ``test_moe.py``.
 
 Load-balancing auxiliary loss follows Switch/GShard:
 ``aux = E * sum_e(frac_tokens_e * mean_gate_e)``.
@@ -30,7 +48,7 @@ from paddle_tpu.core.module import Module
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.initializer import Normal
 
-__all__ = ["MoEMLP", "top_k_routing"]
+__all__ = ["MoEMLP", "top_k_routing", "top_k_routing_compact"]
 
 
 def _constrain(x, spec: P):
@@ -49,6 +67,41 @@ def _constrain(x, spec: P):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def _route(logits, k: int, capacity: int):
+    """Shared routing core: softmax → sequential top-k picks with
+    per-expert slot assignment under capacity. Returns
+    ``(probs, rounds, aux_loss)`` where each round is a tuple of [N]
+    arrays ``(expert_idx, slot, keep, gate)`` — ``gate`` already zeroed
+    for dropped (over-capacity) picks."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    rounds = []
+    masked = probs
+    # claimed[e] tracking via cumulative one-hot counts across the k picks
+    prior = jnp.zeros((n, e), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                     # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # [N, E]
+        # position of each token within its chosen expert's buffer:
+        # tokens earlier in the batch claim earlier slots (cumsum), plus
+        # slots already used by previous routing rounds
+        pos = (jnp.cumsum(onehot, axis=0) - 1) + prior.sum(0)  # [N, E]
+        prior = prior + onehot
+        pos_t = jnp.sum(pos * onehot, axis=-1)                # [N]
+        keep = pos_t < capacity
+        gate = jnp.sum(probs * onehot, axis=-1) * keep        # [N]
+        rounds.append((idx, pos_t, keep, gate))
+        masked = masked * (1 - onehot)
+
+    # Switch aux loss: fraction of tokens per expert × mean router prob
+    frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac * mean_prob)
+    return probs, rounds, aux_loss
+
+
 def top_k_routing(logits, k: int, capacity: int):
     """Route tokens to top-k experts under a per-expert capacity.
 
@@ -64,40 +117,35 @@ def top_k_routing(logits, k: int, capacity: int):
       aux_loss: scalar load-balancing loss.
     """
     n, e = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    gates = jnp.zeros_like(probs)
-    masked = probs
+    probs, rounds, aux_loss = _route(logits, k, capacity)
     dispatch = jnp.zeros((n, e, capacity), probs.dtype)
     combine = jnp.zeros((n, e, capacity), probs.dtype)
-    # claimed[e] tracking via cumulative one-hot counts across the k picks
-    prior = jnp.zeros((n, e), jnp.int32)
-    for _ in range(k):
-        idx = jnp.argmax(masked, axis=-1)                     # [N]
-        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # [N, E]
-        # position of each token within its chosen expert's buffer:
-        # tokens earlier in the batch claim earlier slots (cumsum), plus
-        # slots already used by previous routing rounds
-        pos = (jnp.cumsum(onehot, axis=0) - 1) + prior.sum(0)  # [N, E]
-        prior = prior + onehot
-        pos_t = jnp.sum(pos * onehot, axis=-1)                # [N]
-        keep = pos_t < capacity
-        gate = jnp.sum(probs * onehot, axis=-1) * keep        # [N]
+    for idx, pos_t, keep, gate in rounds:
+        onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)    # [N, E]
         oh_pos = jax.nn.one_hot(pos_t, capacity,
                                 dtype=probs.dtype)            # [N, C]
-        d = (onehot.astype(probs.dtype)[:, :, None]
-             * oh_pos[:, None, :] * keep[:, None, None])
+        d = (onehot[:, :, None] * oh_pos[:, None, :]
+             * keep.astype(probs.dtype)[:, None, None])
         dispatch = dispatch + d
         combine = combine + d * gate[:, None, None]
-        gates = gates + probs * onehot
-        masked = masked * (1 - onehot)
-
-    # Switch aux loss: fraction of tokens per expert × mean router prob
-    frac = jnp.mean(
-        jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype), axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux_loss = e * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux_loss
+
+
+def top_k_routing_compact(logits, k: int, capacity: int):
+    """Index form of :func:`top_k_routing` — the same routing decisions
+    without the [N, E, C] one-hots.
+
+    Returns ``(expert, slot, keep, gate, aux_loss)``, each [N, k]:
+    ``expert[n, j]`` is the j-th pick's expert, ``slot[n, j]`` its
+    position in that expert's capacity buffer (may be ≥ capacity when
+    dropped), ``keep`` the in-capacity mask, and ``gate`` the softmax
+    gate weight (zero where dropped)."""
+    _, rounds, aux_loss = _route(logits, k, capacity)
+    expert = jnp.stack([r[0] for r in rounds], axis=1)
+    slot = jnp.stack([r[1] for r in rounds], axis=1)
+    keep = jnp.stack([r[2] for r in rounds], axis=1)
+    gate = jnp.stack([r[3] for r in rounds], axis=1)
+    return expert, slot, keep, gate, aux_loss
 
 
 class MoEMLP(Module):
@@ -110,7 +158,12 @@ class MoEMLP(Module):
     def __init__(self, hidden_size: int, intermediate_size: int,
                  num_experts: int, *, top_k: int = 2,
                  capacity_factor: float = 1.25, init_std: float = 0.02,
-                 num_layers: int = 1, dtype=jnp.float32, key=None):
+                 num_layers: int = 1, dtype=jnp.float32,
+                 dispatch_mode: str = "auto", key=None):
+        if dispatch_mode not in ("auto", "einsum", "gather"):
+            raise ValueError(
+                f"dispatch_mode must be auto|einsum|gather, got "
+                f"{dispatch_mode!r}")
         keys = rng.split_key(key, 4)
         E, H, I_ = num_experts, hidden_size, intermediate_size
         init = Normal(0.0, init_std)
@@ -129,11 +182,31 @@ class MoEMLP(Module):
         self.num_experts = E
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
+        self.dispatch_mode = dispatch_mode
 
     def capacity(self, n_tokens: int) -> int:
         c = int(math.ceil(n_tokens * self.top_k * self.capacity_factor
                           / self.num_experts))
         return max(c, self.top_k)
+
+    def _resolved_mode(self) -> str:
+        """Resolve ``auto`` at trace time against the ambient mesh: the
+        einsum form's derived all_to_all is load-bearing only when a
+        real ``ep`` axis exists; everywhere else the quadratic one-hot
+        contractions are pure overhead and ``gather`` wins."""
+        if self.dispatch_mode != "auto":
+            return self.dispatch_mode
+        from paddle_tpu.parallel.mesh import current_mesh
+        mesh = current_mesh()
+        if mesh is not None and dict(mesh.shape).get("ep", 1) > 1:
+            return "einsum"
+        return "gather"
+
+    def _experts(self, expert_in):
+        gate = jnp.einsum("ech,ehi->eci", expert_in, self.w_gate)
+        up = jnp.einsum("ech,ehi->eci", expert_in, self.w_up)
+        act = F.swiglu(up, gate)
+        return jnp.einsum("eci,eih->ech", act, self.w_down)
 
     def __call__(self, x):
         b, t, h = x.shape
@@ -143,21 +216,65 @@ class MoEMLP(Module):
 
         # router in fp32 for stable softmax (standard MoE practice)
         logits = tokens.astype(jnp.float32) @ self.router
+
+        mode = self._resolved_mode()
+        if mode == "gather":
+            out, aux = self._call_gather(tokens, logits, n, h, cap)
+        elif mode == "einsum":
+            out, aux = self._call_einsum(tokens, logits, n, h, cap)
+        else:
+            raise ValueError(f"unknown dispatch_mode {mode!r}")
+        return out.reshape(b, t, h), aux.astype(jnp.float32)
+
+    def _call_einsum(self, tokens, logits, n, h, cap):
         dispatch, combine, aux = top_k_routing(logits, self.top_k, cap)
-        dispatch = dispatch.astype(x.dtype)
-        combine = combine.astype(x.dtype)
+        dispatch = dispatch.astype(tokens.dtype)
+        combine = combine.astype(tokens.dtype)
 
         # dispatch: [N,H] x [N,E,C] -> [E,C,H]; the sharding constraint
         # makes the XLA partitioner materialize the ep all_to_all here
         expert_in = jnp.einsum("nh,nec->ech", tokens, dispatch)
         expert_in = _constrain(expert_in, P("ep", None, None))
 
-        gate = jnp.einsum("ech,ehi->eci", expert_in, self.w_gate)
-        up = jnp.einsum("ech,ehi->eci", expert_in, self.w_up)
-        act = F.swiglu(up, gate)
-        expert_out = jnp.einsum("eci,eih->ech", act, self.w_down)
+        expert_out = self._experts(expert_in)
         expert_out = _constrain(expert_out, P("ep", None, None))
 
         # combine (the return all_to_all): [E,C,H] x [N,E,C] -> [N,H]
         out = jnp.einsum("ech,nec->nh", expert_out, combine)
-        return out.reshape(b, t, h), aux.astype(jnp.float32)
+        return out, aux
+
+    def _call_gather(self, tokens, logits, n, h, cap):
+        e, k = self.num_experts, self.top_k
+        expert, slot, keep, gate, aux = top_k_routing_compact(
+            logits, k, cap)
+
+        # flat destination slot per (token, pick); dropped picks land in
+        # an out-of-bounds trash slot (served by fill-mode gathers below)
+        dest = jnp.where(keep, expert * cap + slot, e * cap)      # [N, k]
+        # inverse map slot→token: a tiny int scatter (destinations are
+        # unique by construction except the shared trash slot); the
+        # out-of-bounds sentinel n marks unfilled slots
+        src = jnp.full((e * cap + 1,), n, jnp.int32)
+        tok_idx = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[:, None], (n, k))
+        src = src.at[dest.reshape(-1)].set(tok_idx.reshape(-1))
+
+        # pack expert inputs with one row gather (embedding-lookup
+        # pattern; backward is the scatter-add of embedding grads).
+        # mode="fill" zero-fills the sentinel rows without materializing
+        # a padded copy of the token buffer, and its transpose drops the
+        # out-of-bounds cotangents
+        expert_in = jnp.take(tokens, src[:e * cap], axis=0,
+                             mode="fill", fill_value=0).reshape(e, cap, h)
+        expert_in = _constrain(expert_in, P("ep", None, None))
+
+        expert_out = self._experts(expert_in)
+        expert_out = _constrain(expert_out, P("ep", None, None))
+
+        # combine: k row gathers + gate-weighted sum (the trash slot is
+        # out of bounds → zero-filled, and its gate is already zero)
+        picked = jnp.take(expert_out.reshape(e * cap, h), dest.reshape(-1),
+                          axis=0, mode="fill",
+                          fill_value=0).reshape(n, k, h)
+        out = jnp.sum(picked * gate.astype(tokens.dtype)[..., None], axis=1)
+        return out, aux

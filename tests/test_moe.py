@@ -176,3 +176,55 @@ def test_moe_ep_fsdp_hybrid(devices8):
     assert w.sharding.spec[0] == "ep" and "fsdp" in str(w.sharding.spec)
     ref_losses, _ = run(DistributedStrategy())
     np.testing.assert_allclose(hybrid_losses, ref_losses, rtol=2e-4)
+
+
+def test_moe_dispatch_modes_match():
+    """gather (index) dispatch must reproduce the einsum (one-hot)
+    dispatch exactly — same routing core, same capacity/drop semantics —
+    for outputs AND gradients, including with overflow drops."""
+    paddle_tpu.seed(7)
+    H, I_, E = 16, 32, 4
+    # capacity_factor 0.6 forces real drops at top-2
+    kw = dict(top_k=2, capacity_factor=0.6)
+    moe_e = MoEMLP(H, I_, E, dispatch_mode="einsum", **kw)
+    moe_g = moe_e.replace(dispatch_mode="gather")
+
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 24, H)
+                    .astype(np.float32))
+
+    def loss(m, x):
+        out, aux = m(x)
+        return jnp.sum(out ** 2) + aux, out
+
+    (l_e, out_e), g_e = jax.value_and_grad(loss, argnums=(0, 1),
+                                           has_aux=True)(moe_e, x)
+    (l_g, out_g), g_g = jax.value_and_grad(loss, argnums=(0, 1),
+                                           has_aux=True)(moe_g, x)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_e),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(l_g), float(l_e), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_e), jax.tree.leaves(g_g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_moe_auto_mode_resolution():
+    """auto → gather off-mesh / on an ep-less mesh; einsum when the mesh
+    has a real ep axis."""
+    moe = MoEMLP(8, 16, 2)
+    assert moe.dispatch_mode == "auto"
+    assert moe._resolved_mode() == "gather"
+    mesh = M.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    with M.MeshContext(mesh):
+        assert moe._resolved_mode() == "gather"
+
+
+def test_moe_auto_mode_picks_einsum_under_ep(devices8):
+    from paddle_tpu.core.strategy import DistributedStrategy as DS
+    s = DS()
+    s.expert_parallel.enable = True
+    s.expert_parallel.degree = 4
+    mesh = M.mesh_from_strategy(s)
+    moe = MoEMLP(8, 16, 4)
+    with M.MeshContext(mesh):
+        assert moe._resolved_mode() == "einsum"
