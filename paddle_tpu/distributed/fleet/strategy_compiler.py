@@ -118,11 +118,19 @@ def build_train_step(model, optimizer, loss_fn=None, *,
     if mesh is None:
         from paddle_tpu.parallel.mesh import get_mesh
         mesh = get_mesh()
+    if strategy.localsgd.enable and strategy.dgc.enable:
+        raise ValueError(
+            "localsgd and dgc are mutually exclusive comm-reduction "
+            "strategies (pick one)")
     if strategy.localsgd.enable:
         from paddle_tpu.parallel.localsgd import build_localsgd_step
         return build_localsgd_step(model, optimizer, loss_fn,
                                    strategy=strategy, mesh=mesh,
                                    donate=donate)
+    if strategy.dgc.enable:
+        from paddle_tpu.parallel.dgc import build_dgc_step
+        return build_dgc_step(model, optimizer, loss_fn,
+                              strategy=strategy, mesh=mesh, donate=donate)
 
     far_cfg = strategy.fp16_allreduce
     use_fp16_ar = far_cfg.enable
