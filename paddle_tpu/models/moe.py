@@ -52,7 +52,7 @@ class MoEConfig:
     # "auto" → gather dispatch unless the mesh has a real ep axis
     # (see nn/moe.py module docstring for the two dispatch forms)
     dispatch_mode: str = "auto"
-    # kept for LlamaAttention compatibility
+    # per-block remat of the python-loop blocks (expert buffers included)
     remat: bool = False
     remat_policy: str = "nothing_saveable"
 
@@ -118,8 +118,18 @@ class MoEForCausalLM(Module):
     def forward_with_aux(self, input_ids, training: bool = False):
         x = self.embed(input_ids)
         aux_total = jnp.zeros((), jnp.float32)
+        blk_fn = lambda b, h: b(h, training=training)
+        if self.config.remat:
+            # per-block remat (the python-loop analogue of ScannedBlocks'
+            # checkpointed scan body): activations of each MoE block —
+            # including the [E, C, H/I] expert buffers — are recomputed
+            # in backward under the configured policy
+            import jax as _jax
+            from paddle_tpu.nn.scan import REMAT_POLICIES
+            blk_fn = _jax.checkpoint(
+                blk_fn, policy=REMAT_POLICIES[self.config.remat_policy])
         for block in self.blocks:
-            x, aux = block(x, training=training)
+            x, aux = blk_fn(block, x)
             aux_total = aux_total + aux
         logits = self.lm_head(self.norm(x))
         return logits, aux_total / max(len(self.blocks), 1)

@@ -228,3 +228,28 @@ def test_moe_auto_mode_picks_einsum_under_ep(devices8):
     moe = MoEMLP(8, 16, 4)
     with M.MeshContext(mesh):
         assert moe._resolved_mode() == "einsum"
+
+
+def test_moe_remat_matches_no_remat():
+    """Per-block remat (python-loop checkpoint) is a pure memory/FLOPs
+    trade: losses must match the non-remat forward exactly."""
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 256, (4, 16)).astype(np.int32))
+
+    def losses(remat):
+        paddle_tpu.seed(3)
+        cfg = MoEConfig.tiny(remat=remat)
+        model = MoEForCausalLM(cfg)
+        mesh = M.create_mesh({"dp": 1}, devices=jax.devices()[:1])
+        with M.MeshContext(mesh):
+            step = dist.fleet.build_train_step(
+                model, optimizer=optim.AdamW(1e-2), mesh=mesh)
+            state = step.init_state(model)
+            batch = step.shard_batch({"input_ids": ids, "labels": ids})
+            out = []
+            for i in range(3):
+                state, m = step(state, batch, jax.random.PRNGKey(i))
+                out.append(float(m["loss"]))
+        return out
+
+    np.testing.assert_allclose(losses(True), losses(False), rtol=1e-6)
