@@ -26,10 +26,25 @@ TPU-native design — two dispatch modes sharing one routing core:
   embedding-lookup pattern XLA handles natively). This is the fast path
   when experts are local (no ``ep`` axis, or ep size 1).
 
+- ``gather_grouped`` (opt-in, for expert parallelism at scale): tokens
+  reshaped into G batch-shard groups, routing vmapped per group (the
+  position cumsum becomes group-local — under a dp-sharded batch the
+  global-N cumsum of the other modes forces cross-shard prefix sums),
+  each group gather-packs a ``[E, C/G, H]`` buffer, and one transpose
+  with an ``ep`` sharding constraint is the dp→ep all_to_all, derived
+  by the partitioner exactly like the einsum mode's — but with no
+  ``[N, E, C]`` one-hots at any point. Capacity is per group (each
+  group owns a C/G quota per expert — GShard's real grouping
+  semantics), so drop behavior differs from the global-capacity modes
+  when load is uneven across groups; with ample capacity all three
+  modes agree exactly.
+
 ``dispatch_mode="auto"`` picks ``gather`` unless the ambient mesh has a
-real ``ep`` axis (where the einsum form's derived all_to_all is load-
-bearing). Both modes produce identical routing (same capacity/drop
-semantics, same gates) — parity-tested in ``test_moe.py``.
+real ``ep`` axis (where a derived all_to_all is load-bearing; the
+global-capacity einsum form keeps the long-standing parity contract).
+``einsum``/``gather`` produce identical routing (same capacity/drop
+semantics, same gates) — parity-tested in ``test_moe.py``, as is the
+ample-capacity three-way agreement.
 
 Load-balancing auxiliary loss follows Switch/GShard:
 ``aux = E * sum_e(frac_tokens_e * mean_gate_e)``.
@@ -94,12 +109,17 @@ def _route(logits, k: int, capacity: int):
         rounds.append((idx, pos_t, keep, gate))
         masked = masked * (1 - onehot)
 
-    # Switch aux loss: fraction of tokens per expert × mean router prob
+    return probs, rounds, _switch_aux_loss(probs)
+
+
+def _switch_aux_loss(probs):
+    """Switch aux loss: fraction of tokens per expert × mean router
+    prob, over whatever token population ``probs`` covers."""
+    e = probs.shape[-1]
     frac = jnp.mean(
         jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
-    aux_loss = e * jnp.sum(frac * mean_prob)
-    return probs, rounds, aux_loss
+    return e * jnp.sum(frac * mean_prob)
 
 
 def top_k_routing(logits, k: int, capacity: int):
@@ -160,10 +180,11 @@ class MoEMLP(Module):
                  capacity_factor: float = 1.25, init_std: float = 0.02,
                  num_layers: int = 1, dtype=jnp.float32,
                  dispatch_mode: str = "auto", key=None):
-        if dispatch_mode not in ("auto", "einsum", "gather"):
+        if dispatch_mode not in ("auto", "einsum", "gather",
+                                 "gather_grouped"):
             raise ValueError(
-                f"dispatch_mode must be auto|einsum|gather, got "
-                f"{dispatch_mode!r}")
+                f"dispatch_mode must be auto|einsum|gather|gather_grouped,"
+                f" got {dispatch_mode!r}")
         keys = rng.split_key(key, 4)
         E, H, I_ = num_experts, hidden_size, intermediate_size
         init = Normal(0.0, init_std)
@@ -220,6 +241,8 @@ class MoEMLP(Module):
         mode = self._resolved_mode()
         if mode == "gather":
             out, aux = self._call_gather(tokens, logits, n, h, cap)
+        elif mode == "gather_grouped":
+            out, aux = self._call_gather_grouped(tokens, logits, n, h)
         elif mode == "einsum":
             out, aux = self._call_einsum(tokens, logits, n, h, cap)
         else:
@@ -278,3 +301,73 @@ class MoEMLP(Module):
                           fill_value=0).reshape(n, k, h)
         out = jnp.sum(picked * gate.astype(tokens.dtype)[..., None], axis=1)
         return out, aux
+
+    def _groups(self, n: int) -> int:
+        """Group count for gather_grouped: the mesh's batch-sharding
+        degree (dp·fsdp), so each group is one data shard and the
+        vmapped routing never crosses shards. Falls back toward 1 when
+        the token count doesn't divide."""
+        from paddle_tpu.parallel.mesh import BATCH_AXES, current_mesh
+        mesh = current_mesh()
+        g = 1
+        if mesh is not None:
+            shape = dict(mesh.shape)
+            for ax in BATCH_AXES:
+                g *= shape.get(ax, 1)
+        # largest group count that both aligns with the batch shards and
+        # divides the token count (gcd — a halving loop would skip valid
+        # divisors for non-power-of-2 degrees)
+        return max(math.gcd(g, n), 1)
+
+    def _call_gather_grouped(self, tokens, logits, n, h):
+        """Per-group gather dispatch for expert parallelism: G groups of
+        n/G tokens each own a capacity(n/G) quota per expert. The
+        [G, E, Cg, H] ↔ [E, G, Cg, H] transposes under the dp/ep
+        sharding constraints ARE the token all_to_all, derived by the
+        partitioner — same collective role as the einsum mode's, with
+        no [N, E, C] one-hots anywhere."""
+        e, k = self.num_experts, self.top_k
+        g = self._groups(n)
+        ng = n // g
+        cg = self.capacity(ng)
+        t_g = tokens.reshape(g, ng, h)
+        l_g = logits.reshape(g, ng, e)
+
+        expert, slot, keep, gate, _ = jax.vmap(
+            lambda lg: top_k_routing_compact(lg, k, cg))(l_g)
+        # aux stays GLOBAL (same population as the other modes) — the
+        # grouping only changes capacity quotas, not the balance target
+        aux = _switch_aux_loss(jax.nn.softmax(logits, axis=-1))
+
+        dest = jnp.where(keep, expert * cg + slot, e * cg)    # [G, ng, k]
+        tok_idx = jnp.broadcast_to(
+            jnp.arange(ng, dtype=jnp.int32)[None, :, None], (g, ng, k))
+        src = jnp.full((g, e * cg + 1), ng, jnp.int32)
+        src = jax.vmap(lambda s, d, t: s.at[d.reshape(-1)]
+                       .set(t.reshape(-1)))(src, dest, tok_idx)
+
+        packed = jax.vmap(lambda tg, sg: jnp.take(
+            tg, sg[:e * cg], axis=0, mode="fill", fill_value=0))(t_g, src)
+        from paddle_tpu.parallel.mesh import BATCH_AXES
+        packed = packed.reshape(g, e, cg, h)
+        # double-sharded staging block: each (batch-shard, ep) device
+        # holds its (group, expert-shard) tile — the constraint pair
+        # makes the partitioner emit the direct batch→ep exchange. The
+        # group axis must name ALL batch axes (groups come from
+        # dp·fsdp), or an fsdp-sharded batch gets gathered whole
+        packed = _constrain(packed, P(BATCH_AXES, "ep", None, None))
+        expert_in = packed.transpose(1, 0, 2, 3).reshape(e, g * cg, h)
+        expert_in = _constrain(expert_in, P("ep", None, None))
+
+        expert_out = self._experts(expert_in)
+        expert_out = _constrain(expert_out, P("ep", None, None))
+
+        back = expert_out.reshape(e, g, cg, h).transpose(1, 0, 2, 3)
+        back = _constrain(back, P(BATCH_AXES, "ep", None, None))
+        picked = jax.vmap(lambda rows, d: jnp.take(
+            rows.reshape(e * cg, h), d.reshape(-1), axis=0, mode="fill",
+            fill_value=0))(back, dest)                  # [G, ng*k, H]
+        picked = picked.reshape(g, ng, k, h)
+        out = jnp.sum(picked * gate.astype(tokens.dtype)[..., None],
+                      axis=2)
+        return out.reshape(n, h), aux

@@ -26,6 +26,7 @@ def run_example(name, *args):
     ("serve_model.py", ("--steps", "120")),
     ("long_context_sp.py", ("--steps", "4", "--seq", "256")),
     ("elastic_remote_ckpt.py", ("--epochs", "4", "--steps", "3")),
+    ("dgc_dcn.py", ("--steps", "8")),
 ])
 def test_example_runs(script, args):
     proc = run_example(script, *args)
