@@ -49,6 +49,8 @@ def main():
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--sparsity", type=float, default=0.99)
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -105,7 +107,7 @@ def main():
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(
             jax.eval_shape(lambda: LlamaForCausalLM(cfg)))
-        if hasattr(l, "shape") and l.size >= 1024)
+        if hasattr(l, "shape") and l.size >= s.dgc.dense_size_threshold)
     dense_bytes = n_params * 4
     sparse_bytes = int(n_params * (1 - args.sparsity)) * 8
     print(f"\nfinal loss: dgc={dgc_loss:.4f} vs dense dp={dp_loss:.4f}")
