@@ -127,24 +127,32 @@ def main(only: str | None = None):
         dids = jnp.asarray(np.random.RandomState(0).randint(
             0, dcfg.vocab_size, (db, prompt_len)).astype(np.int32))
 
-        gen = jax.jit(lambda m, ids: generate(m, ids, new_toks))
-        out = gen(dmodel, dids)
-        np.asarray(out)                                   # compile + run
-        # time WITH a host fetch per rep: through the tunnel plugin,
-        # block_until_ready alone can report dispatch-only time for
-        # repeated identical executions (measured: 0.2ms vs the real
-        # 4.3s) — fetching the tokens is the unambiguous barrier
-        reps = 3
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = np.asarray(gen(dmodel, dids))
-        dt = (time.perf_counter() - t0) / reps
-        assert out.shape == (db, prompt_len + new_toks)
+        def decode_rate(model):
+            gen = jax.jit(lambda m, ids: generate(m, ids, new_toks))
+            out = gen(model, dids)
+            np.asarray(out)                               # compile + run
+            # time WITH a host fetch per rep: through the tunnel plugin,
+            # block_until_ready alone can report dispatch-only time for
+            # repeated identical executions (measured: 0.2ms vs the
+            # real 4.3s) — fetching the tokens is the barrier
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = np.asarray(gen(model, dids))
+            dt = (time.perf_counter() - t0) / reps
+            assert out.shape == (db, prompt_len + new_toks)
+            return db * new_toks / dt
+
+        from paddle_tpu.quant import quantize_weights_int8
+
+        bf16_rate = decode_rate(dmodel)
+        int8_rate = decode_rate(quantize_weights_int8(dmodel))
         print(json.dumps({
             "model": "llama-953M-decode",
             "params_m": round(dcfg.num_params() / 1e6, 1),
-            "decode_tokens_per_sec": round(db * new_toks / dt, 1),
-            "tokens_per_sec_per_seq": round(new_toks / dt, 1),
+            "decode_tokens_per_sec": round(bf16_rate, 1),
+            "tokens_per_sec_per_seq": round(bf16_rate / db, 1),
+            "int8_weight_only_tokens_per_sec": round(int8_rate, 1),
             "batch": db, "new_tokens": new_toks}), flush=True)
 
     # ERNIE base MLM (encoder side)

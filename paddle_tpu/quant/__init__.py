@@ -19,9 +19,13 @@ from paddle_tpu.quant.qat import (
 from paddle_tpu.quant.ptq import (
     Int8Linear, calibrate, convert_to_int8, int8_state_dict,
 )
+from paddle_tpu.quant.weight_only import (
+    WeightOnlyInt8Linear, quantize_weights_int8,
+)
 
 __all__ = ["functional", "fake_quant", "fake_quant_abs_max",
            "fake_channel_wise_quant_abs_max", "moving_average_abs_max_scale",
            "quant_max", "QuantConfig", "QuantedLinear", "QuantedConv2D",
            "quantize_model", "calibrate", "convert_to_int8", "Int8Linear",
-           "int8_state_dict"]
+           "int8_state_dict", "WeightOnlyInt8Linear",
+           "quantize_weights_int8"]

@@ -76,3 +76,21 @@ def moving_average_abs_max_scale(x, running_scale, momentum: float = 0.9):
     running scale (stop-grad)."""
     now = jnp.max(jnp.abs(jax.lax.stop_gradient(x)))
     return momentum * running_scale + (1.0 - momentum) * now
+
+
+def channelwise_int8_freeze(w, *, axis: int = -2, qmax: int = 127):
+    """Symmetric per-channel int8 freeze: returns ``(wq int8, scale)``
+    with ``dequant = wq * scale`` and ``scale = absmax/qmax`` reduced
+    over ``axis`` (every axis except the channel axes). The elementwise
+    error is bounded by ``scale/2``.
+
+    This is the same quantization grid ``ptq.convert_to_int8`` freezes
+    on — ptq stores the UN-normalized absmax as its ``w_scale`` (the
+    QAT fake-quant convention, divided by qmax at dequant and in
+    ``int8_state_dict``), while this helper returns the ready-to-use
+    dequant scale. Keep the two in sync through this docstring."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=axis), 1e-8) / qmax
+    wq = jnp.clip(jnp.round(w32 / jnp.expand_dims(scale, axis)),
+                  -qmax, qmax).astype(jnp.int8)
+    return wq, scale

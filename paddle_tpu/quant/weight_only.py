@@ -1,0 +1,111 @@
+"""Weight-only int8 quantization for serving/decode.
+
+Reference context: the reference's slim/quantization stack
+(``fluid/contrib/slim/quantization/``) is built around fake-quant +
+freeze for int8 *compute* (matched here by ``quant/qat.py`` +
+``quant/ptq.py``). Weight-only quantization is the serving-era
+complement this framework adds for autoregressive decode on TPU:
+decode is HBM-bandwidth-bound (every generated token re-reads all
+weights), so storing weights int8 halves the dominant traffic while
+keeping activations and accumulation in bf16/f32 — no calibration data,
+no activation-scale bookkeeping, near-lossless per-channel rounding.
+
+Design notes:
+- Per-output-channel symmetric scales. The scale is applied AFTER the
+  contraction — ``x @ (q·s) == (x @ q)·s`` for a per-out-channel ``s``
+  — so the matmul's rhs is a bare ``convert(int8)`` that XLA fuses into
+  the dot's operand stream (no dequantized [in, out] copy in HBM).
+- ``quantize_weights_int8`` is a model transform (``map_modules``): any
+  ``nn.Linear`` becomes a ``WeightOnlyInt8Linear`` with the same call
+  contract and the same partition specs (weight spec carries over;
+  the scale inherits the output-dim axis), so TP-sharded decode works
+  unchanged. Embeddings are left alone (a gather reads one row per
+  token — not the bandwidth problem).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.module import Module
+from paddle_tpu.nn.common import Linear
+from paddle_tpu.nn.stateful import map_modules
+
+__all__ = ["WeightOnlyInt8Linear", "quantize_weights_int8"]
+
+
+class WeightOnlyInt8Linear(Module):
+    """Drop-in Linear with int8-stored weights and bf16/f32 compute."""
+
+    _nontrainable = ("weight_q", "w_scale")
+
+    def __init__(self, weight_q, w_scale, bias, compute_dtype,
+                 pspecs=None):
+        self.weight_q = weight_q          # int8 [in, out]
+        self.w_scale = w_scale            # stored in the compute dtype [out]
+        self.bias = bias
+        self.compute_dtype = jnp.dtype(compute_dtype).name
+        if pspecs is not None:
+            self._pspecs = pspecs
+
+    @property
+    def weight(self):
+        """Dequantized weight — keeps consumers that read
+        ``linear.weight`` working (tied-embedding losses, FLOPs
+        counters); prefer ``__call__`` on hot paths (this materializes
+        the full matrix)."""
+        dt = jnp.dtype(self.compute_dtype)
+        return (self.weight_q.astype(dt)
+                * self.w_scale.astype(dt)[..., None, :])
+
+    def __call__(self, x):
+        from paddle_tpu import amp as amp_mod
+
+        # honor an active autocast scope the way F.linear's allow-list
+        # cast does; otherwise compute in the quantized model's dtype
+        dt = amp_mod.active_dtype("linear") or jnp.dtype(self.compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt:
+            x = x.astype(dt)
+        y = jnp.dot(x, self.weight_q.astype(dt)) * self.w_scale.astype(dt)
+        if self.bias is not None:
+            y = y + self.bias.astype(dt)
+        return y
+
+
+def quantize_weights_int8(model):
+    """Quantize every ``nn.Linear`` in ``model`` to weight-only int8
+    (per-output-channel symmetric). Returns a new model; the original
+    is untouched. Typically applied to a trained/loaded model right
+    before ``models.generation.generate`` or a Predictor export."""
+
+    from paddle_tpu.quant.functional import channelwise_int8_freeze
+
+    def fn(m):
+        if not isinstance(m, Linear):
+            return m
+        w = m.weight
+        # reduce over the input dim (axis -2): per-output-channel scales,
+        # and scan-stacked Linears ([L, in, out] weights inside
+        # ScannedBlocks) keep their leading layer axis on every leaf.
+        # The scale is rounded to the model dtype BEFORE quantizing, so
+        # dequant with the stored (bf16) scale stays on the freeze grid
+        # and the scale/2 error bound holds for bf16 models too
+        scale = channelwise_int8_freeze(w, axis=-2)[1].astype(w.dtype)
+        wq = jnp.clip(
+            jnp.round(w.astype(jnp.float32)
+                      / scale.astype(jnp.float32)[..., None, :]),
+            -127, 127).astype(jnp.int8)
+        pspecs = None
+        if hasattr(m, "_pspecs"):
+            by_name = dict(m._pspecs)
+            wspec = by_name.get("weight")
+            out_axis = (wspec[-1] if wspec is not None and len(wspec) >= 2
+                        else None)
+            pspecs = (("weight_q", wspec) if wspec is not None
+                      else ("weight_q", P(None, None)),
+                      ("w_scale", P(out_axis)),
+                      ("bias", by_name.get("bias", P(out_axis))))
+        return WeightOnlyInt8Linear(wq, scale, m.bias, w.dtype, pspecs)
+
+    return map_modules(fn, model)

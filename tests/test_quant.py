@@ -13,7 +13,7 @@ import pytest
 
 import paddle_tpu
 from paddle_tpu import nn, quant
-from paddle_tpu.quant import QuantConfig
+from paddle_tpu.quant import QuantConfig, quantize_weights_int8
 
 
 def test_fake_quant_grid_and_error():
@@ -127,3 +127,99 @@ def test_int8_dot_general_runs_int32_accum():
     hlo = jax.jit(lambda m, x: m(x)).lower(
         q, jnp.ones((4, 16))).as_text()
     assert "i8" in hlo and "i32" in hlo, hlo[:500]
+
+
+def test_weight_only_int8_linear_accuracy_and_bound():
+    """Per-channel weight-only int8: elementwise dequant error bounded
+    by scale/2, output relative error small (no calibration needed)."""
+    import paddle_tpu
+
+    paddle_tpu.seed(0)
+    lin = nn.Linear(64, 32)
+    q = quant.quantize_weights_int8(lin)
+    assert isinstance(q, quant.WeightOnlyInt8Linear)
+    assert q.weight_q.dtype == jnp.int8
+    deq = q.weight_q.astype(jnp.float32) * q.w_scale
+    err = np.abs(np.asarray(deq) - np.asarray(lin.weight))
+    bound = np.asarray(q.w_scale)[None, :] / 2 + 1e-7
+    assert (err <= bound).all()
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 64)
+                    .astype(np.float32))
+    rel = (np.linalg.norm(np.asarray(q(x)) - np.asarray(lin(x)))
+           / np.linalg.norm(np.asarray(lin(x))))
+    assert rel < 0.02, rel
+
+
+def test_weight_only_int8_scan_stacked_model_generates():
+    """quantize_weights_int8 over a scan-stacked llama: every stacked
+    leaf keeps its leading layer axis (the scan contract), logits stay
+    close, and the jitted KV-cache generate runs on the quantized
+    model."""
+    import paddle_tpu
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=64, num_layers=2,
+                           num_heads=4, num_kv_heads=4, max_seq_len=64)
+    m = LlamaForCausalLM(cfg)
+    qm = quantize_weights_int8(m)
+    wq = qm.blocks.block.attn.wq.weight_q
+    assert wq.shape[0] == 2 and wq.dtype == jnp.int8
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 8))
+                      .astype(np.int32))
+    lo, lq = m(ids), qm(ids)
+    rel = (np.linalg.norm(np.asarray(lq - lo, dtype=np.float32))
+           / np.linalg.norm(np.asarray(lo, dtype=np.float32)))
+    assert rel < 0.05, rel
+    out = np.asarray(jax.jit(lambda mm, i: generate(mm, i, 8))(qm, ids))
+    assert out.shape == (2, 16)
+    assert (out[:, :8] == np.asarray(ids)).all()
+
+
+def test_weight_only_int8_preserves_tp_pspecs():
+    from jax.sharding import PartitionSpec as P
+
+    lin = nn.Linear(16, 8, pspec=P(None, "tp"))
+    q = quant.quantize_weights_int8(lin)
+    specs = dict(q._pspecs)
+    assert specs["weight_q"] == P(None, "tp")
+    assert specs["w_scale"] == P("tp")
+
+
+def test_weight_only_int8_bf16_grid_and_weight_property():
+    """bf16 model: quantization happens against the bf16-rounded scale,
+    so dequant with the stored scale keeps the scale/2 bound; the
+    .weight property serves consumers that read linear.weight (e.g.
+    model.loss on a quantized causal LM)."""
+    import paddle_tpu
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(1)
+    lin = nn.Linear(32, 16, dtype=jnp.bfloat16)
+    q = quant.quantize_weights_int8(lin)
+    deq = np.asarray(q.weight_q.astype(jnp.float32)
+                     * np.asarray(q.w_scale, dtype=np.float32)[None, :])
+    err = np.abs(deq - np.asarray(lin.weight, dtype=np.float32))
+    bound = np.asarray(q.w_scale, dtype=np.float32)[None, :] / 2 + 1e-7
+    assert (err <= bound).all()
+    assert q.weight.shape == (32, 16)
+
+    cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=32)
+    qm = quant.quantize_weights_int8(LlamaForCausalLM(cfg))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 8))
+                      .astype(np.int32))
+    loss = qm.loss(ids, ids, training=False)
+    assert np.isfinite(float(loss))
+
+
+def test_weight_only_int8_honors_autocast():
+    from paddle_tpu import amp
+
+    lin = nn.Linear(16, 8, dtype=jnp.float32)
+    q = quant.quantize_weights_int8(lin)
+    x = jnp.ones((2, 16), jnp.float32)
+    assert q(x).dtype == jnp.float32
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        assert q(x).dtype == jnp.bfloat16
