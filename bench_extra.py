@@ -79,8 +79,10 @@ def main(only: str | None = None):
         # Mamba (Pallas selective-scan kernel; per-layer remat)
         mcfg = MambaConfig(vocab_size=50304, hidden_size=1024,
                            num_layers=24, dtype="bfloat16", remat=True)
-        n = 50304 * 1024 * 2 + 24 * 6 * 1024 * 2048
-        lm_bench("mamba-0.3B", MambaForCausalLM(mcfg), 50304, 8, 2048, n)
+        # exact count (tied embedding once) — the old 405M estimate
+        # double-counted the tied table; true size is ~212M
+        lm_bench("mamba-0.2B", MambaForCausalLM(mcfg), 50304, 8, 2048,
+                 mcfg.num_params())
 
     if want("moe"):
         # MoE (8 experts, ~4x active sparsity)
@@ -153,6 +155,23 @@ def main(only: str | None = None):
             "decode_tokens_per_sec": round(bf16_rate, 1),
             "tokens_per_sec_per_seq": round(bf16_rate / db, 1),
             "int8_weight_only_tokens_per_sec": round(int8_rate, 1),
+            "batch": db, "new_tokens": new_toks}), flush=True)
+
+        # Mamba stateful decode: the recurrent O(1)-per-token path — no
+        # KV cache growth, constant state (conv tail + [Ei, N] SSM
+        # state per layer), so per-token cost is flat in context length
+        from paddle_tpu.models import MambaConfig, MambaForCausalLM
+
+        mdcfg = MambaConfig(vocab_size=50304, hidden_size=1024,
+                            num_layers=24, dtype="bfloat16")
+        _pt.seed(0)
+        mmodel = MambaForCausalLM(mdcfg)
+        mam_rate = decode_rate(mmodel)
+        print(json.dumps({
+            "model": "mamba-0.2B-decode",
+            "params_m": round(mdcfg.num_params() / 1e6, 1),
+            "decode_tokens_per_sec": round(mam_rate, 1),
+            "tokens_per_sec_per_seq": round(mam_rate / db, 1),
             "batch": db, "new_tokens": new_toks}), flush=True)
 
     # ERNIE base MLM (encoder side)

@@ -62,8 +62,22 @@ class MambaConfig:
         base.update(kw)
         return cls(**base)
 
+    def num_params(self) -> int:
+        """Exact parameter count (embeddings are TIED — counted once)."""
+        E, Ei, N, R = (self.hidden_size, self.inner_size,
+                       self.state_size, self.rank)
+        per_layer = (E * 2 * Ei                     # in_proj
+                     + Ei * self.conv_kernel + Ei   # conv w + b
+                     + Ei * (R + 2 * N)             # x_proj
+                     + R * Ei + Ei                  # dt_proj w + b
+                     + Ei * N + Ei                  # A_log + D
+                     + Ei * E                       # out_proj
+                     + E)                           # norm
+        return self.vocab_size * E + self.num_layers * per_layer + E
 
-def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
+
+def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None,
+                   return_state: bool = False, initial_state=None):
     """y = SSM(u) via parallel associative scan.
 
     u:[B,T,Ei] delta:[B,T,Ei] A:[Ei,N] B,C:[B,T,N] D:[Ei]
@@ -76,6 +90,10 @@ def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
     by T/k at one extra sequential dimension — the memory shape a long-
     context Mamba needs, kept XLA-fusible (no hand-written kernel; the
     within-chunk scan fuses into large elementwise blocks on the VPU).
+
+    ``return_state=True`` additionally returns the final recurrent state
+    ``h_T [B, Ei, N]``; ``initial_state`` seeds ``h_0`` (both = the
+    decode/prefill handoff).
     """
     if chunk_size is None or chunk_size >= u.shape[1]:
         dA = jnp.exp(delta[..., None] * A)                   # [B,T,Ei,N]
@@ -86,9 +104,13 @@ def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
             a2, b2 = right
             return a1 * a2, a2 * b1 + b2
 
-        _, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+        cumA, h = jax.lax.associative_scan(combine, (dA, dBu), axis=1)
+        if initial_state is not None:
+            # h_t += (prod_{<=t} dA) * h_0 — linearity of the recurrence
+            h = h + cumA * initial_state[:, None]
         y = jnp.einsum("btin,btn->bti", h, C)
-        return y + u * D
+        y = y + u * D
+        return (y, h[:, -1]) if return_state else y
 
     Bsz, T, Ei = u.shape
     k = int(chunk_size)
@@ -114,15 +136,16 @@ def selective_scan(u, delta, A, B, C, D, chunk_size: int | None = None):
         return jnp.moveaxis(
             x.reshape(Bsz, T // k, k, *x.shape[2:]), 1, 0)   # [nc,B,k,...]
 
-    h0 = jnp.zeros((Bsz, Ei, A.shape[-1]), u.dtype)
+    h0 = (initial_state if initial_state is not None
+          else jnp.zeros((Bsz, Ei, A.shape[-1]), u.dtype))
     # per-chunk remat: without it the backward saves every chunk's scan
     # internals ([nc, B, k, Ei, N] — the full unchunked footprint again);
     # recomputing one chunk in backward keeps live memory at [B, k, Ei, N]
-    _, ys = jax.lax.scan(jax.checkpoint(chunk_step, prevent_cse=False),
-                         h0, (to_chunks(u), to_chunks(delta),
-                              to_chunks(B), to_chunks(C)))
-    y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, T, Ei)
-    return y + u * D
+    h_last, ys = jax.lax.scan(jax.checkpoint(chunk_step, prevent_cse=False),
+                              h0, (to_chunks(u), to_chunks(delta),
+                                   to_chunks(B), to_chunks(C)))
+    y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, T, Ei) + u * D
+    return (y, h_last) if return_state else y
 
 
 class MambaBlock(Module):
@@ -150,43 +173,113 @@ class MambaBlock(Module):
         self.conv_kernel = cfg.conv_kernel
         self.scan_chunk_size = cfg.scan_chunk_size
 
-    def __call__(self, x, training: bool = False):
-        residual = x
-        x = self.norm(x)
-        xz = self.in_proj(x)
-        u, z = jnp.split(xz, 2, axis=-1)                     # [B,T,Ei]
-        # causal depthwise conv over time
-        K = self.conv_kernel
-        pad = jnp.pad(u, ((0, 0), (K - 1, 0), (0, 0)))
-        windows = jnp.stack([pad[:, i:i + u.shape[1]] for i in range(K)],
-                            axis=-1)                          # [B,T,Ei,K]
-        u = jnp.einsum("btek,ek->bte", windows, self.conv_weight)
-        u = F.silu(u + self.conv_bias)
+    def _in_split(self, x):
+        """norm + in_proj → (u_raw, z): the conv input and the gate."""
+        xz = self.in_proj(self.norm(x))
+        return jnp.split(xz, 2, axis=-1)
 
+    def _ssm_coeffs(self, u):
+        """u (post-conv activations, any leading dims) → (delta, B, C, A)
+        in f32."""
         proj = self.x_proj(u)
         dt, Bc, Cc = jnp.split(proj, [self.rank,
                                       self.rank + self.state_size], axis=-1)
-        delta = F.softplus(self.dt_proj(dt))                  # [B,T,Ei]
+        delta = F.softplus(self.dt_proj(dt))
         A = -jnp.exp(self.A_log)                              # [Ei,N]
+        return (delta.astype(jnp.float32), Bc.astype(jnp.float32),
+                Cc.astype(jnp.float32), A)
+
+    def _conv_seq(self, u_raw, left_ctx=None):
+        """Causal depthwise conv over time for a [B, T, Ei] sequence.
+        ``left_ctx`` [B, K-1, Ei] supplies the carried left context
+        (decode prefill); None = K-1 zeros (sequence start). Returns
+        ``(u, ctx)`` where ctx is the padded input the windows read —
+        its last K-1 steps are the next carried tail."""
+        K = self.conv_kernel
+        if left_ctx is None:
+            ctx = jnp.pad(u_raw, ((0, 0), (K - 1, 0), (0, 0)))
+        else:
+            ctx = jnp.concatenate([left_ctx.astype(u_raw.dtype), u_raw],
+                                  axis=1)
+        windows = jnp.stack(
+            [ctx[:, i:i + u_raw.shape[1]] for i in range(K)],
+            axis=-1)                                          # [B,T,Ei,K]
+        u = jnp.einsum("btek,ek->bte", windows, self.conv_weight)
+        return F.silu(u + self.conv_bias), ctx
+
+    def __call__(self, x, training: bool = False):
+        residual = x
+        u_raw, z = self._in_split(x)                          # [B,T,Ei]
+        u, _ = self._conv_seq(u_raw)
+        delta, Bc, Cc, A = self._ssm_coeffs(u)
         T = u.shape[1]
         chunk = (self.scan_chunk_size
                  if self.scan_chunk_size and T % self.scan_chunk_size == 0
                  else None)
-        uf, df = u.astype(jnp.float32), delta.astype(jnp.float32)
-        bf, cf = Bc.astype(jnp.float32), Cc.astype(jnp.float32)
+        uf = u.astype(jnp.float32)
         y = None
         _pk = F._pallas()
         if _pk is not None:
             mode = _pk.dispatch_mode()
             if mode != "off" and _pk.selective_scan_supported(
-                    uf, df, A, bf, cf, self.D, chunk=chunk):
+                    uf, delta, A, Bc, Cc, self.D, chunk=chunk):
                 y = _pk.selective_scan(
-                    uf, df, A, bf, cf, self.D, chunk=chunk,
+                    uf, delta, A, Bc, Cc, self.D, chunk=chunk,
                     partitioned=mode == "partitioned")
         if y is None:
-            y = selective_scan(uf, df, A, bf, cf, self.D, chunk_size=chunk)
+            y = selective_scan(uf, delta, A, Bc, Cc, self.D,
+                               chunk_size=chunk)
         y = y.astype(x.dtype) * F.silu(z)
         return residual + self.out_proj(y)
+
+    # ---- stateful decode (the recurrent O(1)-per-token form) ----------
+
+    def init_state(self, batch_size: int, dtype):
+        """(conv tail [B, K-1, Ei], ssm state [B, Ei, N])."""
+        Ei = self.conv_weight.shape[0]
+        return (jnp.zeros((batch_size, self.conv_kernel - 1, Ei), dtype),
+                jnp.zeros((batch_size, Ei, self.state_size), jnp.float32))
+
+    def prefill(self, x, state):
+        """Sequence forward that consumes AND returns decode state, so
+        chunked prefill / continuation from a warm cache is exact: the
+        carried conv tail replaces the causal zero-padding, and the
+        carried SSM state seeds the scan (jnp path — runs once per
+        generation; uses the same chunked-scan selection as __call__ so
+        long prompts keep the chunked memory shape)."""
+        conv_tail, h0 = state
+        residual = x
+        u_raw, z = self._in_split(x)
+        K, T = self.conv_kernel, u_raw.shape[1]
+        u, ctx = self._conv_seq(u_raw, left_ctx=conv_tail)
+        delta, Bc, Cc, A = self._ssm_coeffs(u)
+        chunk = (self.scan_chunk_size
+                 if self.scan_chunk_size and T % self.scan_chunk_size == 0
+                 else None)
+        y, h_last = selective_scan(u.astype(jnp.float32), delta, A, Bc,
+                                   Cc, self.D, chunk_size=chunk,
+                                   return_state=True, initial_state=h0)
+        y = y.astype(x.dtype) * F.silu(z)
+        # explicit start index (NOT -(K-1): for K == 1 that is -0 and
+        # would return the whole sequence instead of an empty tail)
+        tail = ctx[:, ctx.shape[1] - (K - 1):]
+        return residual + self.out_proj(y), (tail, h_last)
+
+    def step(self, x, state):
+        """One decode step: x [B, E], state from init_state/prefill."""
+        conv_tail, h = state
+        residual = x
+        u_raw, z = self._in_split(x)                          # [B, Ei]
+        window = jnp.concatenate([conv_tail, u_raw[:, None]], axis=1)
+        u = jnp.einsum("bke,ek->be", window, self.conv_weight)
+        u = F.silu(u + self.conv_bias)
+        delta, Bc, Cc, A = self._ssm_coeffs(u)
+        dA = jnp.exp(delta[..., None] * A)                    # [B,Ei,N]
+        dBu = (delta * u.astype(jnp.float32))[..., None] * Bc[:, None, :]
+        h = dA * h + dBu
+        y = jnp.einsum("bin,bn->bi", h, Cc) + u.astype(jnp.float32) * self.D
+        y = y.astype(x.dtype) * F.silu(z)
+        return residual + self.out_proj(y), (window[:, 1:], h)
 
 
 class MambaForCausalLM(Module):
@@ -216,3 +309,35 @@ class MambaForCausalLM(Module):
         from paddle_tpu.models._common import causal_lm_loss
         return causal_lm_loss(self, self.embed.weight.T, input_ids,
                               labels, ignore_index, training)
+
+    # ---- decode interface (models/generation.py contract) -------------
+    # Unlike attention models there is no positional KV cache: the
+    # "cache" is the per-layer recurrent state (conv tail + SSM state),
+    # O(1) in sequence length — Mamba's whole serving advantage. The
+    # ``max_len``/``index`` arguments of the shared contract are
+    # accepted and ignored (the state is positionless).
+
+    def init_cache(self, batch_size: int, max_len: int | None = None,
+                   dtype=None):
+        cfg = self.config
+        dtype = jnp.dtype(dtype or cfg.dtype)
+        L, Ei = cfg.num_layers, cfg.inner_size
+        return (jnp.zeros((L, batch_size, cfg.conv_kernel - 1, Ei), dtype),
+                jnp.zeros((L, batch_size, Ei, cfg.state_size),
+                          jnp.float32))
+
+    def forward_with_cache(self, input_ids, cache, index: int = 0):
+        """Returns (logits [B, T, V], new cache). T > 1 = prefill (the
+        parallel scan, consuming AND capturing each layer's state — so
+        chunked prefill / warm-cache continuation is exact); T == 1 =
+        one recurrent step. ``index`` is ignored (see class note)."""
+        x = self.embed(input_ids)
+        if input_ids.shape[1] == 1:
+            h, new_cache = self.blocks.scan_with(
+                x[:, 0], cache, fn=lambda blk, xc, st: blk.step(xc, st))
+            h = h[:, None]
+        else:
+            h, new_cache = self.blocks.scan_with(
+                x, cache, fn=lambda blk, xc, st: blk.prefill(xc, st))
+        logits = self.norm(h) @ self.embed.weight.T
+        return logits, new_cache

@@ -186,15 +186,20 @@ class ScannedBlocks(Module):
         _reemit_tape(tape)
         return x
 
-    def scan_with(self, x, per_layer, **kwargs):
+    def scan_with(self, x, per_layer, fn=None, **kwargs):
         """Scan with a per-layer input/output pytree (leaves carry a
         leading [n_layers] dim — e.g. stacked KV caches for decoding).
         Each block must return ``(y, per_layer_out)``. Returns
-        ``(x, stacked_outputs)``."""
+        ``(x, stacked_outputs)``. ``fn(layer, carry, pl_in)`` dispatches
+        a method other than ``__call__`` (e.g. a Mamba block's
+        ``step``/``prefill``)."""
 
         def body(carry, layer_and_pl):
             layer, pl_in = layer_and_pl
-            y, pl_out = layer(carry, pl_in, **kwargs)
+            if fn is None:
+                y, pl_out = layer(carry, pl_in, **kwargs)
+            else:
+                y, pl_out = fn(layer, carry, pl_in)
             return y, pl_out
 
         x, out = lax.scan(body, x, (self.block, per_layer))

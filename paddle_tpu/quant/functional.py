@@ -78,11 +78,16 @@ def moving_average_abs_max_scale(x, running_scale, momentum: float = 0.9):
     return momentum * running_scale + (1.0 - momentum) * now
 
 
-def channelwise_int8_freeze(w, *, axis: int = -2, qmax: int = 127):
+def channelwise_int8_freeze(w, *, axis: int = -2, qmax: int = 127,
+                            scale_dtype=None):
     """Symmetric per-channel int8 freeze: returns ``(wq int8, scale)``
     with ``dequant = wq * scale`` and ``scale = absmax/qmax`` reduced
     over ``axis`` (every axis except the channel axes). The elementwise
     error is bounded by ``scale/2``.
+
+    ``scale_dtype`` rounds the scale to a storage dtype BEFORE
+    quantizing, so dequant with the stored (e.g. bf16) scale stays on
+    the freeze grid and the error bound still holds.
 
     This is the same quantization grid ``ptq.convert_to_int8`` freezes
     on — ptq stores the UN-normalized absmax as its ``w_scale`` (the
@@ -91,6 +96,9 @@ def channelwise_int8_freeze(w, *, axis: int = -2, qmax: int = 127):
     dequant scale. Keep the two in sync through this docstring."""
     w32 = w.astype(jnp.float32)
     scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=axis), 1e-8) / qmax
-    wq = jnp.clip(jnp.round(w32 / jnp.expand_dims(scale, axis)),
-                  -qmax, qmax).astype(jnp.int8)
+    if scale_dtype is not None:
+        scale = scale.astype(scale_dtype)
+    wq = jnp.clip(
+        jnp.round(w32 / jnp.expand_dims(scale.astype(jnp.float32), axis)),
+        -qmax, qmax).astype(jnp.int8)
     return wq, scale

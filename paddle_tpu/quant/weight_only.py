@@ -88,14 +88,11 @@ def quantize_weights_int8(model):
         # reduce over the input dim (axis -2): per-output-channel scales,
         # and scan-stacked Linears ([L, in, out] weights inside
         # ScannedBlocks) keep their leading layer axis on every leaf.
-        # The scale is rounded to the model dtype BEFORE quantizing, so
-        # dequant with the stored (bf16) scale stays on the freeze grid
-        # and the scale/2 error bound holds for bf16 models too
-        scale = channelwise_int8_freeze(w, axis=-2)[1].astype(w.dtype)
-        wq = jnp.clip(
-            jnp.round(w.astype(jnp.float32)
-                      / scale.astype(jnp.float32)[..., None, :]),
-            -127, 127).astype(jnp.int8)
+        # scale_dtype=w.dtype quantizes against the dtype-rounded scale,
+        # so dequant with the stored (bf16) scale stays on the freeze
+        # grid and the scale/2 error bound holds for bf16 models too
+        wq, scale = channelwise_int8_freeze(w, axis=-2,
+                                            scale_dtype=w.dtype)
         pspecs = None
         if hasattr(m, "_pspecs"):
             by_name = dict(m._pspecs)
