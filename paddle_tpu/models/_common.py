@@ -26,3 +26,33 @@ def causal_lm_loss(model, head_weight, input_ids, labels,
     return F.cross_entropy(
         logits[:, :-1].astype(jnp.float32), labels[:, 1:],
         ignore_index=ignore_index)
+
+
+def cached_attention(q, k, v, cache, index):
+    """Static-KV-cache decode core shared by every attention family
+    (llama GQA, GPT fused-MHA, MoE): write this chunk's k/v at
+    ``index`` into the fixed [B, S, Hkv, D] buffers, then attend —
+    plain causal over the chunk for the int-0 prefill fast path
+    (flash-kernel eligible), masked over the whole buffer otherwise
+    (key j visible to query t iff j <= index + t; future slots are
+    zeros and masked off). Returns ``(attn_out, (k_buf, v_buf))``."""
+    import jax
+
+    k_buf, v_buf = cache
+    T = q.shape[1]
+    S = k_buf.shape[1]
+    idx = jnp.asarray(0 if index is None else index, jnp.int32)
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k.astype(k_buf.dtype), (0, idx, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v.astype(v_buf.dtype), (0, idx, 0, 0))
+    if isinstance(index, int) and index == 0:
+        out = F.scaled_dot_product_attention(q, k, v, causal=True)
+    else:
+        q_pos = idx + jnp.arange(T)
+        key_pos = jnp.arange(S)
+        mask = key_pos[None, :] <= q_pos[:, None]              # [T, S]
+        out = F.scaled_dot_product_attention(
+            q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
+            mask=mask[None, None])
+    return out, (k_buf, v_buf)

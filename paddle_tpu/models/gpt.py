@@ -76,15 +76,24 @@ class GPTBlock(Module):
         self.num_heads = cfg.num_heads
         self.head_dim = E // cfg.num_heads
 
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, cache=None, *, index=None, training: bool = False):
+        """``cache``/``index`` follow the LlamaAttention static-KV-cache
+        contract (llama.py:128): fixed [B, S, H, D] buffers, ``index``
+        the write offset; returns ``(x, new_cache)`` when caching."""
         import jax.ad_checkpoint
 
         B, T, E = x.shape
         h = self.ln1(x)
         qkv = self.wqkv(h).reshape(B, T, 3, self.num_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        a = F.scaled_dot_product_attention(q, k, v, causal=True)
-        # tags for the partial-save remat policies (no-op otherwise)
+        new_cache = None
+        if cache is not None:
+            from paddle_tpu.models._common import cached_attention
+            a, new_cache = cached_attention(q, k, v, cache, index)
+        else:
+            a = F.scaled_dot_product_attention(q, k, v, causal=True)
+        # one shared tail for cached and uncached forwards (same dropout
+        # and remat-policy tags — no-ops in eval/decode)
         attn_out = jax.ad_checkpoint.checkpoint_name(
             self.wo(a.reshape(B, T, E)), "attn_out")
         x = x + self.drop(attn_out, training=training)
@@ -92,7 +101,8 @@ class GPTBlock(Module):
         up = jax.ad_checkpoint.checkpoint_name(
             F.gelu(self.fc1(h), approximate=True), "mlp_up")
         h = jax.ad_checkpoint.checkpoint_name(self.fc2(up), "mlp_out")
-        return x + self.drop(h, training=training)
+        x = x + self.drop(h, training=training)
+        return x if new_cache is None else (x, new_cache)
 
 
 class GPTForCausalLM(Module):
@@ -126,6 +136,36 @@ class GPTForCausalLM(Module):
     def __call__(self, input_ids, training: bool = False):
         return self.lm_head(self.hidden_states(input_ids,
                                                training=training))
+
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Stacked static KV cache ([L, B, S, H, D] ×2) — the
+        llama/generation.py decode contract."""
+        cfg = self.config
+        if max_len > cfg.max_seq_len:
+            # learned positions: past max_seq_len the pos_embed gather
+            # would silently clamp to the last row (RoPE families have
+            # no such cap) — fail loudly instead
+            raise ValueError(
+                f"decode length {max_len} exceeds max_seq_len="
+                f"{cfg.max_seq_len} (learned positional embeddings "
+                "cannot extrapolate)")
+        dtype = jnp.dtype(dtype or cfg.dtype)
+        shape = (cfg.num_layers, batch_size, max_len, cfg.num_heads,
+                 cfg.hidden_size // cfg.num_heads)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def forward_with_cache(self, input_ids, cache, index):
+        """Prefill (whole prompt at index 0) or decode (one token at
+        index t); learned positions are offset by ``index``."""
+        T = input_ids.shape[1]
+        x = (self.embed(input_ids)
+             + self.pos_embed(index + jnp.arange(T)))
+        x, cache = self.blocks.scan_with(x, cache, index=index)
+        return self.lm_head(self.ln_f(x)), cache
+
+    def generate(self, input_ids, max_new_tokens: int, **kwargs):
+        from paddle_tpu.models.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):

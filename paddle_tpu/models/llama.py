@@ -158,29 +158,9 @@ class LlamaAttention(Module):
         q = F.apply_rotary(q, cos, sin)
         k = F.apply_rotary(k, cos, sin)
         if cache is not None:
-            k_buf, v_buf = cache
-            S = k_buf.shape[1]
-            idx = jnp.asarray(0 if index is None else index, jnp.int32)
-            k_buf = jax.lax.dynamic_update_slice(
-                k_buf, k.astype(k_buf.dtype), (0, idx, 0, 0))
-            v_buf = jax.lax.dynamic_update_slice(
-                v_buf, v.astype(v_buf.dtype), (0, idx, 0, 0))
-            if isinstance(index, int) and index == 0:
-                # prefill: no prior context — plain causal attention over
-                # the chunk itself (flash-kernel eligible; the masked path
-                # below would materialize [B, H, T, S] scores)
-                out = F.scaled_dot_product_attention(q, k, v, causal=True)
-            else:
-                # decode: key j visible to query t iff j <= idx + t
-                # (future buffer slots are zeros and masked off)
-                q_pos = idx + jnp.arange(T)
-                key_pos = jnp.arange(S)
-                mask = key_pos[None, :] <= q_pos[:, None]      # [T, S]
-                out = F.scaled_dot_product_attention(
-                    q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
-                    mask=mask[None, None])
-            out = self.wo(out.reshape(B, T, E))
-            return out, (k_buf, v_buf)
+            from paddle_tpu.models._common import cached_attention
+            out, new_cache = cached_attention(q, k, v, cache, index)
+            return self.wo(out.reshape(B, T, E)), new_cache
         # activations: shard heads over tp inside the einsum via sharded
         # inputs; flash path kicks in on TPU for supported shapes
         if self.seq_mode != "none":

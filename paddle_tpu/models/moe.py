@@ -88,7 +88,13 @@ class MoEBlock(Module):
                           num_layers=cfg.num_layers, dtype=dtype,
                           dispatch_mode=cfg.dispatch_mode, key=k2)
 
-    def __call__(self, x, training: bool = False):
+    def __call__(self, x, cache=None, *, index=None, training: bool = False):
+        if cache is not None:
+            attn_out, new_cache = self.attn(self.attn_norm(x), cache=cache,
+                                            index=index, training=training)
+            x = x + attn_out
+            mlp_out, aux = self.moe(self.mlp_norm(x))
+            return x + mlp_out, aux, new_cache
         x = x + self.attn(self.attn_norm(x), training=training)
         mlp_out, aux = self.moe(self.mlp_norm(x))
         return x + mlp_out, aux
@@ -136,6 +142,35 @@ class MoEForCausalLM(Module):
 
     def __call__(self, input_ids, training: bool = False):
         return self.forward_with_aux(input_ids, training)[0]
+
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Stacked static KV cache ([L, B, S, Hkv, D] ×2) — the shared
+        generation contract (batch on axis 1: beam_search reorders cache
+        leaves along it). Expert MLPs are stateless in decode: each step
+        routes the live tokens through the same top-k machinery as
+        training."""
+        cfg = self.config
+        dtype = jnp.dtype(dtype or cfg.dtype)
+        head_dim = cfg.hidden_size // cfg.num_heads
+        shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads,
+                 head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def forward_with_cache(self, input_ids, cache, index):
+        x = self.embed(input_ids)
+        k_all, v_all = cache
+        ks, vs = [], []
+        for i, block in enumerate(self.blocks):
+            x, _aux, (k, v) = block(x, cache=(k_all[i], v_all[i]),
+                                    index=index)
+            ks.append(k)
+            vs.append(v)
+        return (self.lm_head(self.norm(x)),
+                (jnp.stack(ks), jnp.stack(vs)))
+
+    def generate(self, input_ids, max_new_tokens: int, **kwargs):
+        from paddle_tpu.models.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):

@@ -215,3 +215,65 @@ def test_beam_search_eos_and_pad():
     if 5 in row:
         after = row[list(row).index(5) + 1:]
         assert (after == 0).all(), row
+
+
+@pytest.mark.parametrize("family", ["gpt", "moe"])
+def test_gpt_moe_cache_decode_matches_full_forward(family):
+    """GPT and MoE decode through the shared static-KV-cache contract
+    (r4): prefill logits and teacher-forced decode steps must match the
+    full parallel forward, and generate() runs jitted."""
+    import paddle_tpu
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, MoEConfig,
+                                   MoEForCausalLM)
+    from paddle_tpu.models.generation import generate
+
+    paddle_tpu.seed(0)
+    if family == "gpt":
+        m = GPTForCausalLM(GPTConfig.tiny(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0))
+    else:
+        m = MoEForCausalLM(MoEConfig.tiny(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_experts=4, max_seq_len=64))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 96, (2, 10)).astype(np.int32))
+    ext = jnp.asarray(np.random.RandomState(1).randint(0, 96, (2, 3))
+                      .astype(np.int32))
+    allids = jnp.concatenate([ids, ext], axis=1)
+
+    cache = m.init_cache(2, 20)
+    pre, cache = m.forward_with_cache(ids, cache, 0)
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(m(ids)),
+                               rtol=2e-4, atol=2e-5)
+    full2 = np.asarray(m(allids))
+    logits = []
+    for t in range(3):
+        lg, cache = m.forward_with_cache(allids[:, 10 + t:11 + t], cache,
+                                         10 + t)
+        logits.append(np.asarray(lg[:, 0]))
+    np.testing.assert_allclose(np.stack(logits, 1), full2[:, 10:],
+                               rtol=2e-3, atol=1e-4)
+    out = np.asarray(jax.jit(lambda mm, i: generate(mm, i, 6))(m, ids))
+    assert out.shape == (2, 16)
+    assert (out[:, :10] == np.asarray(ids)).all()
+    # beam search reorders cache leaves on axis 1 — the layout contract
+    # every family's init_cache must satisfy
+    from paddle_tpu.models.generation import beam_search
+    bs_out = np.asarray(beam_search(m, ids, 4, num_beams=3))
+    assert bs_out.shape == (2, 14)
+    assert (bs_out[:, :10] == np.asarray(ids)).all()
+
+
+def test_gpt_decode_beyond_max_seq_len_raises():
+    """Learned positions cannot extrapolate: a decode length past
+    max_seq_len must fail loudly, not silently clamp the pos gather."""
+    import paddle_tpu
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle_tpu.seed(0)
+    m = GPTForCausalLM(GPTConfig.tiny(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=16, dropout=0.0))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        m.init_cache(2, 32)
