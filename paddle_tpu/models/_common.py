@@ -56,3 +56,13 @@ def cached_attention(q, k, v, cache, index):
             q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
             mask=mask[None, None])
     return out, (k_buf, v_buf)
+
+
+def init_kv_cache(num_layers, batch_size, max_len, num_kv_heads, head_dim,
+                  dtype):
+    """The stacked static KV-cache layout every attention family shares:
+    ``([L, B, S, Hkv, D], [L, B, S, Hkv, D])`` zeros. Batch MUST stay on
+    axis 1 — beam search reorders cache leaves along it
+    (generation.py)."""
+    shape = (num_layers, batch_size, max_len, num_kv_heads, head_dim)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))

@@ -149,10 +149,11 @@ class GPTForCausalLM(Module):
                 f"decode length {max_len} exceeds max_seq_len="
                 f"{cfg.max_seq_len} (learned positional embeddings "
                 "cannot extrapolate)")
-        dtype = jnp.dtype(dtype or cfg.dtype)
-        shape = (cfg.num_layers, batch_size, max_len, cfg.num_heads,
-                 cfg.hidden_size // cfg.num_heads)
-        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        from paddle_tpu.models._common import init_kv_cache
+        return init_kv_cache(cfg.num_layers, batch_size, max_len,
+                             cfg.num_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             jnp.dtype(dtype or cfg.dtype))
 
     def forward_with_cache(self, input_ids, cache, index):
         """Prefill (whole prompt at index 0) or decode (one token at

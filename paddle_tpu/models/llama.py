@@ -311,12 +311,12 @@ class LlamaForCausalLM(Module):
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Stacked static KV cache for all layers:
         ([L, B, S, Hkv, D], [L, B, S, Hkv, D]) zeros."""
+        from paddle_tpu.models._common import init_kv_cache
         cfg = self.config
-        dtype = jnp.dtype(dtype or cfg.dtype)
-        head_dim = cfg.hidden_size // cfg.num_heads
-        shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads,
-                 head_dim)
-        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        return init_kv_cache(cfg.num_layers, batch_size, max_len,
+                             cfg.num_kv_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             jnp.dtype(dtype or cfg.dtype))
 
     def forward_with_cache(self, input_ids, cache, index):
         """Forward a chunk (prefill: the whole prompt at index 0; decode:

@@ -149,12 +149,12 @@ class MoEForCausalLM(Module):
         leaves along it). Expert MLPs are stateless in decode: each step
         routes the live tokens through the same top-k machinery as
         training."""
+        from paddle_tpu.models._common import init_kv_cache
         cfg = self.config
-        dtype = jnp.dtype(dtype or cfg.dtype)
-        head_dim = cfg.hidden_size // cfg.num_heads
-        shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads,
-                 head_dim)
-        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        return init_kv_cache(cfg.num_layers, batch_size, max_len,
+                             cfg.num_kv_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             jnp.dtype(dtype or cfg.dtype))
 
     def forward_with_cache(self, input_ids, cache, index):
         x = self.embed(input_ids)

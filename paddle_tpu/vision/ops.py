@@ -11,17 +11,15 @@ Implemented (reference file cited per function): yolo_box, prior_box,
 anchor_generator, box_coder (encode/decode), box_clip, iou_similarity,
 box_iou_xyxy, bipartite_match, matrix_nms, multiclass_nms, roi_align,
 distance2bbox/bbox2distance (the anchor-free PP-YOLOE transforms),
-generate_anchor_points, deform_conv2d (v1/v2, r4).
+generate_anchor_points, deform_conv2d (v1/v2, r4), psroi_pool (R-FCN
+position-sensitive pooling as masked bin averages over static grids,
+r4), prroi_pool (PrRoIPool's exact bilinear integral in separable
+closed form, roi-coordinate-differentiable, r4).
 
 Deliberately not ported: the RCNN proposal pipeline
 (``generate_proposals_op.cc``, ``collect/distribute_fpn_proposals_op.cc``)
 — subsumed by the anchor-free detectors this framework ships
-(PP-YOLOE-class); the position-sensitive ROI pools
-(``psroi_pool_op.cc``, ``prroi_pool_op.cc``) — R-FCN-era heads with no
-consumer in the shipped model zoo, and ``roi_align`` (implemented)
-covers the ROI-feature-extraction role in every post-R-FCN detector —
-anyone porting R-FCN can express psroi_pool as ``roi_align`` over the
-position-sensitive channel groups; and the polygon ops
+(PP-YOLOE-class); and the polygon ops
 (``polygon_box_transform_op.cc``, OCR-specific host-side geometry).
 """
 
@@ -39,7 +37,7 @@ __all__ = [
     "yolo_box", "prior_box", "anchor_generator", "box_coder", "box_clip",
     "iou_similarity", "box_iou_xyxy", "bipartite_match", "matrix_nms",
     "multiclass_nms", "roi_align", "distance2bbox", "bbox2distance",
-    "generate_anchor_points", "deform_conv2d",
+    "generate_anchor_points", "deform_conv2d", "psroi_pool", "prroi_pool",
 ]
 
 
@@ -659,3 +657,133 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+def psroi_pool(features, rois, roi_batch_idx, output_channels,
+               output_size, spatial_scale: float = 1.0):
+    """Position-sensitive RoI pooling (reference
+    ``paddle/fluid/operators/psroi_pool_op.cc`` — the R-FCN head: input
+    channel ``c·ph·pw + i·pw + j`` is average-pooled over output bin
+    ``(i, j)`` of output channel ``c``).
+
+    features [N, C, H, W] with C == output_channels·ph·pw; rois [R, 4]
+    xyxy; roi_batch_idx [R] int. TPU-native form: the per-bin integer
+    sub-rectangles of the reference's dynamic loops become boolean
+    masks over the full [H, W] grid (static shapes), the channel
+    grouping is a reshape, and the bin average is one einsum.
+    Empty bins produce 0, matching the reference.
+    """
+    N, C, H, W = features.shape
+    ph, pw = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    if C != output_channels * ph * pw:
+        raise ValueError(
+            f"psroi_pool: C={C} must equal output_channels*ph*pw="
+            f"{output_channels * ph * pw}")
+
+    # reference rounds the roi to integer coords (C round(): half AWAY
+    # from zero, not jnp.round's half-to-even), end = round(x2) + 1
+    def _round_away(v):
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    x1 = _round_away(rois[:, 0]) * spatial_scale
+    y1 = _round_away(rois[:, 1]) * spatial_scale
+    x2 = (_round_away(rois[:, 2]) + 1.0) * spatial_scale
+    y2 = (_round_away(rois[:, 3]) + 1.0) * spatial_scale
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    bin_h = roi_h / ph                                        # [R]
+    bin_w = roi_w / pw
+
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    hs = jnp.clip(jnp.floor(iy[None] * bin_h[:, None] + y1[:, None]),
+                  0, H)                                       # [R, ph]
+    he = jnp.clip(jnp.ceil((iy[None] + 1) * bin_h[:, None] + y1[:, None]),
+                  0, H)
+    ws = jnp.clip(jnp.floor(ix[None] * bin_w[:, None] + x1[:, None]),
+                  0, W)
+    we = jnp.clip(jnp.ceil((ix[None] + 1) * bin_w[:, None] + x1[:, None]),
+                  0, W)
+
+    gy = jnp.arange(H, dtype=jnp.float32)
+    gx = jnp.arange(W, dtype=jnp.float32)
+    my = ((gy[None, None, :] >= hs[..., None])
+          & (gy[None, None, :] < he[..., None]))              # [R, ph, H]
+    mx = ((gx[None, None, :] >= ws[..., None])
+          & (gx[None, None, :] < we[..., None]))              # [R, pw, W]
+
+    grouped = features.reshape(N, output_channels, ph, pw, H, W)
+
+    def per_roi(my_r, mx_r, bidx):
+        # the bin mask is separable — contract the two 1-D masks
+        # directly (no [ph, pw, H, W] intermediate)
+        fy = my_r.astype(features.dtype)                      # [ph, H]
+        fx = mx_r.astype(features.dtype)                      # [pw, W]
+        total = jnp.einsum("cijhw,ih,jw->cij", grouped[bidx], fy, fx)
+        count = (jnp.sum(fy, axis=1)[:, None]
+                 * jnp.sum(fx, axis=1)[None, :])              # [ph, pw]
+        return jnp.where(count > 0, total / jnp.maximum(count, 1.0), 0.0)
+
+    return jax.vmap(per_roi)(my, mx, roi_batch_idx)  # [R, C_out, ph, pw]
+
+
+def prroi_pool(features, rois, roi_batch_idx, output_size,
+               spatial_scale: float = 1.0):
+    """Precise RoI pooling (reference
+    ``paddle/fluid/operators/prroi_pool_op.cc`` — PrRoIPool: the EXACT
+    integral of the bilinearly-interpolated feature surface over each
+    bin, no sampling grid, differentiable in the roi coordinates).
+
+    TPU-native closed form: the bilinear surface is
+    ``f(y, x) = Σ_{h,w} feat[h, w]·tri(y−h)·tri(x−w)`` (tri = the hat
+    function), so its integral over a bin SEPARATES:
+    ``∫∫ f = Σ_{h,w} feat[h, w]·Iy[h]·Ix[w]`` with
+    ``Iy[h] = ∫ tri(y−h) dy`` in closed form — one [H] and one [W]
+    weight vector per bin and a single einsum per roi, instead of the
+    reference's per-cell ``PrRoIPoolingMatCalculation`` walk. Being a
+    composition of smooth jnp ops, ``jax.grad`` provides both the
+    feature gradient and the roi-coordinate gradient the reference
+    hand-derives. Zero padding outside the feature map (official PrRoI
+    semantics); degenerate (zero-area) bins produce 0.
+    """
+    N, C, H, W = features.shape
+    ph, pw = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    x2 = rois[:, 2] * spatial_scale
+    y2 = rois[:, 3] * spatial_scale
+    bin_h = (y2 - y1) / ph                                    # [R]
+    bin_w = (x2 - x1) / pw
+
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    ys = y1[:, None] + iy[None] * bin_h[:, None]              # [R, ph]
+    ye = ys + bin_h[:, None]
+    xs = x1[:, None] + ix[None] * bin_w[:, None]              # [R, pw]
+    xe = xs + bin_w[:, None]
+
+    def hat_integral(lo, hi, n):
+        """∫_{lo}^{hi} tri(t − k) dt for every k in [0, n) — closed
+        form via the hat antiderivative G (piecewise quadratic)."""
+        k = jnp.arange(n, dtype=jnp.float32)
+
+        def G(t):
+            u = jnp.clip(t, -1.0, 1.0)
+            return jnp.where(u <= 0, (u + 1.0) ** 2 / 2.0,
+                             1.0 - (1.0 - u) ** 2 / 2.0)
+
+        return G(hi[..., None] - k) - G(lo[..., None] - k)
+
+    Iy = hat_integral(ys, ye, H)                              # [R, ph, H]
+    Ix = hat_integral(xs, xe, W)                              # [R, pw, W]
+    area = jnp.maximum(bin_h[:, None, None] * bin_w[:, None, None], 0.0)
+
+    def per_roi(Iy_r, Ix_r, area_r, bidx):
+        total = jnp.einsum("chw,ih,jw->cij", features[bidx], Iy_r, Ix_r)
+        return jnp.where(area_r > 0.0, total / jnp.maximum(area_r, 1e-12),
+                         0.0)
+
+    return jax.vmap(per_roi)(Iy, Ix, area, roi_batch_idx)  # [R, C, ph, pw]

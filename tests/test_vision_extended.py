@@ -325,3 +325,117 @@ class TestGeneralAdaptivePool:
         np.testing.assert_allclose(
             np.asarray(F.adaptive_avg_pool2d(jnp.asarray(x2), (2, 2))),
             x2.reshape(1, 2, 2, 4, 2, 4).mean(axis=(3, 5)), rtol=1e-5)
+
+
+def test_psroi_pool_matches_naive():
+    """Golden check vs a direct python implementation of the reference
+    semantics (psroi_pool_op.cc): rounded roi coords, floor/ceil bin
+    rectangles, per-bin channel group c*ph*pw + i*pw + j, empty bin
+    -> 0."""
+    from paddle_tpu.vision.ops import psroi_pool
+
+    rs = np.random.RandomState(0)
+    C_out, ph, pw, H, W = 3, 2, 2, 9, 11
+    feats = rs.randn(2, C_out * ph * pw, H, W).astype(np.float32)
+    # half-integer coords exercise the C-round (half-away-from-zero)
+    # semantics where numpy/python round-half-to-even would differ
+    rois = np.array([[0.0, 0.0, 7.9, 5.2],
+                     [2.5, 1.5, 9.5, 8.0],
+                     [4.0, 4.0, 4.2, 4.2]], np.float32)
+    bidx = np.array([0, 1, 0], np.int32)
+    scale = 0.5
+
+    out = np.asarray(psroi_pool(jnp.asarray(feats), jnp.asarray(rois),
+                                jnp.asarray(bidx), C_out, (ph, pw),
+                                spatial_scale=scale))
+
+    def round_away(v):  # C round(): half away from zero
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+    want = np.zeros((3, C_out, ph, pw), np.float32)
+    for r in range(3):
+        x1 = round_away(rois[r, 0]) * scale
+        y1 = round_away(rois[r, 1]) * scale
+        x2 = (round_away(rois[r, 2]) + 1.0) * scale
+        y2 = (round_away(rois[r, 3]) + 1.0) * scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        for c in range(C_out):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.clip(np.floor(i * rh / ph + y1), 0, H))
+                    he = int(np.clip(np.ceil((i + 1) * rh / ph + y1), 0, H))
+                    ws = int(np.clip(np.floor(j * rw / pw + x1), 0, W))
+                    we = int(np.clip(np.ceil((j + 1) * rw / pw + x1), 0, W))
+                    ch = c * ph * pw + i * pw + j
+                    region = feats[bidx[r], ch, hs:he, ws:we]
+                    want[r, c, i, j] = region.mean() if region.size else 0.0
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_prroi_pool_matches_dense_integration():
+    """PrRoIPool computes the EXACT integral of the bilinear surface;
+    a dense Riemann sum over the same surface must converge to it."""
+    from paddle_tpu.vision.ops import prroi_pool
+
+    rs = np.random.RandomState(1)
+    C, H, W = 2, 8, 10
+    feats = rs.randn(1, C, H, W).astype(np.float32)
+    rois = np.array([[1.2, 0.7, 7.6, 5.9]], np.float32)
+    bidx = np.array([0], np.int32)
+    ph = pw = 2
+
+    out = np.asarray(prroi_pool(jnp.asarray(feats), jnp.asarray(rois),
+                                jnp.asarray(bidx), (ph, pw)))
+
+    def bilinear(c, y, x):
+        # zero-padded outside, hat-function form
+        total = 0.0
+        for h in range(max(0, int(np.floor(y))),
+                       min(H, int(np.floor(y)) + 2)):
+            for w in range(max(0, int(np.floor(x))),
+                           min(W, int(np.floor(x)) + 2)):
+                wy = max(0.0, 1.0 - abs(y - h))
+                wx = max(0.0, 1.0 - abs(x - w))
+                total += feats[0, c, h, w] * wy * wx
+        return total
+
+    n = 80
+    x1, y1, x2, y2 = rois[0]
+    bh, bw = (y2 - y1) / ph, (x2 - x1) / pw
+    want = np.zeros((C, ph, pw), np.float32)
+    for c in range(C):
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + i * bh + (np.arange(n) + 0.5) * bh / n
+                xs = x1 + j * bw + (np.arange(n) + 0.5) * bw / n
+                acc = sum(bilinear(c, y, x) for y in ys for x in xs)
+                want[c, i, j] = acc / (n * n)
+    np.testing.assert_allclose(out[0], want, rtol=2e-3, atol=2e-3)
+
+
+def test_prroi_pool_roi_gradients_flow():
+    """The PrRoI selling point: gradients w.r.t. the roi COORDINATES
+    exist (exact integral, no sampling) — finite and nonzero."""
+    from paddle_tpu.vision.ops import prroi_pool
+
+    rs = np.random.RandomState(2)
+    feats = jnp.asarray(rs.randn(1, 2, 8, 8).astype(np.float32))
+    bidx = jnp.asarray([0], jnp.int32)
+
+    def f(rois):
+        return jnp.sum(prroi_pool(feats, rois, bidx, 2))
+
+    import jax
+    g = jax.grad(f)(jnp.asarray([[1.0, 1.0, 6.0, 6.0]], jnp.float32))
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).max()) > 0.0
+
+
+def test_prroi_pool_degenerate_bin_is_zero():
+    from paddle_tpu.vision.ops import prroi_pool
+
+    feats = jnp.ones((1, 1, 6, 6), jnp.float32)
+    rois = jnp.asarray([[2.0, 2.0, 2.0, 5.0]], jnp.float32)  # zero width
+    out = prroi_pool(feats, rois, jnp.asarray([0], jnp.int32), 2)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
