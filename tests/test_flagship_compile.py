@@ -133,3 +133,28 @@ def test_mixtral_8x7b_ep_fsdp_compiles(devices8):
     # ep4 x fsdp2 -> ~57GB/device + unsharded-axis leftovers
     assert 40 < args_gb < 75, args_gb
     assert ma.alias_size_in_bytes / 1e9 > 40   # donated, not copied
+
+
+def test_llama2_7b_long_context_ring_compiles(devices8):
+    """The long-context north star at flagship scale: 7B with the
+    sequence axis sharded 4-way (ring attention) at seq 32,768 compiles
+    under sp4 x fsdp2. Ring attention's O(T/sp) per-device attention
+    memory is what makes the config expressible at all — a dense
+    [B, H, T, T] score tensor at this shape would be ~137 GB in bf16
+    (~275 GB fp32), far past a single device."""
+    s = DistributedStrategy()
+    s.sequence_parallel.enable = True
+    s.sequence_parallel.degree = 4
+    s.sequence_parallel.mode = "ring"
+    s.sharding.enable = True
+    s.sharding.stage = 3
+    s.sharding.degree = 2
+    s.dp_degree = 1
+    compiled, params_b, _ = _compile_abstract(
+        LlamaConfig.llama2_7b(), s, bs=2, seq=32768)
+    assert 6.5 < params_b < 7.0, params_b
+    ma = compiled.memory_analysis()
+    args_gb = ma.argument_size_in_bytes / 1e9
+    # state sharded over fsdp2 only (sp shards activations, not params)
+    assert 25 < args_gb < 45, args_gb
+    assert ma.alias_size_in_bytes / 1e9 > 25   # donated
