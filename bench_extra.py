@@ -167,11 +167,13 @@ def main(only: str | None = None):
         _pt.seed(0)
         mmodel = MambaForCausalLM(mdcfg)
         mam_rate = decode_rate(mmodel)
+        mam_int8 = decode_rate(quantize_weights_int8(mmodel))
         print(json.dumps({
             "model": "mamba-0.2B-decode",
             "params_m": round(mdcfg.num_params() / 1e6, 1),
             "decode_tokens_per_sec": round(mam_rate, 1),
             "tokens_per_sec_per_seq": round(mam_rate / db, 1),
+            "int8_weight_only_tokens_per_sec": round(mam_int8, 1),
             "batch": db, "new_tokens": new_toks}), flush=True)
 
     # ERNIE base MLM (encoder side)
