@@ -157,6 +157,25 @@ def main(only: str | None = None):
             "int8_weight_only_tokens_per_sec": round(int8_rate, 1),
             "batch": db, "new_tokens": new_toks}), flush=True)
 
+        # GPT decode (learned positions, fused-QKV MHA) through the same
+        # shared cache contract
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        gdcfg = GPTConfig(vocab_size=50304, hidden_size=2048,
+                          num_layers=12, num_heads=16, max_seq_len=1024,
+                          dropout=0.0, dtype="bfloat16", remat=False)
+        _pt.seed(0)
+        gmodel = GPTForCausalLM(gdcfg)
+        gpt_rate = decode_rate(gmodel)
+        gpt_int8 = decode_rate(quantize_weights_int8(gmodel))
+        print(json.dumps({
+            "model": "gpt-0.8B-decode",
+            "params_m": round(gdcfg.num_params() / 1e6, 1),
+            "decode_tokens_per_sec": round(gpt_rate, 1),
+            "tokens_per_sec_per_seq": round(gpt_rate / db, 1),
+            "int8_weight_only_tokens_per_sec": round(gpt_int8, 1),
+            "batch": db, "new_tokens": new_toks}), flush=True)
+
         # Mamba stateful decode: the recurrent O(1)-per-token path — no
         # KV cache growth, constant state (conv tail + [Ei, N] SSM
         # state per layer), so per-token cost is flat in context length

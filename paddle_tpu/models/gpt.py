@@ -54,6 +54,18 @@ class GPTConfig:
         base.update(kw)
         return cls(**base)
 
+    def num_params(self) -> int:
+        """Exact parameter count (embed + positions + blocks + head)."""
+        E, L = self.hidden_size, self.num_layers
+        per_layer = (3 * E * E + 3 * E      # wqkv w + b
+                     + E * E + E            # wo
+                     + 4 * E * E + 4 * E    # fc1
+                     + 4 * E * E + E        # fc2
+                     + 4 * E)               # 2 LayerNorms (w + b)
+        return (self.vocab_size * E + self.max_seq_len * E
+                + L * per_layer + 2 * E     # final LN
+                + E * self.vocab_size)      # untied lm_head
+
 
 class GPTBlock(Module):
     def __init__(self, cfg: GPTConfig, key=None):

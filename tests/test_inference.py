@@ -277,3 +277,19 @@ def test_gpt_decode_beyond_max_seq_len_raises():
         max_seq_len=16, dropout=0.0))
     with pytest.raises(ValueError, match="max_seq_len"):
         m.init_cache(2, 32)
+
+
+def test_gpt_num_params_exact():
+    """GPTConfig.num_params must equal the actual leaf count (the bench
+    decode leg reports it)."""
+    import paddle_tpu
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig.tiny(vocab_size=128, hidden_size=64, num_layers=3,
+                         num_heads=4, max_seq_len=32)
+    paddle_tpu.seed(0)
+    m = GPTForCausalLM(cfg)
+    actual = sum(int(np.prod(l.shape))
+                 for l in jax.tree_util.tree_leaves(m)
+                 if hasattr(l, "shape"))
+    assert cfg.num_params() == actual, (cfg.num_params(), actual)
