@@ -35,34 +35,87 @@ def cached_attention(q, k, v, cache, index):
     plain causal over the chunk for the int-0 prefill fast path
     (flash-kernel eligible), masked over the whole buffer otherwise
     (key j visible to query t iff j <= index + t; future slots are
-    zeros and masked off). Returns ``(attn_out, (k_buf, v_buf))``."""
+    zeros and masked off). Returns ``(attn_out, new_cache)``.
+
+    Two cache layouts:
+    - ``(k_buf, v_buf)`` — plain buffers in any float dtype.
+    - ``(k_q, v_q, k_scale, v_scale)`` — int8-quantized cache
+      (``init_kv_cache(dtype=jnp.int8)``): k/v stored int8 with
+      per-(position, head) absmax scales [L?, B, S, Hkv]; long-context
+      decode is KV-bandwidth-bound, and the dequant (convert +
+      broadcast-mul) fuses into the attention matmul's operand stream
+      the same way the weight-only int8 path's does."""
     import jax
 
-    k_buf, v_buf = cache
+    quantized = len(cache) == 4
     T = q.shape[1]
-    S = k_buf.shape[1]
     idx = jnp.asarray(0 if index is None else index, jnp.int32)
-    k_buf = jax.lax.dynamic_update_slice(
-        k_buf, k.astype(k_buf.dtype), (0, idx, 0, 0))
-    v_buf = jax.lax.dynamic_update_slice(
-        v_buf, v.astype(v_buf.dtype), (0, idx, 0, 0))
+
+    def write(buf, x):
+        return jax.lax.dynamic_update_slice(
+            buf, x.astype(buf.dtype), (0, idx) + (0,) * (buf.ndim - 2))
+
+    if quantized:
+        k_q, v_q, k_s, v_s = cache
+        S = k_q.shape[1]
+
+        def quant(x):
+            s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+            s = jnp.maximum(s, 1e-8)                      # [B, T, Hkv]
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                          -127, 127).astype(jnp.int8)
+            return xq, s
+
+        kq, ks = quant(k)
+        vq, vs = quant(v)
+        k_q, v_q = write(k_q, kq), write(v_q, vq)
+        k_s, v_s = write(k_s, ks), write(v_s, vs)
+        new_cache = (k_q, v_q, k_s, v_s)
+        deq = lambda xq, s: (xq.astype(q.dtype)
+                             * s.astype(q.dtype)[..., None])
+        k_full = lambda: deq(k_q, k_s)
+        v_full = lambda: deq(v_q, v_s)
+    else:
+        k_buf, v_buf = cache
+        S = k_buf.shape[1]
+        k_buf, v_buf = write(k_buf, k), write(v_buf, v)
+        new_cache = (k_buf, v_buf)
+        k_full = lambda: k_buf.astype(q.dtype)
+        v_full = lambda: v_buf.astype(q.dtype)
+
     if isinstance(index, int) and index == 0:
+        # prefill attends on the raw (unquantized) chunk — the write
+        # above still populates the cache for the decode steps
         out = F.scaled_dot_product_attention(q, k, v, causal=True)
     else:
         q_pos = idx + jnp.arange(T)
         key_pos = jnp.arange(S)
         mask = key_pos[None, :] <= q_pos[:, None]              # [T, S]
         out = F.scaled_dot_product_attention(
-            q, k_buf.astype(q.dtype), v_buf.astype(q.dtype),
-            mask=mask[None, None])
-    return out, (k_buf, v_buf)
+            q, k_full(), v_full(), mask=mask[None, None])
+    return out, new_cache
 
 
 def init_kv_cache(num_layers, batch_size, max_len, num_kv_heads, head_dim,
                   dtype):
     """The stacked static KV-cache layout every attention family shares:
     ``([L, B, S, Hkv, D], [L, B, S, Hkv, D])`` zeros. Batch MUST stay on
-    axis 1 — beam search reorders cache leaves along it
-    (generation.py)."""
+    axis 1 — beam search reorders cache leaves along it (generation.py).
+
+    ``dtype=jnp.int8`` selects the quantized layout
+    ``(k_q, v_q, k_scale, v_scale)`` with f32 per-(position, head)
+    scales [L, B, S, Hkv] — see ``cached_attention``; request it with
+    ``generate(..., cache_dtype=jnp.int8)``."""
     shape = (num_layers, batch_size, max_len, num_kv_heads, head_dim)
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        sshape = shape[:-1]
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(sshape, jnp.float32),
+                jnp.zeros(sshape, jnp.float32))
+    if not jnp.issubdtype(dtype, jnp.floating):
+        # any other integer dtype would silently truncate k/v on write
+        raise ValueError(
+            f"cache dtype {dtype} unsupported: use a float dtype or "
+            "jnp.int8 (the quantized layout)")
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))

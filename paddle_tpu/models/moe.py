@@ -158,15 +158,16 @@ class MoEForCausalLM(Module):
 
     def forward_with_cache(self, input_ids, cache, index):
         x = self.embed(input_ids)
-        k_all, v_all = cache
-        ks, vs = [], []
+        # arity-agnostic layer unstack/restack: works for the plain
+        # (k, v) layout and the int8 (k, v, k_scale, v_scale) layout
+        outs = tuple([] for _ in cache)
         for i, block in enumerate(self.blocks):
-            x, _aux, (k, v) = block(x, cache=(k_all[i], v_all[i]),
-                                    index=index)
-            ks.append(k)
-            vs.append(v)
+            x, _aux, new_c = block(x, cache=tuple(c[i] for c in cache),
+                                   index=index)
+            for lst, c in zip(outs, new_c):
+                lst.append(c)
         return (self.lm_head(self.norm(x)),
-                (jnp.stack(ks), jnp.stack(vs)))
+                tuple(jnp.stack(lst) for lst in outs))
 
     def generate(self, input_ids, max_new_tokens: int, **kwargs):
         from paddle_tpu.models.generation import generate

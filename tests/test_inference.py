@@ -293,3 +293,81 @@ def test_gpt_num_params_exact():
                  for l in jax.tree_util.tree_leaves(m)
                  if hasattr(l, "shape"))
     assert cfg.num_params() == actual, (cfg.num_params(), actual)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt", "moe"])
+def test_int8_kv_cache_decode_close_to_full(family):
+    """Quantized KV cache (init_kv_cache(dtype=int8) via
+    generate(cache_dtype=jnp.int8)): per-(position, head) absmax scales
+    keep teacher-forced decode logits within a fraction of a percent of
+    the full forward, and greedy generation matches the bf16-cache run
+    on these shapes."""
+    import paddle_tpu
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                                   LlamaForCausalLM, MoEConfig,
+                                   MoEForCausalLM)
+    from paddle_tpu.models.generation import generate
+
+    paddle_tpu.seed(0)
+    if family == "llama":
+        m = LlamaForCausalLM(LlamaConfig.tiny(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, max_seq_len=64))
+    elif family == "gpt":
+        m = GPTForCausalLM(GPTConfig.tiny(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, dropout=0.0))
+    else:
+        m = MoEForCausalLM(MoEConfig.tiny(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_experts=4, max_seq_len=64))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 96, (2, 10)).astype(np.int32))
+    ext = jnp.asarray(np.random.RandomState(1).randint(0, 96, (2, 3))
+                      .astype(np.int32))
+    allids = jnp.concatenate([ids, ext], axis=1)
+    full = np.asarray(m(allids))
+
+    cache = m.init_cache(2, 16, dtype=jnp.int8)
+    assert len(cache) == 4 and cache[0].dtype == jnp.int8
+    pre, cache = m.forward_with_cache(ids, cache, 0)
+    # prefill attends on the raw chunk — exact
+    np.testing.assert_allclose(np.asarray(pre), full[:, :10], rtol=2e-4,
+                               atol=2e-5)
+    for t in range(3):
+        lg, cache = m.forward_with_cache(allids[:, 10 + t:11 + t], cache,
+                                         10 + t)
+        rel = (np.linalg.norm(np.asarray(lg[:, 0]) - full[:, 10 + t])
+               / np.linalg.norm(full[:, 10 + t]))
+        assert rel < 0.02, (t, rel)
+
+    g8 = np.asarray(generate(m, ids, 6, cache_dtype=jnp.int8))
+    gf = np.asarray(generate(m, ids, 6))
+    assert g8.shape == gf.shape == (2, 16)
+    np.testing.assert_array_equal(g8, gf)
+
+
+def test_mamba_ignores_int8_cache_dtype():
+    """Mamba's recurrent state accumulates — cache_dtype=int8 falls back
+    to the model float dtype instead of corrupting the state."""
+    import paddle_tpu
+    from paddle_tpu.models import MambaConfig, MambaForCausalLM
+
+    paddle_tpu.seed(0)
+    m = MambaForCausalLM(MambaConfig.tiny(vocab_size=64, hidden_size=32,
+                                          num_layers=2, state_size=8))
+    cache = m.init_cache(2, dtype=jnp.int8)
+    assert jnp.issubdtype(jax.tree_util.tree_leaves(cache)[0].dtype,
+                          jnp.floating)
+
+
+def test_kv_cache_rejects_other_int_dtypes():
+    import paddle_tpu
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny(vocab_size=64, hidden_size=32,
+                                          num_layers=2, num_heads=4,
+                                          num_kv_heads=2, max_seq_len=32))
+    with pytest.raises(ValueError, match="int8"):
+        m.init_cache(2, 16, dtype=jnp.int32)
