@@ -321,11 +321,16 @@ class MambaForCausalLM(Module):
                    dtype=None):
         cfg = self.config
         dtype = jnp.dtype(dtype or cfg.dtype)
-        if not jnp.issubdtype(dtype, jnp.floating):
+        if dtype == jnp.int8:
             # the attention families' cache_dtype=int8 (quantized KV)
             # has no analogue here — the recurrent state is O(1) and
             # accumulates, so it stays in the model's float dtype
             dtype = jnp.dtype(cfg.dtype)
+        elif not jnp.issubdtype(dtype, jnp.floating):
+            raise ValueError(
+                f"cache dtype {dtype} unsupported: use a float dtype "
+                "(or jnp.int8, which Mamba maps back to its float "
+                "state — the recurrent state accumulates)")
         L, Ei = cfg.num_layers, cfg.inner_size
         return (jnp.zeros((L, batch_size, cfg.conv_kernel - 1, Ei), dtype),
                 jnp.zeros((L, batch_size, Ei, cfg.state_size),
