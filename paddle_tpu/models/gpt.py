@@ -90,8 +90,9 @@ class GPTBlock(Module):
 
     def __call__(self, x, cache=None, *, index=None, training: bool = False):
         """``cache``/``index`` follow the LlamaAttention static-KV-cache
-        contract (llama.py:128): fixed [B, S, H, D] buffers, ``index``
-        the write offset; returns ``(x, new_cache)`` when caching."""
+        contract (llama.py:128): read-only [B, H, S, D] layer slices,
+        ``index`` the write offset; returns ``(x, payload)`` when
+        caching (the chunk k/v for the model-level stacked write)."""
         import jax.ad_checkpoint
 
         B, T, E = x.shape
@@ -150,7 +151,7 @@ class GPTForCausalLM(Module):
                                                training=training))
 
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
-        """Stacked static KV cache ([L, B, S, H, D] ×2) — the
+        """Stacked static KV cache ([L, B, H, S, D] ×2) — the
         llama/generation.py decode contract."""
         cfg = self.config
         if max_len > cfg.max_seq_len:
@@ -170,10 +171,12 @@ class GPTForCausalLM(Module):
     def forward_with_cache(self, input_ids, cache, index):
         """Prefill (whole prompt at index 0) or decode (one token at
         index t); learned positions are offset by ``index``."""
+        from paddle_tpu.models._common import apply_cache_writes
         T = input_ids.shape[1]
         x = (self.embed(input_ids)
              + self.pos_embed(index + jnp.arange(T)))
-        x, cache = self.blocks.scan_with(x, cache, index=index)
+        x, payload = self.blocks.scan_with(x, cache, index=index)
+        cache = apply_cache_writes(cache, payload, index)
         return self.lm_head(self.ln_f(x)), cache
 
     def generate(self, input_ids, max_new_tokens: int, **kwargs):

@@ -128,9 +128,12 @@ class LlamaAttention(Module):
     def __call__(self, x, positions=None, cache=None, index=None,
                  training: bool = False):
         """Forward. ``cache``/``index`` enable incremental decoding with a
-        *static* KV cache: ``cache = (k_buf, v_buf)`` of fixed shape
-        [B, S, Hkv, D] and ``index`` the write offset of this chunk. The
-        fixed shape means one compiled decode step serves every position
+        *static* KV cache: ``cache`` is this layer's read-only slice
+        (``(k_buf, v_buf)`` [B, Hkv, S, D], or the int8 4-tuple) and
+        ``index`` the write offset of this chunk. The cached branch
+        returns ``(out, payload)`` — the chunk's k/v for the model-level
+        stacked write (``models._common.apply_cache_writes``). The fixed
+        shape means one compiled decode step serves every position
         (XLA-friendly; the reference's growing-concat Cache in
         ``python/paddle/nn/layer/transformer.py`` recompiles per length
         under jit)."""
@@ -159,8 +162,8 @@ class LlamaAttention(Module):
         k = F.apply_rotary(k, cos, sin)
         if cache is not None:
             from paddle_tpu.models._common import cached_attention
-            out, new_cache = cached_attention(q, k, v, cache, index)
-            return self.wo(out.reshape(B, T, E)), new_cache
+            out, payload = cached_attention(q, k, v, cache, index)
+            return self.wo(out.reshape(B, T, E)), payload
         # activations: shard heads over tp inside the einsum via sharded
         # inputs; flash path kicks in on TPU for supported shapes
         if self.seq_mode != "none":
@@ -310,7 +313,8 @@ class LlamaForCausalLM(Module):
 
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         """Stacked static KV cache for all layers:
-        ([L, B, S, Hkv, D], [L, B, S, Hkv, D]) zeros."""
+        ([L, B, Hkv, S, D], [L, B, Hkv, S, D]) zeros (batch on axis 1 —
+        the beam-search reorder contract)."""
         from paddle_tpu.models._common import init_kv_cache
         cfg = self.config
         return init_kv_cache(cfg.num_layers, batch_size, max_len,
@@ -321,9 +325,15 @@ class LlamaForCausalLM(Module):
     def forward_with_cache(self, input_ids, cache, index):
         """Forward a chunk (prefill: the whole prompt at index 0; decode:
         one token at index t) updating the static KV cache. Returns
-        (logits [B, T, V], new_cache)."""
+        (logits [B, T, V], new_cache). The scan reads per-layer cache
+        slices and collects each layer's chunk k/v; ONE stacked
+        dynamic_update_slice then writes all layers — in place under the
+        decode loop's donated carry (re-stacking the cache through scan
+        outputs cost a full cache copy per token)."""
+        from paddle_tpu.models._common import apply_cache_writes
         x = self.embed(input_ids)
-        x, cache = self.blocks.scan_with(x, cache, index=index)
+        x, payload = self.blocks.scan_with(x, cache, index=index)
+        cache = apply_cache_writes(cache, payload, index)
         x = self.norm(x)
         if self.lm_head is not None:
             return self.lm_head(x), cache

@@ -144,7 +144,7 @@ class MoEForCausalLM(Module):
         return self.forward_with_aux(input_ids, training)[0]
 
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
-        """Stacked static KV cache ([L, B, S, Hkv, D] ×2) — the shared
+        """Stacked static KV cache ([L, B, Hkv, S, D] ×2) — the shared
         generation contract (batch on axis 1: beam_search reorders cache
         leaves along it). Expert MLPs are stateless in decode: each step
         routes the live tokens through the same top-k machinery as
@@ -157,17 +157,22 @@ class MoEForCausalLM(Module):
                              jnp.dtype(dtype or cfg.dtype))
 
     def forward_with_cache(self, input_ids, cache, index):
+        from paddle_tpu.models._common import apply_cache_writes
+
         x = self.embed(input_ids)
-        # arity-agnostic layer unstack/restack: works for the plain
-        # (k, v) layout and the int8 (k, v, k_scale, v_scale) layout
+        # arity-agnostic payload collection: works for the plain (k, v)
+        # layout and the int8 (k, v, k_scale, v_scale) layout; the
+        # stacked write happens once, after all layers (llama.py
+        # forward_with_cache rationale)
         outs = tuple([] for _ in cache)
         for i, block in enumerate(self.blocks):
-            x, _aux, new_c = block(x, cache=tuple(c[i] for c in cache),
-                                   index=index)
-            for lst, c in zip(outs, new_c):
+            x, _aux, pay = block(x, cache=tuple(c[i] for c in cache),
+                                 index=index)
+            for lst, c in zip(outs, pay):
                 lst.append(c)
+        payload = tuple(jnp.stack(lst) for lst in outs)
         return (self.lm_head(self.norm(x)),
-                tuple(jnp.stack(lst) for lst in outs))
+                apply_cache_writes(cache, payload, index))
 
     def generate(self, input_ids, max_new_tokens: int, **kwargs):
         from paddle_tpu.models.generation import generate

@@ -1,0 +1,204 @@
+"""Fused single-token decode attention over the static KV cache.
+
+The serving hot loop: every generated token attends its one query
+against the filled prefix of the per-layer cache. The XLA einsum path
+pays three taxes this kernel deletes (all measured on the v5e bench
+geometry, BASELINE.md decode table):
+
+- it reads the WHOLE [S] buffer every step even when only ``index`` of
+  ``S`` positions are live — this kernel bounds the K/V DMA to the
+  filled prefix (blocks past the fill map to the same block index via
+  the scalar-prefetched ``index``, and Mosaic elides the repeated DMA);
+- the int8 cache dequant materializes full bf16 copies of k/v — here
+  the int8 blocks go MXU-ready as ``convert(int8)`` and both scales fold
+  into the [G, bk] logit/prob planes (column-wise multiplies), so the
+  HBM traffic really is the int8 bytes;
+- the online-softmax statistics live in VMEM across key blocks — no
+  [B, H, 1, S] logits round trip.
+
+The fresh token's k/v (raw dtype, exact) join the softmax as grid step
+0; cache blocks stream as steps 1..nk with positions ``>= index``
+masked. Layout contract matches ``models._common.init_kv_cache``:
+per-layer cache slices [B, Hkv, S, D] (+ f32 scales [B, Hkv, S] for the
+int8 layout), q [B, 1, Hq, D].
+
+Reference role: the decode half of the reference's fused attention
+serving path (``paddle/fluid/operators/fused/multihead_matmul_op.cu``
+feeding ``inference/api/analysis_predictor.h``); inference-only, no VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas import _support
+
+LANES = 128
+NEG_INF = -1e30
+
+
+def _block_k(S: int) -> int:
+    for bk in (512, 256, 128):
+        if S % bk == 0:
+            return bk
+    return 0
+
+
+def supported(q, cache) -> bool:
+    """Kernel gate; callers fall back to the einsum path when False.
+    Decode chunks only (T == 1); prefill always takes the flash path."""
+    mode = _support.dispatch_mode()
+    if mode not in ("raw",):
+        return False
+    if q.ndim != 4 or q.shape[1] != 1:
+        return False
+    B, T, Hq, D = q.shape
+    k = cache[0]
+    if k.ndim != 4:
+        return False
+    _, Hkv, S, Dk = k.shape
+    if Dk != D or D not in (64, 128, 256) or Hq % Hkv:
+        return False
+    if _block_k(S) == 0:
+        return False
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    quantized = len(cache) == 4
+    if quantized and k.dtype != jnp.int8:
+        return False
+    if not quantized and k.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    return True
+
+
+def _kernel(idx_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
+            scale, bk, nk, G, Hkv, quantized, out_dtype):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    j = pl.program_id(1)
+    idx = idx_ref[0]
+    last_block = jnp.maximum(idx - 1, 0) // bk
+
+    @pl.when(j == 0)
+    def _fresh():
+        # the chunk's own token: p = exp(s - m) = 1, l = 1, acc = v_new
+        q = q_ref[0].astype(jnp.float32)            # [Hq, D]
+        kn = kn_ref[0].astype(jnp.float32)          # [Hkv, D]
+        vn = vn_ref[0].astype(jnp.float32)
+        for h in range(Hkv):
+            rows = slice(h * G, (h + 1) * G)
+            s_h = jnp.sum(q[rows] * kn[h:h + 1], axis=1,
+                          keepdims=True) * scale    # [G, 1]
+            m_ref[rows, :] = jnp.broadcast_to(s_h, (G, LANES))
+            acc_ref[rows, :] = jnp.broadcast_to(vn[h:h + 1],
+                                                (G, vn.shape[1]))
+        l_ref[:, :] = jnp.ones_like(l_ref)
+
+    @pl.when((j > 0) & (j - 1 <= last_block))
+    def _cache_block():
+        jb = j - 1
+        q = q_ref[0].astype(jnp.float32)            # [Hq, D]
+        pos = jb * bk + jax.lax.broadcasted_iota(jnp.int32, (G, bk), 1)
+        valid = pos < idx
+        for h in range(Hkv):
+            rows = slice(h * G, (h + 1) * G)
+            kh = kc_ref[0, h].astype(jnp.float32)   # [bk, D]
+            s = jax.lax.dot_general(
+                q[rows], kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [G, bk]
+            if quantized:
+                # per-position scale folds into the logit plane
+                s = s * ks_ref[0, h:h + 1, :]
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[rows, :1]
+            l_prev = l_ref[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)                  # [G, bk]
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[rows, :1] = alpha * l_prev + jnp.sum(p, axis=1,
+                                                      keepdims=True)
+            m_ref[rows, :1] = m_new
+            if quantized:
+                # v scale folds into the prob plane
+                p = p * vs_ref[0, h:h + 1, :]
+            pv = jax.lax.dot_general(
+                p, vc_ref[0, h].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [G, D]
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
+
+    @pl.when(j == nk)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:, :] / jnp.where(l == 0.0, 1.0, l)).astype(
+            out_dtype)
+
+
+def decode_attention(q, k_new, v_new, cache, index, *, scale: float):
+    """q [B, 1, Hq, D]; k_new/v_new [B, Hkv, 1, D] (this step's raw k/v);
+    ``cache`` the per-layer read-only slice; ``index`` traced int32 fill
+    position (cache holds tokens [0, index)). Returns [B, 1, Hq, D]."""
+    B, T, Hq, D = q.shape
+    Hkv = k_new.shape[1]
+    G = Hq // Hkv
+    quantized = len(cache) == 4
+    kc, vc = cache[0], cache[1]
+    S = kc.shape[2]
+    bk = _block_k(S)
+    nk = S // bk
+
+    q2 = q.reshape(B, Hq, D)
+    kn2 = k_new.reshape(B, Hkv, D)
+    vn2 = v_new.reshape(B, Hkv, D)
+    idx_arr = jnp.asarray(index, jnp.int32).reshape(1)
+
+    def cache_map(b, j, idx_ref):
+        last = jnp.maximum(idx_ref[0] - 1, 0) // bk
+        return (b, 0, jnp.minimum(jnp.maximum(j - 1, 0), last), 0)
+
+    def scale_map(b, j, idx_ref):
+        last = jnp.maximum(idx_ref[0] - 1, 0) // bk
+        return (b, 0, jnp.minimum(jnp.maximum(j - 1, 0), last))
+
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), lambda b, j, i: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j, i: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j, i: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, bk, D), cache_map),
+        pl.BlockSpec((1, Hkv, bk, D), cache_map),
+    ]
+    args = [q2, kn2, vn2, kc, vc]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, Hkv, bk), scale_map),
+                     pl.BlockSpec((1, Hkv, bk), scale_map)]
+        args += [cache[2], cache[3]]
+
+    kernel = functools.partial(
+        _kernel, scale=scale, bk=bk, nk=nk, G=G, Hkv=Hkv,
+        quantized=quantized, out_dtype=q.dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, nk + 1),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, i: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((Hq, D), jnp.float32),
+                pltpu.VMEM((Hq, LANES), jnp.float32),
+                pltpu.VMEM((Hq, LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        compiler_params=_support.compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_support.interpret(),
+    )(idx_arr, *args)
+    return out.reshape(B, 1, Hq, D)

@@ -1,0 +1,152 @@
+"""Pallas decode-attention kernel (ops/pallas/decode_attention.py).
+
+OpTest discipline (reference
+``python/paddle/fluid/tests/unittests/op_test.py:226``): the kernel must
+reproduce the einsum fallback bit-for-bit in interpret mode (same dtype
+path, same visibility set), bound its reads to the filled prefix, and
+fold the int8 scales exactly.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import _common
+from paddle_tpu.ops.pallas import _support, decode_attention as dk
+
+
+def _mk(B=2, Hq=8, Hkv=4, S=256, D=64, dtype=jnp.float32, quant=False,
+        seed=0):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, 1, Hq, D), dtype)
+    k_new = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
+    v_new = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
+    if quant:
+        kc = jnp.asarray(rs.randint(-127, 128, (B, Hkv, S, D)), jnp.int8)
+        vc = jnp.asarray(rs.randint(-127, 128, (B, Hkv, S, D)), jnp.int8)
+        ks = jnp.asarray(rs.rand(B, Hkv, S) * 0.05 + 0.001, jnp.float32)
+        vs = jnp.asarray(rs.rand(B, Hkv, S) * 0.05 + 0.001, jnp.float32)
+        cache = (kc, vc, ks, vs)
+    else:
+        cache = (jnp.asarray(rs.randn(B, Hkv, S, D), dtype),
+                 jnp.asarray(rs.randn(B, Hkv, S, D), dtype))
+    return q, k_new, v_new, cache
+
+
+def _fallback(q, k_new, v_new, cache, idx):
+    """The einsum path of models._common.cached_attention, decode branch
+    (q [B,1,Hq,D], chunk already in buffer layout)."""
+    B, T, Hq, D = q.shape
+    Hkv = k_new.shape[1]
+    G = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    if len(cache) == 4:
+        k_c, v_c, k_s, v_s = cache
+        kc = k_c.astype(q.dtype) * k_s.astype(q.dtype)[..., None]
+        vc = v_c.astype(q.dtype) * v_s.astype(q.dtype)[..., None]
+    else:
+        kc, vc = cache
+    S = kc.shape[2]
+    qh = q.transpose(0, 2, 1, 3).reshape(B, Hkv, G, T, D)
+    neg = jnp.finfo(jnp.float32).min
+    s_c = jnp.einsum("bkgtd,bksd->bkgts", qh, kc) * scale
+    s_c = jnp.where((jnp.arange(S) < idx)[None, None, None, None, :],
+                    s_c.astype(jnp.float32), neg)
+    s_n = (jnp.einsum("bkgtd,bkud->bkgtu", qh, k_new) * scale
+           ).astype(jnp.float32)
+    probs = jax.nn.softmax(jnp.concatenate([s_c, s_n], -1), axis=-1)
+    p_c = probs[..., :S].astype(q.dtype)
+    p_n = probs[..., S:].astype(q.dtype)
+    out = (jnp.einsum("bkgts,bksd->bkgtd", p_c, vc)
+           + jnp.einsum("bkgtu,bkud->bkgtd", p_n, v_new))
+    return out.reshape(B, Hq, T, D).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("idx", [1, 37, 128, 255])
+def test_kernel_matches_fallback(quant, idx):
+    q, kn, vn, cache = _mk(quant=quant)
+    with _support.force_dispatch():
+        assert dk.supported(q, cache)
+        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(idx),
+                                  scale=1.0 / 8.0)
+    want = _fallback(q, kn, vn, cache, idx)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_gqa_group_mapping():
+    """Hq=8, Hkv=2 (G=4): each q head must read ITS kv head's cache."""
+    q, kn, vn, cache = _mk(Hq=8, Hkv=2, seed=3)
+    with _support.force_dispatch():
+        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(100),
+                                  scale=0.125)
+    want = _fallback(q, kn, vn, cache, 100)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_ignores_stale_positions():
+    """Positions >= index must not contribute: poisoning them with huge
+    values changes nothing."""
+    q, kn, vn, cache = _mk(seed=1)
+    idx = 64
+    k, v = np.asarray(cache[0]).copy(), np.asarray(cache[1]).copy()
+    k[:, :, idx:] = 1e4
+    v[:, :, idx:] = -1e4
+    poisoned = (jnp.asarray(k), jnp.asarray(v))
+    with _support.force_dispatch():
+        a = dk.decode_attention(q, kn, vn, cache, jnp.int32(idx),
+                                scale=0.125)
+        b = dk.decode_attention(q, kn, vn, poisoned, jnp.int32(idx),
+                                scale=0.125)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_supported_gates():
+    q, _, _, cache = _mk()
+    with _support.force_dispatch():
+        assert dk.supported(q, cache)
+        # prefill chunk (T > 1) is not the kernel's job
+        assert not dk.supported(jnp.zeros((2, 4, 8, 64)), cache)
+        # head_dim off the MXU grid
+        assert not dk.supported(jnp.zeros((2, 1, 8, 32)), (
+            jnp.zeros((2, 4, 256, 32)),) * 2)
+        # S not blockable
+        assert not dk.supported(jnp.zeros((2, 1, 8, 64)), (
+            jnp.zeros((2, 4, 100, 64)),) * 2)
+    # no dispatch context off-TPU → fallback (on a TPU host the bare
+    # call legitimately dispatches)
+    if not _support.on_tpu():
+        assert not dk.supported(q, cache)
+
+
+def test_cached_attention_dispatches_kernel(monkeypatch):
+    """models._common.cached_attention must route supported decode
+    shapes through the kernel (and produce the same payload/out as the
+    fallback it replaces)."""
+    rs = np.random.RandomState(5)
+    B, Hq, Hkv, S, D = 2, 4, 4, 128, 64
+    q = jnp.asarray(rs.randn(B, 1, Hq, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, 1, Hkv, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, 1, Hkv, D), jnp.float32)
+    cache = (jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32),
+             jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32))
+    calls = {}
+    orig = dk.decode_attention
+
+    def spy(*a, **kw):
+        calls["hit"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(dk, "decode_attention", spy)
+    with _support.force_dispatch():
+        out_k, pay_k = _common.cached_attention(q, k, v, cache,
+                                                jnp.int32(50))
+    assert calls.get("hit")
+    out_f, pay_f = _common.cached_attention(q, k, v, cache, jnp.int32(50))
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_f),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(pay_k, pay_f):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
