@@ -28,6 +28,7 @@ PEAK_FLOPS = {
     "v5p": 459e12,
     "v4": 275e12,
     "v6e": 918e12,
+    "v6 lite": 918e12,   # v6e reports device_kind "TPU v6 lite"
 }
 A100_CLASS_MFU = 0.40
 
@@ -38,6 +39,26 @@ def detect_peak_flops(device) -> float:
         if key in kind:
             return flops
     return 197e12  # conservative default
+
+
+# per-chip HBM bandwidth (bytes/s) by TPU generation — the decode
+# roofline (BASELINE.md serving table): tokens/s ≈ BW / bytes-per-token
+PEAK_HBM_BW = {
+    "v5e": 819e9,
+    "v5 lite": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6e": 1638e9,
+    "v6 lite": 1638e9,   # v6e reports device_kind "TPU v6 lite"
+}
+
+
+def detect_peak_bandwidth(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, bw in PEAK_HBM_BW.items():
+        if key in kind:
+            return bw
+    return 819e9
 
 
 def main():

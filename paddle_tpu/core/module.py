@@ -102,6 +102,16 @@ class Module:
         new = object.__new__(type(self))
         new.__dict__.update(self.__dict__)
         new.__dict__.update(changes)
+        # unflattened modules carry a _data_fields__ split override (see
+        # _split_fields); genuinely NEW array-valued fields must join it
+        # or they would silently become static aux (dropped from jit
+        # arguments, invisible to tree_map)
+        override = new.__dict__.get("_data_fields__")
+        if override is not None:
+            add = {k for k, v in changes.items()
+                   if k not in override and _is_data(v)}
+            if add:
+                new.__dict__["_data_fields__"] = frozenset(override) | add
         return new
 
     def named_parameters(self):

@@ -37,37 +37,47 @@ def _quant_chunk(x):
     return xq, s
 
 
-def cached_attention(q, k, v, cache, index):
+def cached_attention(q, k, v, cache, index, layer=0):
     """Static-KV-cache attention core shared by every attention family
-    (llama GQA, GPT fused-MHA, MoE). ``cache`` is this layer's READ-ONLY
-    slice of the stacked buffers; the new tokens are NOT written here —
-    they are returned as a write payload and the model applies ONE
-    stacked ``dynamic_update_slice`` per step (``apply_cache_writes``).
-    Splitting read from write keeps the per-step HBM traffic at
-    (filled cache read) + (one-token write): the earlier write-through
-    design re-stacked the whole cache through ``lax.scan`` outputs every
-    step — a full cache copy per generated token (measured ~2 ms/step on
-    the bench geometry, v5e).
+    (llama GQA, GPT fused-MHA, MoE). ``cache`` holds the FULL stacked
+    read-only buffers ([L, B, Hkv, S, D] — see ``init_kv_cache``) and
+    ``layer`` is this block's layer id (a traced scalar under the layer
+    scan, a python int under a block loop). The new tokens are NOT
+    written here — they are returned as a write payload and the model
+    applies ONE stacked ``dynamic_update_slice`` per step
+    (``apply_cache_writes``). Two measured-on-v5e design constraints
+    shape this contract:
+
+    - re-stacking the cache through ``lax.scan`` outputs cost a full
+      cache copy per generated token (~2 ms/step on the bench geometry)
+      → read/write split;
+    - slicing the layer OUT of the stacked buffer costs a full layer
+      copy per layer per step when the consumer is the Pallas kernel
+      (XLA cannot fuse a dynamic-slice producer into a custom call;
+      ~1.45 ms/step) → the kernel receives the stacked buffers and picks
+      the layer inside its index maps via the scalar-prefetched ``layer``.
 
     The chunk's own k/v attend fresh (raw dtype, exact) while previous
     positions read from the buffer: key j < index from cache, chunk-local
     causal for [index, index+T) — the same visibility set as writing
     first and masking j <= index + t.
 
-    Two cache layouts (per-layer slices; see ``init_kv_cache``):
-    - ``(k_buf, v_buf)`` [B, Hkv, S, D] — plain buffers, any float dtype.
+    Two cache layouts:
+    - ``(k_buf, v_buf)`` [L, B, Hkv, S, D] — any float dtype.
     - ``(k_q, v_q, k_scale, v_scale)`` — int8 buffers + f32
-      per-(head, position) scales [B, Hkv, S].
+      per-(head, position) scales [L, B, Hkv, S].
 
-    The [B, Hkv, S, D] layout (heads ahead of sequence) matters on TPU:
-    the decode attention contracts D and batches (B, Hkv), so S×D are
-    the minor-most dims exactly as the MXU wants them — the previous
-    [B, S, Hkv, D] layout made XLA physically transpose both buffers
+    The [..., Hkv, S, D] layout (heads ahead of sequence) matters on
+    TPU: the decode attention contracts D and batches (B, Hkv), so S×D
+    are the minor-most dims exactly as the MXU wants them — the previous
+    [..., S, Hkv, D] layout made XLA physically transpose both buffers
     every step (measured ~0.9 ms/step extra on the bench geometry).
 
     Returns ``(out [B, T, Hq, D], payload)`` where payload leaves are the
     chunk k/v in buffer layout ([B, Hkv, T, D], scales [B, Hkv, T]).
     """
+    import jax
+
     quantized = len(cache) == 4
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -92,20 +102,24 @@ def cached_attention(q, k, v, cache, index):
     idx = jnp.asarray(index, jnp.int32)
     from paddle_tpu.ops.pallas import decode_attention as _dk
     if _dk.supported(q, cache):
-        out = _dk.decode_attention(q, kt, vt, cache, idx, scale=scale)
+        out = _dk.decode_attention(q, kt, vt, cache, layer, idx,
+                                   scale=scale)
         return out, payload
 
-    # einsum fallback (CPU / unsupported shapes): two-piece softmax —
-    # prefix logits against the buffer + fresh-chunk causal logits,
-    # normalized jointly. GQA maps q-head (g, h) to kv-head h with no
-    # repeat of the cache.
+    # einsum fallback (CPU / unsupported shapes): slice this layer, then
+    # two-piece softmax — prefix logits against the buffer + fresh-chunk
+    # causal logits, normalized jointly. GQA maps q-head (g, h) to
+    # kv-head h with no repeat of the cache.
+    sl = (tuple(c[layer] for c in cache) if isinstance(layer, int) else
+          tuple(jax.lax.dynamic_index_in_dim(c, layer, 0, keepdims=False)
+                for c in cache))
     if quantized:
-        k_c, v_c, k_s, v_s = cache
+        k_c, v_c, k_s, v_s = sl
         dt = q.dtype
         kc = k_c.astype(dt) * k_s.astype(dt)[..., None]
         vc = v_c.astype(dt) * v_s.astype(dt)[..., None]
     else:
-        kc, vc = (c.astype(q.dtype) for c in cache)
+        kc, vc = (c.astype(q.dtype) for c in sl)
     S = kc.shape[2]
     qh = q.transpose(0, 2, 1, 3).reshape(B, Hkv, G, T, D)
     neg = jnp.finfo(jnp.float32).min
@@ -116,7 +130,6 @@ def cached_attention(q, k, v, cache, index):
     chunk_causal = (jnp.arange(T)[None, :] <= jnp.arange(T)[:, None])
     s_n = jnp.where(chunk_causal[None, None, None],
                     s_n.astype(jnp.float32), neg)
-    import jax
     probs = jax.nn.softmax(jnp.concatenate([s_c, s_n], axis=-1), axis=-1)
     p_c, p_n = probs[..., :S].astype(q.dtype), probs[..., S:].astype(q.dtype)
     out = (jnp.einsum("bkgts,bksd->bkgtd", p_c, vc)

@@ -88,11 +88,13 @@ class GPTBlock(Module):
         self.num_heads = cfg.num_heads
         self.head_dim = E // cfg.num_heads
 
-    def __call__(self, x, cache=None, *, index=None, training: bool = False):
-        """``cache``/``index`` follow the LlamaAttention static-KV-cache
-        contract (llama.py:128): read-only [B, H, S, D] layer slices,
-        ``index`` the write offset; returns ``(x, payload)`` when
-        caching (the chunk k/v for the model-level stacked write)."""
+    def __call__(self, x, layer=None, *, cache=None, index=None,
+                 training: bool = False):
+        """``cache``/``index``/``layer`` follow the LlamaAttention
+        static-KV-cache contract (llama.py:128): full stacked read-only
+        [L, B, H, S, D] buffers + this block's layer id, ``index`` the
+        write offset; returns ``(x, payload)`` when caching (the chunk
+        k/v for the model-level stacked write)."""
         import jax.ad_checkpoint
 
         B, T, E = x.shape
@@ -102,7 +104,8 @@ class GPTBlock(Module):
         new_cache = None
         if cache is not None:
             from paddle_tpu.models._common import cached_attention
-            a, new_cache = cached_attention(q, k, v, cache, index)
+            a, new_cache = cached_attention(
+                q, k, v, cache, index, layer=0 if layer is None else layer)
         else:
             a = F.scaled_dot_product_attention(q, k, v, causal=True)
         # one shared tail for cached and uncached forwards (same dropout
@@ -175,7 +178,9 @@ class GPTForCausalLM(Module):
         T = input_ids.shape[1]
         x = (self.embed(input_ids)
              + self.pos_embed(index + jnp.arange(T)))
-        x, payload = self.blocks.scan_with(x, cache, index=index)
+        x, payload = self.blocks.scan_with(
+            x, jnp.arange(self.config.num_layers), cache=cache,
+            index=index)
         cache = apply_cache_writes(cache, payload, index)
         return self.lm_head(self.ln_f(x)), cache
 

@@ -126,15 +126,16 @@ class LlamaAttention(Module):
         self.seq_mode = "none"
 
     def __call__(self, x, positions=None, cache=None, index=None,
-                 training: bool = False):
-        """Forward. ``cache``/``index`` enable incremental decoding with a
-        *static* KV cache: ``cache`` is this layer's read-only slice
-        (``(k_buf, v_buf)`` [B, Hkv, S, D], or the int8 4-tuple) and
-        ``index`` the write offset of this chunk. The cached branch
-        returns ``(out, payload)`` — the chunk's k/v for the model-level
-        stacked write (``models._common.apply_cache_writes``). The fixed
-        shape means one compiled decode step serves every position
-        (XLA-friendly; the reference's growing-concat Cache in
+                 layer=0, training: bool = False):
+        """Forward. ``cache``/``index``/``layer`` enable incremental
+        decoding with a *static* KV cache: ``cache`` holds the full
+        stacked read-only buffers (``(k_buf, v_buf)``
+        [L, B, Hkv, S, D], or the int8 4-tuple), ``layer`` this block's
+        layer id, ``index`` the write offset of this chunk. The cached
+        branch returns ``(out, payload)`` — the chunk's k/v for the
+        model-level stacked write (``models._common.apply_cache_writes``).
+        The fixed shape means one compiled decode step serves every
+        position (XLA-friendly; the reference's growing-concat Cache in
         ``python/paddle/nn/layer/transformer.py`` recompiles per length
         under jit)."""
         B, T, E = x.shape
@@ -162,7 +163,8 @@ class LlamaAttention(Module):
         k = F.apply_rotary(k, cos, sin)
         if cache is not None:
             from paddle_tpu.models._common import cached_attention
-            out, payload = cached_attention(q, k, v, cache, index)
+            out, payload = cached_attention(q, k, v, cache, index,
+                                            layer=layer)
             return self.wo(out.reshape(B, T, E)), payload
         # activations: shard heads over tp inside the einsum via sharded
         # inputs; flash path kicks in on TPU for supported shapes
@@ -209,8 +211,10 @@ class LlamaBlock(Module):
                                 dtype=dtype)
         self.mlp = LlamaMLP(cfg, key=k2)
 
-    def __call__(self, x, cache=None, *, index=None, training: bool = False):
+    def __call__(self, x, layer=None, *, cache=None, index=None,
+                 training: bool = False):
         attn_out = self.attn(self.attn_norm(x), cache=cache, index=index,
+                             layer=0 if layer is None else layer,
                              training=training)
         new_cache = None
         if cache is not None:
@@ -325,14 +329,19 @@ class LlamaForCausalLM(Module):
     def forward_with_cache(self, input_ids, cache, index):
         """Forward a chunk (prefill: the whole prompt at index 0; decode:
         one token at index t) updating the static KV cache. Returns
-        (logits [B, T, V], new_cache). The scan reads per-layer cache
-        slices and collects each layer's chunk k/v; ONE stacked
-        dynamic_update_slice then writes all layers — in place under the
-        decode loop's donated carry (re-stacking the cache through scan
-        outputs cost a full cache copy per token)."""
+        (logits [B, T, V], new_cache). The stacked cache rides the scan
+        as a closed-over constant — each block reads it through its
+        layer id (no per-layer slice materializes; see
+        ``_common.cached_attention``) and contributes its chunk k/v to
+        the scan outputs; ONE stacked dynamic_update_slice then writes
+        all layers — in place under the decode loop's donated carry
+        (re-stacking the cache through scan outputs cost a full cache
+        copy per token)."""
         from paddle_tpu.models._common import apply_cache_writes
         x = self.embed(input_ids)
-        x, payload = self.blocks.scan_with(x, cache, index=index)
+        x, payload = self.blocks.scan_with(
+            x, jnp.arange(self.config.num_layers), cache=cache,
+            index=index)
         cache = apply_cache_writes(cache, payload, index)
         x = self.norm(x)
         if self.lm_head is not None:

@@ -5,10 +5,11 @@ SURVEY.md §2.3.8); included because expert parallelism is a first-class
 mesh axis of this framework (``ep``; see ``nn/moe.py`` for the
 dispatch/all_to_all design and ``core/strategy.py`` ExpertParallelConfig).
 
-Layers are a python loop rather than scan-stacked: each block's aux
-(load-balancing) loss joins the training loss, and the small layer count
-of MoE configs (compute lives in width, not depth) keeps compile time
-fine without scan.
+Layers are scan-stacked (``nn.ScannedBlocks``) like every other decoder
+family, so the pipeline override and the 1F1B schedule apply to MoE
+unchanged — pp×ep×fsdp hybrids compose. The per-block load-balancing
+aux loss rides the per-layer state tape rather than the scan carry
+(``nn.stateful.record_aux``; see MoEBlock).
 """
 
 from __future__ import annotations
@@ -73,7 +74,19 @@ class MoEConfig:
 
 
 class MoEBlock(Module):
+    """Scan-stackable MoE decoder block (carry-to-carry). The
+    load-balancing aux loss does NOT travel in the carry: each block
+    records its pre-scaled contribution (``aux · weight / L``) on the
+    per-layer state tape (``nn.stateful.record_aux``), which every
+    scan-based executor — plain ScannedBlocks, the GPipe tick scan, the
+    1F1B schedule (with cotangent seeding) — already transports. That is
+    what lets MoE blocks ride pipelines like any other block (the
+    reference's section programs carry no model-class carve-outs,
+    ``framework/section_worker.cc:44``)."""
+
     def __init__(self, cfg: MoEConfig, key=None):
+        from paddle_tpu.nn.stateful import new_uid
+
         k1, k2 = rng.split_key(key)
         dtype = jnp.dtype(cfg.dtype)
         self.attn_norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps,
@@ -87,17 +100,26 @@ class MoEBlock(Module):
                           init_std=cfg.init_std,
                           num_layers=cfg.num_layers, dtype=dtype,
                           dispatch_mode=cfg.dispatch_mode, key=k2)
+        self._uid = new_uid()
+        self._aux_scale = float(cfg.aux_loss_weight) / max(
+            cfg.num_layers, 1)
 
-    def __call__(self, x, cache=None, *, index=None, training: bool = False):
+    def __call__(self, x, layer=None, *, cache=None, index=None,
+                 training: bool = False):
+        from paddle_tpu.nn.stateful import record_aux
+
+        new_cache = None
         if cache is not None:
-            attn_out, new_cache = self.attn(self.attn_norm(x), cache=cache,
-                                            index=index, training=training)
+            attn_out, new_cache = self.attn(
+                self.attn_norm(x), cache=cache, index=index,
+                layer=0 if layer is None else layer, training=training)
             x = x + attn_out
-            mlp_out, aux = self.moe(self.mlp_norm(x))
-            return x + mlp_out, aux, new_cache
-        x = x + self.attn(self.attn_norm(x), training=training)
+        else:
+            x = x + self.attn(self.attn_norm(x), training=training)
         mlp_out, aux = self.moe(self.mlp_norm(x))
-        return x + mlp_out, aux
+        record_aux(self._uid, aux.astype(jnp.float32) * self._aux_scale)
+        x = x + mlp_out
+        return x if new_cache is None else (x, new_cache)
 
 
 class MoEForCausalLM(Module):
@@ -105,14 +127,21 @@ class MoEForCausalLM(Module):
     term in with ``aux_loss_weight``."""
 
     def __init__(self, cfg: MoEConfig, key=None):
+        from paddle_tpu.nn.scan import ScannedBlocks
+
         keys = rng.split_key(key, 2 + cfg.num_layers)
         dtype = jnp.dtype(cfg.dtype)
         self.embed = Embedding(cfg.vocab_size, cfg.hidden_size,
                                weight_init=Normal(0.0, cfg.init_std),
                                dtype=dtype, key=keys[0],
                                pspec=P("tp", "fsdp"))
-        self.blocks = tuple(
-            MoEBlock(cfg, key=keys[2 + i]) for i in range(cfg.num_layers))
+        # scan-stacked like every other decoder family (expert weights
+        # get a leading layer axis [L, E, ...]): the pipeline override
+        # and the 1F1B schedule apply to MoE unchanged — the aux loss
+        # rides the per-layer tape, not the carry (see MoEBlock)
+        self.blocks = ScannedBlocks(
+            lambda i: MoEBlock(cfg, key=keys[2 + i]), cfg.num_layers,
+            remat=cfg.remat, remat_policy=cfg.remat_policy)
         self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps,
                             dtype=dtype)
         self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias=False,
@@ -122,23 +151,23 @@ class MoEForCausalLM(Module):
         self.config = cfg
 
     def forward_with_aux(self, input_ids, training: bool = False):
+        """Returns ``(logits, aux_term)`` where ``aux_term`` is the
+        READY-TO-ADD loss contribution (already scaled by
+        ``aux_loss_weight / num_layers`` and summed over layers):
+        ``loss = ce + aux_term``. The per-layer contributions are
+        collected off the state tape (see MoEBlock) — the same channel
+        the pipeline executors transport — and re-emitted onward so an
+        outer trainer tape still sees them."""
+        from paddle_tpu.nn.stateful import collect_aux, record_state, \
+            tape_call
+
         x = self.embed(input_ids)
-        aux_total = jnp.zeros((), jnp.float32)
-        blk_fn = lambda b, h: b(h, training=training)
-        if self.config.remat:
-            # per-block remat (the python-loop analogue of ScannedBlocks'
-            # checkpointed scan body): activations of each MoE block —
-            # including the [E, C, H/I] expert buffers — are recomputed
-            # in backward under the configured policy
-            import jax as _jax
-            from paddle_tpu.nn.scan import REMAT_POLICIES
-            blk_fn = _jax.checkpoint(
-                blk_fn, policy=REMAT_POLICIES[self.config.remat_policy])
-        for block in self.blocks:
-            x, aux = blk_fn(block, x)
-            aux_total = aux_total + aux
+        x, tape = tape_call(self.blocks, x, training=training)
+        aux_term = collect_aux(tape)
+        for uid, updates in tape.items():
+            record_state(uid, **updates)
         logits = self.lm_head(self.norm(x))
-        return logits, aux_total / max(len(self.blocks), 1)
+        return logits, aux_term
 
     def __call__(self, input_ids, training: bool = False):
         return self.forward_with_aux(input_ids, training)[0]
@@ -157,22 +186,23 @@ class MoEForCausalLM(Module):
                              jnp.dtype(dtype or cfg.dtype))
 
     def forward_with_cache(self, input_ids, cache, index):
+        """Prefill/decode through the shared cache contract. Expert
+        capacity note: each chunk routes with a capacity derived from
+        the LIVE chunk's token count (B·T per step, i.e. B for decode),
+        not the full-sequence count — under capacity pressure the
+        drop/contention pattern therefore differs from the parallel
+        training forward (which routes all B·T tokens at once). Decode
+        chunks are tiny, so per-chunk capacity ≥ top_k practically never
+        drops; raise ``capacity_factor`` if bit-parity with the full
+        forward under pressure matters."""
         from paddle_tpu.models._common import apply_cache_writes
 
         x = self.embed(input_ids)
-        # arity-agnostic payload collection: works for the plain (k, v)
-        # layout and the int8 (k, v, k_scale, v_scale) layout; the
-        # stacked write happens once, after all layers (llama.py
-        # forward_with_cache rationale)
-        outs = tuple([] for _ in cache)
-        for i, block in enumerate(self.blocks):
-            x, _aux, pay = block(x, cache=tuple(c[i] for c in cache),
-                                 index=index)
-            for lst, c in zip(outs, pay):
-                lst.append(c)
-        payload = tuple(jnp.stack(lst) for lst in outs)
-        return (self.lm_head(self.norm(x)),
-                apply_cache_writes(cache, payload, index))
+        x, payload = self.blocks.scan_with(
+            x, jnp.arange(self.config.num_layers), cache=cache,
+            index=index)
+        cache = apply_cache_writes(cache, payload, index)
+        return self.lm_head(self.norm(x)), cache
 
     def generate(self, input_ids, max_new_tokens: int, **kwargs):
         from paddle_tpu.models.generation import generate
@@ -180,8 +210,39 @@ class MoEForCausalLM(Module):
 
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
-        logits, aux = self.forward_with_aux(input_ids, training=training)
+        logits, aux_term = self.forward_with_aux(input_ids,
+                                                 training=training)
         ce = F.cross_entropy(
             logits[:, :-1].astype(jnp.float32), labels[:, 1:],
             ignore_index=ignore_index, reduction="mean")
-        return ce + self.config.aux_loss_weight * aux
+        return ce + aux_term
+
+    def pipeline_parts(self):
+        """1F1B decomposition (``parallel/pipeline_1f1b.py``): embed on
+        stage 0, MoE blocks pipelined (their aux-loss tape entries get
+        cotangent-seeded by the schedule), final norm + lm head on the
+        last stage."""
+        head = (self.norm, self.lm_head)
+
+        def head_loss_sum(head, h, labels):
+            # labels arrive next-token-shifted from the schedule (see
+            # llama.pipeline_parts): full-row SUM loss; the aux term is
+            # added by the schedule from the tape, not here
+            norm, lm_head = head
+            logits = lm_head(norm(h)).astype(jnp.float32)
+            return F.cross_entropy(logits, labels, reduction="sum")
+
+        from paddle_tpu.parallel.pipeline_1f1b import default_loss_denom \
+            as loss_denom
+
+        model = self
+
+        def assemble(dembed, dblocks_stacked, dhead):
+            import jax
+            g = jax.tree_util.tree_map(jnp.zeros_like, model)
+            return g.replace(
+                embed=dembed, norm=dhead[0], lm_head=dhead[1],
+                blocks=g.blocks.replace(block=dblocks_stacked))
+
+        return (self.embed, self.blocks, head, head_loss_sum, loss_denom,
+                assemble)

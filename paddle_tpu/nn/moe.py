@@ -224,6 +224,23 @@ class MoEMLP(Module):
         return "gather"
 
     def _experts(self, expert_in):
+        sg = getattr(self, "w_gate_scale", None)
+        if sg is not None:
+            # weight-only int8 experts (quant.quantize_weights_int8):
+            # the einsum rhs is a bare convert(int8) that XLA fuses into
+            # the dot's operand stream; the per-(expert, out-channel)
+            # scale applies after the contraction — x @ (q·s) == (x @ q)·s
+            dt = expert_in.dtype
+            gate = jnp.einsum("ech,ehi->eci", expert_in,
+                              self.w_gate.astype(dt)) \
+                * sg.astype(dt)[:, None, :]
+            up = jnp.einsum("ech,ehi->eci", expert_in,
+                            self.w_up.astype(dt)) \
+                * self.w_up_scale.astype(dt)[:, None, :]
+            act = F.swiglu(up, gate)
+            return jnp.einsum("eci,eih->ech", act,
+                              self.w_down.astype(dt)) \
+                * self.w_down_scale.astype(dt)[:, None, :]
         gate = jnp.einsum("ech,ehi->eci", expert_in, self.w_gate)
         up = jnp.einsum("ech,ehi->eci", expert_in, self.w_up)
         act = F.swiglu(up, gate)
@@ -314,10 +331,12 @@ class MoEMLP(Module):
             shape = dict(mesh.shape)
             for ax in BATCH_AXES:
                 g *= shape.get(ax, 1)
-        # largest group count that both aligns with the batch shards and
-        # divides the token count (gcd — a halving loop would skip valid
-        # divisors for non-power-of-2 degrees)
-        return max(math.gcd(g, n), 1)
+        # grouping is only valid when the token count splits EXACTLY
+        # into the batch shards: a partial group count (any divisor
+        # < g) would break the P(BATCH_AXES, ...) constraint on the
+        # [G, E, Cg, H] buffers (G must be divisible by the dp·fsdp
+        # shard product) — fall back to one group (no grouping) instead
+        return g if g > 0 and n % g == 0 else 1
 
     def _call_gather_grouped(self, tokens, logits, n, h):
         """Per-group gather dispatch for expert parallelism: G groups of

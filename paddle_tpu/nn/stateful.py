@@ -62,6 +62,40 @@ def record_state(uid: int, **updates) -> bool:
     return True
 
 
+# Reserved tape entry name for per-layer auxiliary LOSS contributions
+# (MoE load-balancing). Unlike BatchNorm statistics, these are
+# differentiable loss terms: they ride the same per-layer tape through
+# every scan-based executor (ScannedBlocks, GPipe ticks, 1F1B ticks —
+# which seeds their cotangent in its manual backward), are summed by
+# ``collect_aux`` into the training loss, and are NEVER merged back into
+# module state (``merge_state`` skips them).
+AUX_LOSS_KEY = "aux_loss"
+
+
+def record_aux(uid: int, value) -> bool:
+    """Record a pre-scaled auxiliary loss contribution: ``value`` must
+    already carry its loss weight and 1/num_layers factor so that
+    ``loss = main + collect_aux(tape)`` holds under every executor."""
+    tape = _tape_var.get()
+    if tape is None:
+        return False
+    tape.setdefault(uid, {})[AUX_LOSS_KEY] = value
+    return True
+
+
+def collect_aux(tape: dict):
+    """Sum every ``AUX_LOSS_KEY`` entry on the tape (leaves may be
+    layer-stacked [L, ...] — summed) into one scalar loss term."""
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.float32)
+    for updates in tape.values():
+        if AUX_LOSS_KEY in updates:
+            total = total + jnp.sum(
+                updates[AUX_LOSS_KEY].astype(jnp.float32))
+    return total
+
+
 def map_modules(fn, tree):
     """Bottom-up map over every Module in a pytree (children first)."""
 
@@ -104,12 +138,15 @@ def merge_state(model, tape: dict):
         if uid is not None and uid in tape:
             updates = {}
             for k, v in tape[uid].items():
+                if k == AUX_LOSS_KEY:
+                    # loss contribution, not module state
+                    continue
                 cur = getattr(m, k, None)
                 if (hasattr(v, "astype") and hasattr(cur, "dtype")
                         and v.dtype != cur.dtype):
                     v = v.astype(cur.dtype)
                 updates[k] = v
-            return m.replace(**updates)
+            return m.replace(**updates) if updates else m
         return m
 
     return map_modules(fn, model)

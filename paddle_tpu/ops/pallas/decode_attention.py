@@ -5,22 +5,26 @@ against the filled prefix of the per-layer cache. The XLA einsum path
 pays three taxes this kernel deletes (all measured on the v5e bench
 geometry, BASELINE.md decode table):
 
-- it reads the WHOLE [S] buffer every step even when only ``index`` of
-  ``S`` positions are live — this kernel bounds the K/V DMA to the
-  filled prefix (blocks past the fill map to the same block index via
-  the scalar-prefetched ``index``, and Mosaic elides the repeated DMA);
+- the per-layer ``lax.scan`` slice of the stacked cache materializes a
+  full layer copy per layer per step (XLA cannot fuse a dynamic-slice
+  producer into a custom call — measured 1.45 ms/step of pure copy on
+  the bench geometry). This kernel takes the WHOLE stacked
+  [L, B, Hkv, S, D] buffers and selects the layer in its index maps via
+  a scalar-prefetched layer id — no slice ever exists;
+- it reads the whole [S] buffer even when only ``index`` of ``S``
+  positions are live — the index maps clamp the block id to the filled
+  prefix (blocks past the fill repeat the previous block index and
+  Mosaic elides the repeated DMA);
 - the int8 cache dequant materializes full bf16 copies of k/v — here
   the int8 blocks go MXU-ready as ``convert(int8)`` and both scales fold
   into the [G, bk] logit/prob planes (column-wise multiplies), so the
-  HBM traffic really is the int8 bytes;
-- the online-softmax statistics live in VMEM across key blocks — no
-  [B, H, 1, S] logits round trip.
+  HBM traffic really is the int8 bytes.
 
 The fresh token's k/v (raw dtype, exact) join the softmax as grid step
 0; cache blocks stream as steps 1..nk with positions ``>= index``
-masked. Layout contract matches ``models._common.init_kv_cache``:
-per-layer cache slices [B, Hkv, S, D] (+ f32 scales [B, Hkv, S] for the
-int8 layout), q [B, 1, Hq, D].
+masked. Layout contract matches ``models._common.init_kv_cache``
+(stacked [L, B, Hkv, S, D], f32 scales [L, B, Hkv, S] for int8);
+q [B, 1, Hq, D].
 
 Reference role: the decode half of the reference's fused attention
 serving path (``paddle/fluid/operators/fused/multihead_matmul_op.cu``
@@ -51,7 +55,8 @@ def _block_k(S: int) -> int:
 
 def supported(q, cache) -> bool:
     """Kernel gate; callers fall back to the einsum path when False.
-    Decode chunks only (T == 1); prefill always takes the flash path."""
+    Decode chunks only (T == 1); prefill always takes the flash path.
+    ``cache`` holds the STACKED buffers ([L, B, Hkv, S, D])."""
     mode = _support.dispatch_mode()
     if mode not in ("raw",):
         return False
@@ -59,9 +64,9 @@ def supported(q, cache) -> bool:
         return False
     B, T, Hq, D = q.shape
     k = cache[0]
-    if k.ndim != 4:
+    if k.ndim != 5:
         return False
-    _, Hkv, S, Dk = k.shape
+    _, _, Hkv, S, Dk = k.shape
     if Dk != D or D not in (64, 128, 256) or Hq % Hkv:
         return False
     if _block_k(S) == 0:
@@ -76,15 +81,14 @@ def supported(q, cache) -> bool:
     return True
 
 
-def _kernel(idx_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
+def _kernel(sp_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
             scale, bk, nk, G, Hkv, quantized, out_dtype):
     if quantized:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
     j = pl.program_id(1)
-    idx = idx_ref[0]
-    last_block = jnp.maximum(idx - 1, 0) // bk
+    idx = sp_ref[1]
 
     @pl.when(j == 0)
     def _fresh():
@@ -101,21 +105,30 @@ def _kernel(idx_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
                                                 (G, vn.shape[1]))
         l_ref[:, :] = jnp.ones_like(l_ref)
 
+    last_block = jnp.maximum(idx - 1, 0) // bk
+
     @pl.when((j > 0) & (j - 1 <= last_block))
     def _cache_block():
         jb = j - 1
-        q = q_ref[0].astype(jnp.float32)            # [Hq, D]
+        # MXU contracts bf16 (or int8-converted) operands natively with
+        # f32 accumulation — no f32 up-conversion of the [bk, D] blocks
+        # (a per-block VPU convert measured as the kernel's dominant
+        # cost); only the tiny [G, bk] planes run in f32.
+        q = q_ref[0]                                # [Hq, D], model dtype
+        cdt = q.dtype if kc_ref.dtype == jnp.int8 else kc_ref.dtype
         pos = jb * bk + jax.lax.broadcasted_iota(jnp.int32, (G, bk), 1)
         valid = pos < idx
         for h in range(Hkv):
             rows = slice(h * G, (h + 1) * G)
-            kh = kc_ref[0, h].astype(jnp.float32)   # [bk, D]
+            kh = kc_ref[0, 0, h]                    # [bk, D]
+            if kh.dtype != cdt:
+                kh = kh.astype(cdt)
             s = jax.lax.dot_general(
                 q[rows], kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [G, bk]
             if quantized:
                 # per-position scale folds into the logit plane
-                s = s * ks_ref[0, h:h + 1, :]
+                s = s * ks_ref[0, 0, h:h + 1, :]
             s = jnp.where(valid, s, NEG_INF)
             m_prev = m_ref[rows, :1]
             l_prev = l_ref[rows, :1]
@@ -127,10 +140,12 @@ def _kernel(idx_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
             m_ref[rows, :1] = m_new
             if quantized:
                 # v scale folds into the prob plane
-                p = p * vs_ref[0, h:h + 1, :]
+                p = p * vs_ref[0, 0, h:h + 1, :]
+            vh = vc_ref[0, 0, h]
+            if vh.dtype != cdt:
+                vh = vh.astype(cdt)
             pv = jax.lax.dot_general(
-                p, vc_ref[0, h].astype(jnp.float32),
-                (((1,), (0,)), ((), ())),
+                p.astype(cdt), vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)  # [G, D]
             acc_ref[rows, :] = acc_ref[rows, :] * alpha + pv
 
@@ -141,43 +156,47 @@ def _kernel(idx_ref, q_ref, kn_ref, vn_ref, kc_ref, vc_ref, *rest,
             out_dtype)
 
 
-def decode_attention(q, k_new, v_new, cache, index, *, scale: float):
+def decode_attention(q, k_new, v_new, cache, layer, index, *, scale: float):
     """q [B, 1, Hq, D]; k_new/v_new [B, Hkv, 1, D] (this step's raw k/v);
-    ``cache`` the per-layer read-only slice; ``index`` traced int32 fill
-    position (cache holds tokens [0, index)). Returns [B, 1, Hq, D]."""
+    ``cache`` the STACKED read-only buffers ([L, B, Hkv, S, D], int8
+    layout adds [L, B, Hkv, S] scales); ``layer`` this block's layer id
+    (traced under the layer scan); ``index`` traced int32 fill position
+    (the layer's cache holds tokens [0, index)). Returns [B, 1, Hq, D]."""
     B, T, Hq, D = q.shape
     Hkv = k_new.shape[1]
     G = Hq // Hkv
     quantized = len(cache) == 4
     kc, vc = cache[0], cache[1]
-    S = kc.shape[2]
+    S = kc.shape[3]
     bk = _block_k(S)
     nk = S // bk
 
     q2 = q.reshape(B, Hq, D)
     kn2 = k_new.reshape(B, Hkv, D)
     vn2 = v_new.reshape(B, Hkv, D)
-    idx_arr = jnp.asarray(index, jnp.int32).reshape(1)
+    sp = jnp.stack([jnp.asarray(layer, jnp.int32),
+                    jnp.asarray(index, jnp.int32)])
 
-    def cache_map(b, j, idx_ref):
-        last = jnp.maximum(idx_ref[0] - 1, 0) // bk
-        return (b, 0, jnp.minimum(jnp.maximum(j - 1, 0), last), 0)
+    def cache_map(b, j, sp_ref):
+        last = jnp.maximum(sp_ref[1] - 1, 0) // bk
+        return (sp_ref[0], b, 0,
+                jnp.minimum(jnp.maximum(j - 1, 0), last), 0)
 
-    def scale_map(b, j, idx_ref):
-        last = jnp.maximum(idx_ref[0] - 1, 0) // bk
-        return (b, 0, jnp.minimum(jnp.maximum(j - 1, 0), last))
+    def scale_map(b, j, sp_ref):
+        last = jnp.maximum(sp_ref[1] - 1, 0) // bk
+        return (sp_ref[0], b, 0, jnp.minimum(jnp.maximum(j - 1, 0), last))
 
     in_specs = [
-        pl.BlockSpec((1, Hq, D), lambda b, j, i: (b, 0, 0)),
-        pl.BlockSpec((1, Hkv, D), lambda b, j, i: (b, 0, 0)),
-        pl.BlockSpec((1, Hkv, D), lambda b, j, i: (b, 0, 0)),
-        pl.BlockSpec((1, Hkv, bk, D), cache_map),
-        pl.BlockSpec((1, Hkv, bk, D), cache_map),
+        pl.BlockSpec((1, Hq, D), lambda b, j, s: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j, s: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, j, s: (b, 0, 0)),
+        pl.BlockSpec((1, 1, Hkv, bk, D), cache_map),
+        pl.BlockSpec((1, 1, Hkv, bk, D), cache_map),
     ]
     args = [q2, kn2, vn2, kc, vc]
     if quantized:
-        in_specs += [pl.BlockSpec((1, Hkv, bk), scale_map),
-                     pl.BlockSpec((1, Hkv, bk), scale_map)]
+        in_specs += [pl.BlockSpec((1, 1, Hkv, bk), scale_map),
+                     pl.BlockSpec((1, 1, Hkv, bk), scale_map)]
         args += [cache[2], cache[3]]
 
     kernel = functools.partial(
@@ -189,7 +208,7 @@ def decode_attention(q, k_new, v_new, cache, index, *, scale: float):
             num_scalar_prefetch=1,
             grid=(B, nk + 1),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, i: (b, 0, 0)),
+            out_specs=pl.BlockSpec((1, Hq, D), lambda b, j, s: (b, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((Hq, D), jnp.float32),
                 pltpu.VMEM((Hq, LANES), jnp.float32),
@@ -200,5 +219,5 @@ def decode_attention(q, k_new, v_new, cache, index, *, scale: float):
         compiler_params=_support.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_support.interpret(),
-    )(idx_arr, *args)
+    )(sp, *args)
     return out.reshape(B, 1, Hq, D)

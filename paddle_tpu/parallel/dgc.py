@@ -130,15 +130,19 @@ def build_dgc_step(model, optimizer, loss_fn=None, *, strategy, mesh,
         loss, grads = jax.value_and_grad(f)(m)
 
         if local_clip > 0.0:
-            # DGC local gradient clipping: each worker clips by the
-            # global threshold scaled down by sqrt(P) (DGC paper §3.1 /
-            # reference _append_clip_norm), so the summed gradient keeps
+            # DGC local gradient clipping: each worker clips EVERY
+            # gradient tensor by the threshold scaled down by sqrt(P)
+            # (DGC paper §3.1 / reference _append_clip_norm attaches
+            # ClipGradByNorm per parameter), so each summed tensor keeps
             # the intended norm bound
             bound = local_clip / math.sqrt(n_dp)
-            norm = global_norm(grads)
-            scale = jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
-            grads = jax.tree_util.tree_map(
-                lambda g: (g * scale).astype(g.dtype), grads)
+
+            def clip_leaf(g):
+                norm = jnp.linalg.norm(g.astype(jnp.float32))
+                scale = jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
+                return (g * scale).astype(g.dtype)
+
+            grads = jax.tree_util.tree_map(clip_leaf, grads)
 
         ndev = jax.lax.psum(1, "dp")
 
@@ -249,8 +253,11 @@ class DgcTrainStep:
         self._compress = compress
         self._corrected = corrected
         self._jitted = {}
-        self._host_step = 0
-        self._last_out = None
+        # step arrays we have returned (or adopted) → their host step,
+        # keyed by object id with a weakref guard against id reuse:
+        # replaying an older state or interleaving two TrainStates must
+        # each resolve to THEIR step, not a single shared counter
+        self._known_steps: dict = {}
 
     @property
     def mesh(self):
@@ -301,12 +308,15 @@ class DgcTrainStep:
     def __call__(self, state, batch, key=None):
         if key is None:
             key = rng.next_key()
-        last_step_arr = self._last_out() if self._last_out else None
-        if state.step is not last_step_arr:
-            # foreign state (fresh init / checkpoint restore): adopt its
-            # step so the sparsity schedule resumes, not restarts
-            self._host_step = int(state.step)
-        level = self._level_for(self._host_step)
+        entry = self._known_steps.get(id(state.step))
+        if entry is not None and entry[0]() is state.step:
+            host_step = entry[1]
+        else:
+            # foreign state (fresh init / checkpoint restore / replay of
+            # an unseen state): adopt its step so the sparsity schedule
+            # resumes, not restarts — one host sync, then cached
+            host_step = int(state.step)
+        level = self._level_for(host_step)
         jitted = self._jitted.get(level)
         if jitted is None:
             state_sh = self._state_shardings(state)
@@ -320,6 +330,10 @@ class DgcTrainStep:
                 donate_argnums=(0,) if self._donate else ())
             self._jitted[level] = jitted
         state, metrics = jitted(state, batch, key)
-        self._host_step += 1
-        self._last_out = weakref.ref(state.step)
+        sid = id(state.step)
+        self._known_steps[sid] = (
+            weakref.ref(state.step,
+                        lambda _r, s=sid, m=self._known_steps:
+                        m.pop(s, None)),
+            host_step + 1)
         return state, metrics

@@ -248,7 +248,14 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             # per-microbatch state updates, averaged over microbatches
             # (masked ticks contribute zeros)
             from paddle_tpu.nn.scan import mask_tick_tape
+            from paddle_tpu.nn.stateful import collect_aux
             tape_f = mask_tick_tape(tape_f, do_f, M)
+            # per-layer aux-loss contributions (MoE load balancing) ride
+            # the tape pre-scaled: the masked (1/M-weighted) sum IS this
+            # stage's share of the loss — add it here; psum("pp") below
+            # combines the stages. Gradients flow in the backward
+            # sub-tick via the tape cotangent seed.
+            loss_acc = loss_acc + collect_aux(tape_f)
             slot_prev = lax.dynamic_index_in_dim(h_saved, fc % K, 0,
                                                  keepdims=False)
             h_saved = lax.dynamic_update_index_in_dim(
@@ -289,9 +296,22 @@ def loss_and_grads(model, batch, mesh, *, training: bool = True,
             dy = jnp.where(r == S - 1, dy_own, state_b)
             h_b = lax.dynamic_index_in_dim(h_saved, bc % K, 0,
                                            keepdims=False)
-            _, svjp, _ = jax.vjp(lambda bl, h: stage_fwd(bl, h, bc),
-                                 blk, h_b, has_aux=True)
-            gb, dh_in = svjp(dy.astype(x_mb.dtype))
+            (_, tape_b), svjp = jax.vjp(
+                lambda bl, h: stage_fwd(bl, h, bc), blk, h_b)
+            # tape cotangents: zero for state entries (BatchNorm stats —
+            # statistics, not loss terms), and the microbatch-average
+            # weight for aux-loss entries so each layer's recorded
+            # contribution differentiates exactly as it entered loss_acc
+            # (× the fp16 loss-scale seed, like the head's)
+            from paddle_tpu.nn.stateful import AUX_LOSS_KEY
+            aux_cot = (jnp.where(do_b, 1.0 / M, 0.0)
+                       * cot_scale).astype(jnp.float32)
+            tape_seed = {
+                uid: {k: (jnp.full(v.shape, aux_cot, v.dtype)
+                          if k == AUX_LOSS_KEY else jnp.zeros_like(v))
+                      for k, v in upd.items()}
+                for uid, upd in tape_b.items()}
+            gb, dh_in = svjp((dy.astype(x_mb.dtype), tape_seed))
             gblk = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(do_b, _acc_cast(g),
                                            jnp.zeros_like(a)),

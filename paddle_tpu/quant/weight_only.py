@@ -79,9 +79,35 @@ def quantize_weights_int8(model):
     is untouched. Typically applied to a trained/loaded model right
     before ``models.generation.generate`` or a Predictor export."""
 
+    from paddle_tpu.nn.moe import MoEMLP
     from paddle_tpu.quant.functional import channelwise_int8_freeze
 
     def fn(m):
+        if isinstance(m, MoEMLP):
+            # expert tensors [E, in, out]: per-(expert, out-channel)
+            # scales over the contraction dim (axis -2), applied after
+            # the expert einsums (nn/moe.py _experts). Expert weights
+            # dominate an MoE decode step's HBM reads — every expert is
+            # resident even though only top-k route per token — so this
+            # is the family where halving the bytes pays most.
+            wg, sg = channelwise_int8_freeze(m.w_gate, axis=-2,
+                                             scale_dtype=m.w_gate.dtype)
+            wu, su = channelwise_int8_freeze(m.w_up, axis=-2,
+                                             scale_dtype=m.w_up.dtype)
+            wd, sd = channelwise_int8_freeze(m.w_down, axis=-2,
+                                             scale_dtype=m.w_down.dtype)
+            pspecs = dict(m._pspecs)
+            pspecs.update({
+                "w_gate_scale": P("ep", "tp"),
+                "w_up_scale": P("ep", "tp"),
+                "w_down_scale": P("ep", "fsdp"),
+            })
+            return m.replace(
+                w_gate=wg, w_up=wu, w_down=wd, w_gate_scale=sg,
+                w_up_scale=su, w_down_scale=sd,
+                _pspecs=tuple(pspecs.items()),
+                _nontrainable=("w_gate", "w_up", "w_down", "w_gate_scale",
+                               "w_up_scale", "w_down_scale"))
         if not isinstance(m, Linear):
             return m
         w = m.weight

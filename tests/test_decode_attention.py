@@ -3,8 +3,9 @@
 OpTest discipline (reference
 ``python/paddle/fluid/tests/unittests/op_test.py:226``): the kernel must
 reproduce the einsum fallback bit-for-bit in interpret mode (same dtype
-path, same visibility set), bound its reads to the filled prefix, and
-fold the int8 scales exactly.
+path, same visibility set), select the right layer out of the stacked
+buffers, bound its reads to the filled prefix, and fold the int8 scales
+exactly.
 """
 
 import numpy as np
@@ -16,37 +17,38 @@ from paddle_tpu.models import _common
 from paddle_tpu.ops.pallas import _support, decode_attention as dk
 
 
-def _mk(B=2, Hq=8, Hkv=4, S=256, D=64, dtype=jnp.float32, quant=False,
+def _mk(B=2, Hq=8, Hkv=4, S=256, D=64, L=2, dtype=jnp.float32, quant=False,
         seed=0):
     rs = np.random.RandomState(seed)
     q = jnp.asarray(rs.randn(B, 1, Hq, D), dtype)
     k_new = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
     v_new = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
     if quant:
-        kc = jnp.asarray(rs.randint(-127, 128, (B, Hkv, S, D)), jnp.int8)
-        vc = jnp.asarray(rs.randint(-127, 128, (B, Hkv, S, D)), jnp.int8)
-        ks = jnp.asarray(rs.rand(B, Hkv, S) * 0.05 + 0.001, jnp.float32)
-        vs = jnp.asarray(rs.rand(B, Hkv, S) * 0.05 + 0.001, jnp.float32)
+        kc = jnp.asarray(rs.randint(-127, 128, (L, B, Hkv, S, D)), jnp.int8)
+        vc = jnp.asarray(rs.randint(-127, 128, (L, B, Hkv, S, D)), jnp.int8)
+        ks = jnp.asarray(rs.rand(L, B, Hkv, S) * 0.05 + 0.001, jnp.float32)
+        vs = jnp.asarray(rs.rand(L, B, Hkv, S) * 0.05 + 0.001, jnp.float32)
         cache = (kc, vc, ks, vs)
     else:
-        cache = (jnp.asarray(rs.randn(B, Hkv, S, D), dtype),
-                 jnp.asarray(rs.randn(B, Hkv, S, D), dtype))
+        cache = (jnp.asarray(rs.randn(L, B, Hkv, S, D), dtype),
+                 jnp.asarray(rs.randn(L, B, Hkv, S, D), dtype))
     return q, k_new, v_new, cache
 
 
-def _fallback(q, k_new, v_new, cache, idx):
+def _fallback(q, k_new, v_new, cache, layer, idx):
     """The einsum path of models._common.cached_attention, decode branch
     (q [B,1,Hq,D], chunk already in buffer layout)."""
     B, T, Hq, D = q.shape
     Hkv = k_new.shape[1]
     G = Hq // Hkv
     scale = 1.0 / (D ** 0.5)
+    sl = tuple(c[layer] for c in cache)
     if len(cache) == 4:
-        k_c, v_c, k_s, v_s = cache
+        k_c, v_c, k_s, v_s = sl
         kc = k_c.astype(q.dtype) * k_s.astype(q.dtype)[..., None]
         vc = v_c.astype(q.dtype) * v_s.astype(q.dtype)[..., None]
     else:
-        kc, vc = cache
+        kc, vc = sl
     S = kc.shape[2]
     qh = q.transpose(0, 2, 1, 3).reshape(B, Hkv, G, T, D)
     neg = jnp.finfo(jnp.float32).min
@@ -69,20 +71,34 @@ def test_kernel_matches_fallback(quant, idx):
     q, kn, vn, cache = _mk(quant=quant)
     with _support.force_dispatch():
         assert dk.supported(q, cache)
-        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(idx),
-                                  scale=1.0 / 8.0)
-    want = _fallback(q, kn, vn, cache, idx)
+        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(0),
+                                  jnp.int32(idx), scale=1.0 / 8.0)
+    want = _fallback(q, kn, vn, cache, 0, idx)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_kernel_selects_layer(quant):
+    """The scalar-prefetched layer id must pick layer l's buffers out of
+    the stack — each layer's output must match that layer's fallback."""
+    q, kn, vn, cache = _mk(L=3, quant=quant, seed=7)
+    for l in range(3):
+        with _support.force_dispatch():
+            got = dk.decode_attention(q, kn, vn, cache, jnp.int32(l),
+                                      jnp.int32(90), scale=0.125)
+        want = _fallback(q, kn, vn, cache, l, 90)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"l={l}")
 
 
 def test_kernel_gqa_group_mapping():
     """Hq=8, Hkv=2 (G=4): each q head must read ITS kv head's cache."""
     q, kn, vn, cache = _mk(Hq=8, Hkv=2, seed=3)
     with _support.force_dispatch():
-        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(100),
-                                  scale=0.125)
-    want = _fallback(q, kn, vn, cache, 100)
+        got = dk.decode_attention(q, kn, vn, cache, jnp.int32(1),
+                                  jnp.int32(100), scale=0.125)
+    want = _fallback(q, kn, vn, cache, 1, 100)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
 
@@ -93,14 +109,14 @@ def test_kernel_ignores_stale_positions():
     q, kn, vn, cache = _mk(seed=1)
     idx = 64
     k, v = np.asarray(cache[0]).copy(), np.asarray(cache[1]).copy()
-    k[:, :, idx:] = 1e4
-    v[:, :, idx:] = -1e4
+    k[:, :, :, idx:] = 1e4
+    v[:, :, :, idx:] = -1e4
     poisoned = (jnp.asarray(k), jnp.asarray(v))
     with _support.force_dispatch():
-        a = dk.decode_attention(q, kn, vn, cache, jnp.int32(idx),
-                                scale=0.125)
-        b = dk.decode_attention(q, kn, vn, poisoned, jnp.int32(idx),
-                                scale=0.125)
+        a = dk.decode_attention(q, kn, vn, cache, jnp.int32(0),
+                                jnp.int32(idx), scale=0.125)
+        b = dk.decode_attention(q, kn, vn, poisoned, jnp.int32(0),
+                                jnp.int32(idx), scale=0.125)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
@@ -112,10 +128,10 @@ def test_supported_gates():
         assert not dk.supported(jnp.zeros((2, 4, 8, 64)), cache)
         # head_dim off the MXU grid
         assert not dk.supported(jnp.zeros((2, 1, 8, 32)), (
-            jnp.zeros((2, 4, 256, 32)),) * 2)
+            jnp.zeros((2, 2, 4, 256, 32)),) * 2)
         # S not blockable
         assert not dk.supported(jnp.zeros((2, 1, 8, 64)), (
-            jnp.zeros((2, 4, 100, 64)),) * 2)
+            jnp.zeros((2, 2, 4, 100, 64)),) * 2)
     # no dispatch context off-TPU → fallback (on a TPU host the bare
     # call legitimately dispatches)
     if not _support.on_tpu():
@@ -127,12 +143,12 @@ def test_cached_attention_dispatches_kernel(monkeypatch):
     shapes through the kernel (and produce the same payload/out as the
     fallback it replaces)."""
     rs = np.random.RandomState(5)
-    B, Hq, Hkv, S, D = 2, 4, 4, 128, 64
+    B, Hq, Hkv, S, D, L = 2, 4, 4, 128, 64, 2
     q = jnp.asarray(rs.randn(B, 1, Hq, D), jnp.float32)
     k = jnp.asarray(rs.randn(B, 1, Hkv, D), jnp.float32)
     v = jnp.asarray(rs.randn(B, 1, Hkv, D), jnp.float32)
-    cache = (jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32),
-             jnp.asarray(rs.randn(B, Hkv, S, D), jnp.float32))
+    cache = (jnp.asarray(rs.randn(L, B, Hkv, S, D), jnp.float32),
+             jnp.asarray(rs.randn(L, B, Hkv, S, D), jnp.float32))
     calls = {}
     orig = dk.decode_attention
 
@@ -143,9 +159,10 @@ def test_cached_attention_dispatches_kernel(monkeypatch):
     monkeypatch.setattr(dk, "decode_attention", spy)
     with _support.force_dispatch():
         out_k, pay_k = _common.cached_attention(q, k, v, cache,
-                                                jnp.int32(50))
+                                                jnp.int32(50), layer=1)
     assert calls.get("hit")
-    out_f, pay_f = _common.cached_attention(q, k, v, cache, jnp.int32(50))
+    out_f, pay_f = _common.cached_attention(q, k, v, cache, jnp.int32(50),
+                                            layer=1)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_f),
                                rtol=2e-5, atol=2e-5)
     for a, b in zip(pay_k, pay_f):

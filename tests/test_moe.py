@@ -134,9 +134,10 @@ def test_moe_expert_parallel_matches_single(devices8):
     s_ep.expert_parallel.degree = 4
     ep_losses, ep_state = run(s_ep)
 
-    w = ep_state.model.blocks[0].moe.w_gate
+    w = ep_state.model.blocks.block.moe.w_gate
     assert "ep" in str(w.sharding.spec), w.sharding.spec
-    assert w.sharding.spec[0] == "ep"
+    # stacked blocks: leading layer axis, then the expert axis
+    assert w.sharding.spec[1] == "ep"
 
     dp_losses, _ = run(DistributedStrategy())
     np.testing.assert_allclose(ep_losses, dp_losses, rtol=2e-4)
@@ -172,8 +173,8 @@ def test_moe_ep_fsdp_hybrid(devices8):
     s.sharding.stage = 3
     s.sharding.degree = 2
     hybrid_losses, st = run(s)
-    w = st.model.blocks[0].moe.w_gate
-    assert w.sharding.spec[0] == "ep" and "fsdp" in str(w.sharding.spec)
+    w = st.model.blocks.block.moe.w_gate
+    assert w.sharding.spec[1] == "ep" and "fsdp" in str(w.sharding.spec)
     ref_losses, _ = run(DistributedStrategy())
     np.testing.assert_allclose(hybrid_losses, ref_losses, rtol=2e-4)
 
@@ -305,8 +306,8 @@ def test_moe_gather_grouped_ep_trains_and_matches(devices8):
     s_ep.expert_parallel.degree = 4
     s_ep.dp_degree = 2
     ep_losses, ep_state = run(s_ep, "gather_grouped")
-    w = ep_state.model.blocks[0].moe.w_gate
-    assert w.sharding.spec[0] == "ep", w.sharding.spec
+    w = ep_state.model.blocks.block.moe.w_gate
+    assert w.sharding.spec[1] == "ep", w.sharding.spec
 
     dp_losses, _ = run(DistributedStrategy(), "gather")
     np.testing.assert_allclose(ep_losses, dp_losses, rtol=2e-4)
@@ -330,3 +331,126 @@ def test_moe_gather_grouped_fsdp_batch_axes(devices8):
         out_gg, _ = moe_gg(x)
     np.testing.assert_allclose(np.asarray(out_gg), np.asarray(out_g),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MoE × pipeline parallelism (verdict r4 #2): MoE blocks are
+# scan-stacked like every other family, so both pipeline schedules apply
+# — the aux loss rides the per-layer tape (nn.stateful.record_aux),
+# which GPipe transports differentiably and 1F1B cotangent-seeds.
+# Reference: arbitrary section programs with no model-class carve-outs
+# (framework/section_worker.cc:44).
+# ---------------------------------------------------------------------------
+
+def _pp_moe_run(strategy, cfg, n=3, lr=1e-2, opt=None, seed=11):
+    paddle_tpu.seed(seed)
+    model = MoEForCausalLM(cfg)
+    mesh = M.mesh_from_strategy(strategy)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 16))
+                      .astype(np.int32))
+    with M.MeshContext(mesh):
+        step = dist.fleet.build_train_step(
+            model, optimizer=opt or optim.AdamW(lr), strategy=strategy,
+            mesh=mesh)
+        state = step.init_state(model)
+        batch = step.shard_batch({"input_ids": ids, "labels": ids})
+        losses = []
+        for i in range(n):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+            losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+def _pp_ep_strategy(schedule="gpipe", microbatches=4, fsdp=0, ep=2):
+    s = DistributedStrategy()
+    s.pipeline.enable = True
+    s.pipeline.degree = 2
+    s.pipeline.num_microbatches = microbatches
+    s.pipeline.schedule = schedule
+    s.expert_parallel.enable = True
+    s.expert_parallel.degree = ep
+    if fsdp:
+        s.sharding.enable = True
+        s.sharding.stage = 3
+        s.sharding.degree = fsdp
+    return s
+
+
+def test_moe_gpipe_pp_ep_fsdp_matches_dp(devices8):
+    """pp2×ep2×fsdp2 GPipe must reproduce the dp losses. aux weight 0 +
+    generous capacity isolate schedule parity from the (documented)
+    per-microbatch aux/capacity semantics; the expert all_to_all runs
+    INSIDE the pipeline shard_map (ep stays an automatic axis of the
+    partial-manual region)."""
+    cfg = MoEConfig.tiny(num_experts=4, aux_loss_weight=0.0,
+                         capacity_factor=4.0)
+    pp_losses, pp_state = _pp_moe_run(
+        _pp_ep_strategy("gpipe", fsdp=2), cfg)
+    w = pp_state.model.blocks.block.moe.w_gate
+    spec = w.sharding.spec
+    assert spec[0] == "pp" and spec[1] == "ep", spec
+    dp_losses, _ = _pp_moe_run(DistributedStrategy(), cfg)
+    np.testing.assert_allclose(pp_losses, dp_losses, rtol=2e-4)
+
+
+def test_moe_1f1b_pp_ep_matches_gpipe_with_aux(devices8):
+    """1F1B pp2×ep2 with the aux loss ON must match GPipe (same
+    microbatching → identical aux semantics): the schedule adds the
+    taped aux to its loss and seeds its cotangent in the manual
+    backward."""
+    cfg = MoEConfig.tiny(num_experts=4, aux_loss_weight=0.05,
+                         capacity_factor=4.0)
+    g_losses, _ = _pp_moe_run(_pp_ep_strategy("gpipe"), cfg, n=4)
+    f_losses, _ = _pp_moe_run(_pp_ep_strategy("1f1b"), cfg, n=4)
+    np.testing.assert_allclose(f_losses, g_losses, rtol=3e-4)
+    # and the aux is genuinely included: a run with weight 0 differs
+    cfg0 = MoEConfig.tiny(num_experts=4, aux_loss_weight=0.0,
+                          capacity_factor=4.0)
+    f0_losses, _ = _pp_moe_run(_pp_ep_strategy("1f1b"), cfg0, n=4)
+    assert abs(f_losses[0] - f0_losses[0]) > 1e-4
+
+
+def test_moe_1f1b_aux_gradients_match_reference(devices8):
+    """Gradient-level check of the 1F1B aux cotangent seeding: one SGD
+    step under pp2×ep2 must move the parameters exactly like jax.grad
+    of the microbatched reference loss (mean over microbatch chunks of
+    ce + taped aux). The router only receives gradient THROUGH the aux
+    term's tape cotangent on tiny balanced data where ce barely moves
+    it, so a mismatch here means dropped/mis-scaled seeds."""
+    cfg = MoEConfig.tiny(num_experts=4, aux_loss_weight=0.1,
+                         capacity_factor=4.0)
+    M_mb = 4
+    lr = 0.5
+    losses, state = _pp_moe_run(
+        _pp_ep_strategy("1f1b", microbatches=M_mb), cfg, n=1, lr=lr,
+        opt=optim.SGD(lr), seed=23)
+    stepped = jax.device_get(state.model)
+
+    paddle_tpu.seed(23)
+    ref_model = MoEForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 16))
+                      .astype(np.int32))
+
+    def ref_loss(m):
+        total = 0.0
+        for c in range(M_mb):
+            chunk = ids[c * 2:(c + 1) * 2]
+            total = total + m.loss(chunk, chunk, training=True)
+        return total / M_mb
+
+    grads = jax.grad(ref_loss)(ref_model)
+    ref_stepped = jax.tree_util.tree_map(
+        lambda p, g: p - lr * g.astype(p.dtype), ref_model, grads)
+
+    got = np.asarray(stepped.blocks.block.moe.router, np.float32)
+    want = np.asarray(ref_stepped.blocks.block.moe.router, np.float32)
+    # router moved at all (aux gradient flowed) ...
+    orig = np.asarray(ref_model.blocks.block.moe.router, np.float32)
+    assert np.abs(want - orig).max() > 1e-6
+    # ... and the pipeline's step matches the reference step
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-6)
+    gw = np.asarray(stepped.blocks.block.moe.w_gate, np.float32)
+    ww = np.asarray(ref_stepped.blocks.block.moe.w_gate, np.float32)
+    np.testing.assert_allclose(gw, ww, rtol=2e-3, atol=2e-6)

@@ -223,3 +223,44 @@ def test_weight_only_int8_honors_autocast():
     assert q(x).dtype == jnp.float32
     with amp.auto_cast(enable=True, dtype="bfloat16"):
         assert q(x).dtype == jnp.bfloat16
+
+
+def test_weight_only_int8_moe_experts():
+    """quantize_weights_int8 must quantize the raw MoE expert tensors
+    ([E, in, out] with per-(expert, out-channel) scales), not just the
+    nn.Linear attention/head projections — expert weights dominate an
+    MoE decode step's reads. Logits stay close and the jitted generate
+    runs on the quantized model."""
+    import paddle_tpu
+    from paddle_tpu.models import MoEConfig, MoEForCausalLM
+    from paddle_tpu.models.generation import generate
+
+    paddle_tpu.seed(0)
+    cfg = MoEConfig.tiny(vocab_size=128, hidden_size=32,
+                         intermediate_size=64, num_layers=2,
+                         num_experts=4, max_seq_len=64)
+    m = MoEForCausalLM(cfg)
+    qm = quantize_weights_int8(m)
+    moe = qm.blocks.block.moe
+    assert moe.w_gate.dtype == jnp.int8
+    assert moe.w_down.dtype == jnp.int8
+    assert moe.w_gate_scale.shape == (2, 4, 64)   # [L, E, I]
+    assert moe.w_down_scale.shape == (2, 4, 32)   # [L, E, H]
+    # scales preserve the ep/tp sharding annotations
+    from jax.sharding import PartitionSpec as P
+    specs = dict(moe._pspecs)
+    assert specs["w_gate_scale"] == P("ep", "tp")
+    # experts must be excluded from training updates
+    from paddle_tpu.core.module import trainable_mask
+    import jax as _jax
+    mask_moe = trainable_mask(qm).blocks.block.moe
+    assert mask_moe.w_gate is False and mask_moe.w_down_scale is False
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 8))
+                      .astype(np.int32))
+    lo, lq = m(ids), qm(ids)
+    rel = (np.linalg.norm(np.asarray(lq - lo, dtype=np.float32))
+           / np.linalg.norm(np.asarray(lo, dtype=np.float32)))
+    assert rel < 0.05, rel
+    out = np.asarray(jax.jit(lambda mm, i: generate(mm, i, 8))(qm, ids))
+    assert out.shape == (2, 16)
