@@ -8,7 +8,8 @@ kernels tiled for the MXU/VPU; everything else stays jax.numpy and lets
 XLA fuse.
 """
 
+from paddle_tpu.ops import extras  # noqa: F401
 from paddle_tpu.ops import pallas  # noqa: F401
 from paddle_tpu.ops import sequence  # noqa: F401
 
-__all__ = ["pallas", "sequence"]
+__all__ = ["pallas", "sequence", "extras"]

@@ -1,0 +1,339 @@
+"""Contrib / long-tail operators from the reference's op zoo.
+
+The ops here are the audited tail of ``OPS_AUDIT.md`` — small math ops
+the reference registers as individual CUDA/CPU kernels under
+``paddle/fluid/operators/``, expressed as jnp compositions XLA fuses on
+its own (none is hot enough to justify a Pallas kernel). Each docstring
+cites the reference op it matches.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "shuffle_channel", "temporal_shift", "space_to_depth",
+    "add_position_encoding", "multiplex", "partial_concat", "partial_sum",
+    "cvm", "gather_tree", "fsp_matrix", "conv_shift", "batch_fc",
+    "max_pool2d_with_index", "max_unpool2d", "spatial_pyramid_pool",
+    "hinge_loss", "rank_loss", "bpr_loss", "center_loss", "huber_loss",
+    "modified_huber_loss", "teacher_student_sigmoid_loss",
+    "squared_l2_distance", "squared_l2_norm", "l1_norm",
+]
+
+
+# ---------------------------------------------------------------------------
+# feature-map / tensor transforms
+# ---------------------------------------------------------------------------
+
+def shuffle_channel(x, groups: int):
+    """ShuffleNet channel shuffle on NCHW (reference
+    ``operators/shuffle_channel_op.cc``): split C into ``groups``,
+    transpose the (group, sub) axes."""
+    n, c, h, w = x.shape
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    return (x.reshape(n, groups, c // groups, h, w)
+            .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w))
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25):
+    """TSM temporal shift on [N*T, C, H, W] (reference
+    ``operators/temporal_shift_op.cc``): the first ``shift_ratio`` of
+    channels shift one step back in time, the next ``shift_ratio``
+    forward, the rest stay."""
+    nt, c, h, w = x.shape
+    if nt % seg_num:
+        raise ValueError(f"batch {nt} not divisible by seg_num {seg_num}")
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad = jnp.pad(x5, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    back = pad[:, 2:, :c1]            # channel group 1: t+1 -> t
+    fwd = pad[:, :-2, c1:c2]          # channel group 2: t-1 -> t
+    keep = x5[:, :, c2:]
+    return jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+
+
+def space_to_depth(x, blocksize: int):
+    """Rearrange NCHW spatial blocks into channels (reference
+    ``operators/space_to_depth_op.cc``); ``F.pixel_shuffle`` is the
+    inverse direction."""
+    n, c, h, w = x.shape
+    b = blocksize
+    if h % b or w % b:
+        raise ValueError(f"spatial dims ({h},{w}) not divisible by {b}")
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(
+        n, c * b * b, h // b, w // b)
+
+
+def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """Scaled input + sinusoidal position table (reference
+    ``operators/add_position_encoding_op.cc``): out = alpha*x + beta*PE
+    for x [B, T, E]."""
+    _, t, e = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = (e + 1) // 2                   # sin gets the extra odd column
+    div = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                  * -(math.log(10000.0) / max(half - 1, 1)))
+    pe = jnp.concatenate(
+        [jnp.sin(pos * div), jnp.cos(pos * div[:e - half])], axis=1)
+    return alpha * x + beta * pe[None].astype(x.dtype)
+
+
+def multiplex(inputs, index):
+    """Row-select across a list of same-shape tensors (reference
+    ``operators/multiplex_op.cc``): out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs)                        # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def partial_concat(xs, start_index: int = 0, length: int = -1):
+    """Concat column slices of 2-D inputs (reference
+    ``operators/partial_concat_op.cc``)."""
+    end = None if length < 0 else start_index + length
+    return jnp.concatenate([x[:, start_index:end] for x in xs], axis=1)
+
+
+def partial_sum(xs, start_index: int = 0, length: int = -1):
+    """Sum column slices of 2-D inputs (reference
+    ``operators/partial_sum_op.cc``)."""
+    end = None if length < 0 else start_index + length
+    out = xs[0][:, start_index:end]
+    for x in xs[1:]:
+        out = out + x[:, start_index:end]
+    return out
+
+
+def cvm(x, use_cvm: bool = True):
+    """CTR show/click feature transform (reference
+    ``operators/cvm_op.h`` CvmComputeKernel): x [N, D] whose first two
+    columns are (show, click). use_cvm=True keeps them as
+    (log(show+1), log(click+1) - log(show+1)); False drops them."""
+    if not use_cvm:
+        return x[:, 2:]
+    show = jnp.log(x[:, :1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search ancestry (reference
+    ``operators/gather_tree_op.cc``): ids/parents [T, B, K]; returns the
+    full sequences selected by the last step's beams."""
+    T = ids.shape[0]
+
+    def body(carry, xs):
+        beam = carry                                   # [B, K]
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, beam, axis=1)
+        beam = jnp.take_along_axis(step_parents, beam, axis=1)
+        return beam, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=ids.dtype),
+                            ids.shape[1:])
+    _, rev = jax.lax.scan(body, init, (ids[::-1], parents[::-1]))
+    return rev[::-1]
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (reference
+    ``operators/fsp_op.cc``): x [N, C1, H, W], y [N, C2, H, W] →
+    [N, C1, C2] normalized channel correlation."""
+    n, c1, h, w = x.shape
+    xf = x.reshape(n, c1, h * w)
+    yf = y.reshape(n, y.shape[1], h * w)
+    return jnp.einsum("ncs,nds->ncd", xf, yf) / (h * w)
+
+
+def conv_shift(x, y):
+    """Circular correlation (NTM addressing; reference
+    ``operators/conv_shift_op.cc``): x [B, M], y [B, N] (N odd, N<=M):
+    out[i] = sum_j x[(i + j - (N-1)/2) mod M] * y[j]."""
+    m, nsh = x.shape[1], y.shape[1]
+    half = (nsh - 1) // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(nsh)[None, :] - half) % m
+    return jnp.einsum("bmn,bn->bm", x[:, idx], y)
+
+
+def batch_fc(x, w, bias=None):
+    """Per-slot batched FC (reference ``operators/batch_fc_op.cc``):
+    x [S, N, I], w [S, I, O], bias [S, O] → [S, N, O]."""
+    out = jnp.einsum("sni,sio->sno", x, w)
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return out
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """Max pooling returning flat argmax indices into each input map
+    (reference ``operators/max_pool2d_with_index`` /
+    ``pool_with_index_op.cc``) — the indices feed ``max_unpool2d``."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = x.shape
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])),
+                 constant_values=neg)
+    # index map padded alongside, -1 marking padding
+    flat_idx = (jnp.arange(h * w, dtype=jnp.int32).reshape(h, w))
+    ip = jnp.pad(flat_idx, ((pd[0], pd[0]), (pd[1], pd[1])),
+                 constant_values=-1)
+    oh = (h + 2 * pd[0] - ks[0]) // st[0] + 1
+    ow = (w + 2 * pd[1] - ks[1]) // st[1] + 1
+    # window extraction via gather of strided patches
+    r0 = jnp.arange(oh) * st[0]
+    c0 = jnp.arange(ow) * st[1]
+    rows = r0[:, None, None, None] + jnp.arange(ks[0])[None, None, :, None]
+    cols = c0[None, :, None, None] + jnp.arange(ks[1])[None, None, None, :]
+    patches = xp[:, :, rows, cols]          # [N, C, oh, ow, kh, kw]
+    pidx = ip[rows, cols]                   # [oh, ow, kh, kw]
+    pf = patches.reshape(n, c, oh, ow, -1)
+    arg = jnp.argmax(pf, axis=-1)
+    out = jnp.take_along_axis(pf, arg[..., None], axis=-1)[..., 0]
+    idx = jnp.broadcast_to(pidx.reshape(oh, ow, -1)[None, None], pf.shape)
+    sel = jnp.take_along_axis(idx, arg[..., None], axis=-1)[..., 0]
+    return out, sel.astype(jnp.int32)
+
+
+def max_unpool2d(x, indices, output_size):
+    """Scatter pooled values back to their argmax positions (reference
+    ``operators/unpool_op.cc``): x/indices [N, C, oh, ow], flat indices
+    into the [H, W] output maps."""
+    n, c, oh, ow = x.shape
+    H, W = output_size
+    flat = jnp.zeros((n, c, H * W), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].add(vals)
+    return flat.reshape(n, c, H, W)
+
+
+def spatial_pyramid_pool(x, pyramid_height: int, pool_type: str = "max"):
+    """SPP head (reference ``operators/spp_op.cc``): concat pooled
+    [1x1, 2x2, ..., 2^(h-1) x 2^(h-1)] grids of NCHW into [N, C*sum]."""
+    from paddle_tpu.nn import functional as F
+
+    n, c = x.shape[:2]
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        if pool_type == "max":
+            p = F.adaptive_max_pool2d(x, bins)
+        else:
+            p = F.adaptive_avg_pool2d(x, bins)
+        outs.append(p.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# long-tail losses
+# ---------------------------------------------------------------------------
+
+def hinge_loss(logits, labels):
+    """Elementwise hinge (reference ``operators/hinge_loss_op.cc``):
+    max(0, 1 - (2y - 1) * x)."""
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+def rank_loss(label, left, right):
+    """RankNet pairwise loss (reference ``operators/rank_loss_op.cc``):
+    C = log(1 + exp(o)) - P*o with o = left - right."""
+    o = left - right
+    return jnp.logaddexp(0.0, o) - label * o
+
+
+def bpr_loss(x, label):
+    """Bayesian personalized ranking (reference
+    ``operators/bpr_loss_op.cc``): x [N, C] scores, label [N] the
+    positive item; mean over negatives of -log(sigmoid(x_pos - x_j))."""
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None].astype(jnp.int32),
+                              axis=1)                   # [N, 1]
+    diff = pos - x
+    lo = jax.nn.log_sigmoid(diff)
+    mask = jnp.ones((n, c), bool).at[jnp.arange(n),
+                                     label.astype(jnp.int32)].set(False)
+    return -jnp.sum(lo * mask, axis=1) / jnp.maximum(c - 1, 1)
+
+
+def center_loss(features, label, centers, alpha: float = 0.1,
+                update: bool = True):
+    """Center loss (reference ``operators/center_loss_op.cc``): pulls
+    features toward their class centers. Returns (per-sample loss,
+    new_centers) — the center update is functional here (the reference
+    mutates the centers buffer in-kernel)."""
+    label = label.astype(jnp.int32)
+    cent = centers[label]                              # [N, E]
+    diff = features - cent
+    loss = 0.5 * jnp.sum(diff * diff, axis=1)
+    if not update:
+        return loss, centers
+    num = jnp.zeros((centers.shape[0],), jnp.float32).at[label].add(1.0)
+    delta = jnp.zeros_like(centers).at[label].add(diff.astype(centers.dtype))
+    new_centers = centers + alpha * delta / (num[:, None] + 1.0)
+    return loss, new_centers
+
+
+def huber_loss(x, y, delta: float = 1.0):
+    """Huber regression loss (reference ``operators/huber_loss_op.cc``)."""
+    r = jnp.abs(x - y)
+    return jnp.where(r <= delta, 0.5 * r * r,
+                     delta * (r - 0.5 * delta))
+
+
+def modified_huber_loss(x, y):
+    """Classification Huber (reference
+    ``operators/modified_huber_loss_op.cc``): z = (2y-1)*x;
+    max(0, 1-z)^2 for z >= -1, else -4z."""
+    z = (2.0 * y - 1.0) * x
+    sq = jnp.square(jnp.maximum(0.0, 1.0 - z))
+    return jnp.where(z >= -1.0, sq, -4.0 * z)
+
+
+def teacher_student_sigmoid_loss(x, label):
+    """Distillation sigmoid loss (reference
+    ``operators/teacher_student_sigmoid_loss_op.cc``): the label packs
+    click z and teacher score z' (label<-1: z=0 no teacher; label<0:
+    z=1 no teacher; 0<=label<1: z=0, z'=label; label>=1: z=1,
+    z'=label-1); loss = xent(x, z) + xent(x, z') where present."""
+    x = x.reshape(-1)
+    label = label.reshape(-1)
+    softplus = jnp.logaddexp(0.0, -jnp.abs(x))
+    base = jnp.maximum(x, 0.0) + softplus
+
+    z = jnp.where(label < -1.0, 0.0,
+                  jnp.where(label < 0.0, 1.0,
+                            jnp.where(label < 1.0, 0.0, 1.0)))
+    has_teacher = label >= 0.0
+    zprime = jnp.where(label < 1.0, label, label - 1.0)
+    student = base - x * z
+    teacher = jnp.where(has_teacher, base - x * zprime, 0.0)
+    return student + teacher
+
+
+def squared_l2_distance(x, y):
+    """Row-wise squared L2 distance (reference
+    ``operators/squared_l2_distance_op.cc``)."""
+    d = (x - y).reshape(x.shape[0], -1)
+    return jnp.sum(d * d, axis=1)
+
+
+def squared_l2_norm(x):
+    """Reference ``operators/squared_l2_norm_op.cc``."""
+    return jnp.sum(jnp.square(x))
+
+
+def l1_norm(x):
+    """Reference ``operators/l1_norm_op.cc``."""
+    return jnp.sum(jnp.abs(x))

@@ -38,6 +38,8 @@ __all__ = [
     "iou_similarity", "box_iou_xyxy", "bipartite_match", "matrix_nms",
     "multiclass_nms", "roi_align", "distance2bbox", "bbox2distance",
     "generate_anchor_points", "deform_conv2d", "psroi_pool", "prroi_pool",
+    "generate_proposals", "density_prior_box", "target_assign",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
 ]
 
 
@@ -787,3 +789,138 @@ def prroi_pool(features, rois, roi_batch_idx, output_size,
                          0.0)
 
     return jax.vmap(per_roi)(Iy, Ix, area, roi_batch_idx)  # [R, C, ph, pw]
+
+
+# ---------------------------------------------------------------------------
+# two-stage detector ops: RPN proposals + FPN routing + assignment
+# ---------------------------------------------------------------------------
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1):
+    """RPN proposal generation for ONE image (reference
+    ``detection/generate_proposals_op.cc`` / ``_v2``): decode anchor
+    deltas, clip to the image, filter degenerate boxes, top-k before
+    NMS, greedy NMS, top-k after. Fixed-shape: returns
+    (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n], valid mask)
+    with suppressed slots zeroed — the jit-friendly replacement for the
+    reference's variable-length LoD outputs.
+
+    scores [A, H, W]; bbox_deltas [A*4, H, W]; anchors/variances
+    [H, W, A, 4] (``anchor_generator`` layout).
+    """
+    A = scores.shape[0]
+    s = scores.transpose(1, 2, 0).reshape(-1)                    # [HWA]
+    d = bbox_deltas.reshape(A, 4, *bbox_deltas.shape[1:]) \
+        .transpose(2, 3, 0, 1).reshape(-1, 4)                    # [HWA, 4]
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+
+    # decode (decode_center_size with per-anchor variances)
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + 0.5 * aw
+    acy = anc[:, 1] + 0.5 * ah
+    cx = var[:, 0] * d[:, 0] * aw + acx
+    cy = var[:, 1] * d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+    boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                       cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+    boxes = box_clip(boxes[None], jnp.asarray(im_shape,
+                                              jnp.float32))[0]
+    bw = boxes[:, 2] - boxes[:, 0] + 1.0
+    bh = boxes[:, 3] - boxes[:, 1] + 1.0
+    live = (bw >= min_size) & (bh >= min_size)
+    s = jnp.where(live, s, -jnp.inf)
+
+    k = min(pre_nms_top_n, s.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    top_b = boxes[top_i]
+    # RPN scores are raw logits (any sign): the NMS helper's keep-mask
+    # init (s > 0) must see a positive surrogate for every live
+    # candidate — suppression order comes from the sort, not magnitudes
+    live_s = jnp.where(jnp.isfinite(top_s), 1.0, 0.0)
+    keep = _greedy_nms_keep_sorted(top_b, live_s, nms_thresh,
+                                   normalized=False)
+    keep = keep & jnp.isfinite(top_s)
+    final_s = jnp.where(keep, top_s, -jnp.inf)
+    n_out = min(post_nms_top_n, k)
+    out_s, oi = jax.lax.top_k(final_s, n_out)
+    valid = jnp.isfinite(out_s)
+    rois = jnp.where(valid[:, None], top_b[oi], 0.0)
+    return rois, jnp.where(valid, out_s, 0.0), valid
+
+
+def density_prior_box(input_hw, image_hw, densities, fixed_sizes,
+                      fixed_ratios, step=None, offset: float = 0.5):
+    """Density prior boxes (reference
+    ``detection/density_prior_box_op.cc``): per feature-map cell, a
+    densified grid of priors per (density, fixed_size) pair crossed
+    with ``fixed_ratios``. Returns [H, W, P, 4] normalized xyxy."""
+    fh, fw = input_hw
+    ih, iw = image_hw
+    sw = (iw / fw) if step is None else step[0]
+    sh = (ih / fh) if step is None else step[1]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    boxes = []
+    for density, fs in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            bw = fs * math.sqrt(ratio)
+            bh = fs / math.sqrt(ratio)
+            step_d = fs / density
+            for di in range(density):
+                for dj in range(density):
+                    ox = -fs / 2.0 + step_d / 2.0 + dj * step_d
+                    oy = -fs / 2.0 + step_d / 2.0 + di * step_d
+                    x0 = (cx[None, :] + ox - bw / 2.0) / iw
+                    y0 = (cy[:, None] + oy - bh / 2.0) / ih
+                    x1 = (cx[None, :] + ox + bw / 2.0) / iw
+                    y1 = (cy[:, None] + oy + bh / 2.0) / ih
+                    boxes.append(jnp.stack(
+                        [jnp.broadcast_to(x0, (fh, fw)),
+                         jnp.broadcast_to(y0, (fh, fw)),
+                         jnp.broadcast_to(x1, (fh, fw)),
+                         jnp.broadcast_to(y1, (fh, fw))], axis=-1))
+    return jnp.clip(jnp.stack(boxes, axis=2), 0.0, 1.0)
+
+
+def target_assign(x, match_indices, mismatch_value=0.0):
+    """Assign per-prior targets from matched row entities (reference
+    ``detection/target_assign_op.cc``): x [M, K] entity attributes,
+    match_indices [N] (−1 = unmatched) → (out [N, K], weight [N])."""
+    mi = match_indices.astype(jnp.int32)
+    safe = jnp.maximum(mi, 0)
+    out = x[safe]
+    matched = (mi >= 0)[:, None]
+    out = jnp.where(matched, out, mismatch_value)
+    return out, matched[:, 0].astype(x.dtype)
+
+
+def distribute_fpn_proposals(rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: float):
+    """Route RoIs to FPN levels (reference
+    ``detection/distribute_fpn_proposals_op.cc``): level =
+    floor(refer_level + log2(sqrt(area)/refer_scale)) clipped to
+    [min, max]. Fixed-shape: returns (level [R] int32, order [R]) —
+    consumers gather per-level with a mask instead of splitting into
+    LoD sublists."""
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-12))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    order = jnp.argsort(lvl, stable=True).astype(jnp.int32)
+    return lvl, order
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n: int):
+    """Merge per-level RoIs back by score (reference
+    ``detection/collect_fpn_proposals_op.cc``): concat levels, top-k by
+    score. Returns (rois [post_nms_top_n, 4], scores)."""
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, k)
+    return rois[idx], top_s

@@ -1,0 +1,38 @@
+"""OPS_AUDIT.md stays complete: every enumerated reference op classifies,
+and a sample of 'implemented' claims point at real attributes."""
+
+import importlib
+import os
+import re
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TOOLS = os.path.join(HERE, "..", "tools")
+sys.path.insert(0, TOOLS)
+
+
+def test_every_reference_op_is_classified():
+    gen = importlib.import_module("gen_ops_audit")
+    ops = open(os.path.join(TOOLS, "ref_ops.txt")).read().split()
+    assert len(ops) > 450
+    unmapped = [op for op in ops if gen.classify(op) is None]
+    assert not unmapped, unmapped
+
+
+@pytest.mark.parametrize("api", [
+    ("paddle_tpu.ops.extras", "temporal_shift"),
+    ("paddle_tpu.ops.extras", "gather_tree"),
+    ("paddle_tpu.ops.extras", "max_unpool2d"),
+    ("paddle_tpu.vision.ops", "generate_proposals"),
+    ("paddle_tpu.vision.ops", "target_assign"),
+    ("paddle_tpu.ops.sequence", "segment_mean"),
+    ("paddle_tpu.nn.rnn", "LSTM"),
+    ("paddle_tpu.nn.functional", "interpolate"),
+    ("paddle_tpu.nn.functional", "row_conv"),
+    ("paddle_tpu.metric", "Auc"),
+])
+def test_sampled_implemented_claims_exist(api):
+    mod, name = api
+    assert hasattr(importlib.import_module(mod), name), api

@@ -1,0 +1,310 @@
+"""Contrib op tail (ops/extras.py) + two-stage detector ops
+(vision/ops.py r5 additions) — the implemented rows of OPS_AUDIT.md.
+
+OpTest discipline (reference ``tests/unittests/op_test.py``): each op
+checked against an obvious numpy reference on small shapes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import extras as E
+from paddle_tpu.vision import ops as V
+
+
+def test_shuffle_channel():
+    x = jnp.arange(2 * 6 * 2 * 2, dtype=jnp.float32).reshape(2, 6, 2, 2)
+    y = E.shuffle_channel(x, groups=3)
+    # group-transpose: channel order [0,2,4,1,3,5]
+    np.testing.assert_array_equal(np.asarray(y[:, 0]), np.asarray(x[:, 0]))
+    np.testing.assert_array_equal(np.asarray(y[:, 1]), np.asarray(x[:, 2]))
+    np.testing.assert_array_equal(np.asarray(y[:, 3]), np.asarray(x[:, 1]))
+
+
+def test_temporal_shift_matches_manual():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8, 2, 2).astype(np.float32)       # N=2, T=2
+    y = np.asarray(E.temporal_shift(jnp.asarray(x), seg_num=2))
+    x5 = x.reshape(2, 2, 8, 2, 2)
+    want = np.zeros_like(x5)
+    want[:, 0, :2] = x5[:, 1, :2]                     # back shift
+    want[:, 1, 2:4] = x5[:, 0, 2:4]                   # forward shift
+    want[:, :, 4:] = x5[:, :, 4:]
+    np.testing.assert_allclose(y, want.reshape(4, 8, 2, 2))
+
+
+def test_space_to_depth_matches_reference_layout():
+    """Reference channel layout is BLOCK-major (space_to_depth_op.h:47:
+    out channel k = (bi*b + bj)*C + c), not pixel_shuffle's C-major."""
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 4, 6).astype(np.float32)
+    y = np.asarray(E.space_to_depth(jnp.asarray(x), 2))
+    assert y.shape == (2, 12, 2, 3)
+    for bi in range(2):
+        for bj in range(2):
+            for c in range(3):
+                np.testing.assert_array_equal(
+                    y[:, (bi * 2 + bj) * 3 + c],
+                    x[:, c, bi::2, bj::2])
+
+
+def test_multiplex():
+    a = jnp.asarray([[1.0, 1], [2, 2], [3, 3]])
+    b = jnp.asarray([[10.0, 10], [20, 20], [30, 30]])
+    out = E.multiplex([a, b], jnp.asarray([1, 0, 1]))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [[10, 10], [2, 2], [30, 30]])
+
+
+def test_partial_concat_and_sum_reference_example():
+    a = jnp.asarray([[1.0, 2], [3, 4]])
+    b = jnp.asarray([[5.0, 6], [7, 8]])
+    out = E.partial_concat([a, b], start_index=1, length=1)
+    np.testing.assert_array_equal(np.asarray(out), [[2, 6], [4, 8]])
+    s = E.partial_sum([a, b], start_index=1, length=1)
+    np.testing.assert_array_equal(np.asarray(s), [[8.0], [12.0]])
+
+
+def test_cvm_both_modes():
+    x = jnp.asarray([[3.0, 1.0, 0.5, 0.6]])
+    y = np.asarray(E.cvm(x, use_cvm=True))
+    np.testing.assert_allclose(
+        y[0, :2], [np.log(4.0), np.log(2.0) - np.log(4.0)], rtol=1e-6)
+    np.testing.assert_allclose(y[0, 2:], [0.5, 0.6])
+    y2 = E.cvm(x, use_cvm=False)
+    assert y2.shape == (1, 2)
+
+
+def test_gather_tree_backtrace():
+    # T=3, B=1, K=2; parents select which beam each id came from
+    ids = jnp.asarray([[[1, 2]], [[3, 4]], [[5, 6]]])
+    parents = jnp.asarray([[[0, 0]], [[0, 0]], [[1, 0]]])
+    out = np.asarray(E.gather_tree(ids, parents))
+    # beam 0 at t=2 came from beam 1 at t=1 (parent=1) which came from
+    # beam 0 at t=0
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_fsp_matrix_shape_and_value():
+    x = jnp.ones((2, 3, 4, 4))
+    y = jnp.full((2, 5, 4, 4), 2.0)
+    m = np.asarray(E.fsp_matrix(x, y))
+    assert m.shape == (2, 3, 5)
+    np.testing.assert_allclose(m, 2.0)
+
+
+def test_conv_shift_circular():
+    x = jnp.asarray([[1.0, 2, 3, 4]])
+    y = jnp.asarray([[0.0, 1, 0]])        # identity kernel
+    np.testing.assert_allclose(np.asarray(E.conv_shift(x, y)),
+                               [[1, 2, 3, 4]])
+    shift = jnp.asarray([[1.0, 0, 0]])    # pick left neighbour
+    np.testing.assert_allclose(np.asarray(E.conv_shift(x, shift)),
+                               [[4, 1, 2, 3]])
+
+
+def test_batch_fc():
+    rs = np.random.RandomState(2)
+    x = rs.randn(3, 4, 5).astype(np.float32)
+    w = rs.randn(3, 5, 2).astype(np.float32)
+    b = rs.randn(3, 2).astype(np.float32)
+    out = np.asarray(E.batch_fc(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b)))
+    want = np.einsum("sni,sio->sno", x, w) + b[:, None]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 3, 4, 4).astype(np.float32))
+    out, idx = E.max_pool2d_with_index(x, 2, 2)
+    assert out.shape == (2, 3, 2, 2) and idx.dtype == jnp.int32
+    # indices point at the argmax positions in the flat 4x4 map
+    flat = np.asarray(x).reshape(2, 3, 16)
+    got = np.take_along_axis(flat, np.asarray(idx).reshape(2, 3, 4), -1)
+    np.testing.assert_allclose(got, np.asarray(out).reshape(2, 3, 4))
+    up = E.max_unpool2d(out, idx, (4, 4))
+    assert up.shape == x.shape
+    np.testing.assert_allclose(np.asarray(up).sum(),
+                               np.asarray(out).sum(), rtol=1e-6)
+
+
+def test_spatial_pyramid_pool_sizes():
+    x = jnp.ones((2, 3, 8, 8))
+    y = E.spatial_pyramid_pool(x, pyramid_height=3)
+    assert y.shape == (2, 3 * (1 + 4 + 16))
+    np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+def test_losses_basic_values():
+    np.testing.assert_allclose(
+        np.asarray(E.hinge_loss(jnp.asarray([0.5, -2.0]),
+                                jnp.asarray([1.0, 0.0]))),
+        [0.5, 0.0])
+    # rank loss at o=0, P=0.5: log(2)
+    np.testing.assert_allclose(
+        float(E.rank_loss(0.5, 1.0, 1.0)), np.log(2.0), rtol=1e-6)
+    h = np.asarray(E.huber_loss(jnp.asarray([0.5, 3.0]),
+                                jnp.asarray([0.0, 0.0]), delta=1.0))
+    np.testing.assert_allclose(h, [0.125, 2.5])
+    mh = np.asarray(E.modified_huber_loss(jnp.asarray([0.5, -2.0]),
+                                          jnp.asarray([1.0, 1.0])))
+    np.testing.assert_allclose(mh, [0.25, 8.0])
+    np.testing.assert_allclose(
+        float(E.squared_l2_distance(jnp.ones((1, 4)),
+                                    jnp.zeros((1, 4)))[0]), 4.0)
+    assert float(E.squared_l2_norm(jnp.asarray([3.0, 4.0]))) == 25.0
+    assert float(E.l1_norm(jnp.asarray([-3.0, 4.0]))) == 7.0
+
+
+def test_bpr_loss_prefers_ranked_positive():
+    x_good = jnp.asarray([[5.0, 0.0, 0.0]])
+    x_bad = jnp.asarray([[0.0, 5.0, 5.0]])
+    lab = jnp.asarray([0])
+    assert float(E.bpr_loss(x_good, lab)[0]) < float(E.bpr_loss(x_bad,
+                                                                lab)[0])
+
+
+def test_center_loss_update_moves_centers_toward_features():
+    feats = jnp.asarray([[1.0, 1.0], [3.0, 3.0]])
+    labels = jnp.asarray([0, 0])
+    centers = jnp.zeros((3, 2))
+    loss, new_c = E.center_loss(feats, labels, centers, alpha=1.0)
+    assert loss.shape == (2,)
+    # center 0 moves toward the mean of its features; others untouched
+    assert float(new_c[0, 0]) > 0.0
+    np.testing.assert_allclose(np.asarray(new_c[1:]), 0.0)
+
+
+def test_teacher_student_sigmoid_loss_label_encoding():
+    x = jnp.asarray([0.3, 0.3, 0.3, 0.3])
+    # -2: clk=0 no teacher; -1: clk=1 no teacher; 0.7: clk=0 z'=0.7;
+    # 1.7: clk=1 z'=0.7
+    lab = jnp.asarray([-2.0, -1.0, 0.7, 1.7])
+    out = np.asarray(E.teacher_student_sigmoid_loss(x, lab))
+
+    def xent(x, z):
+        return max(x, 0) - x * z + np.log1p(np.exp(-abs(x)))
+
+    np.testing.assert_allclose(out[0], xent(0.3, 0.0), rtol=1e-6)
+    np.testing.assert_allclose(out[1], xent(0.3, 1.0), rtol=1e-6)
+    np.testing.assert_allclose(out[2], xent(0.3, 0.0) + xent(0.3, 0.7),
+                               rtol=1e-6)
+    np.testing.assert_allclose(out[3], xent(0.3, 1.0) + xent(0.3, 0.7),
+                               rtol=1e-6)
+
+
+def test_add_position_encoding_alpha_beta():
+    x = jnp.zeros((1, 4, 8))
+    y = np.asarray(E.add_position_encoding(x, alpha=2.0, beta=1.0))
+    # position 0: sin terms 0, cos terms 1
+    np.testing.assert_allclose(y[0, 0, :4], 0.0, atol=1e-6)
+    np.testing.assert_allclose(y[0, 0, 4:], 1.0, atol=1e-6)
+
+
+# -- two-stage detector ops -------------------------------------------------
+
+def test_generate_proposals_picks_high_score_nonoverlapping():
+    H = W = 4
+    A = 2
+    # anchors: two sizes per cell
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                size = 8.0 * (a + 1)
+                cx, cy = j * 8.0 + 4, i * 8.0 + 4
+                anchors[i, j, a] = [cx - size / 2, cy - size / 2,
+                                    cx + size / 2, cy + size / 2]
+    var = np.ones((H, W, A, 4), np.float32)
+    scores = np.full((A, H, W), -5.0, np.float32)
+    scores[0, 0, 0] = 5.0
+    scores[0, 3, 3] = 4.0
+    deltas = np.zeros((A * 4, H, W), np.float32)
+    rois, s, valid = V.generate_proposals(
+        jnp.asarray(scores), jnp.asarray(deltas), (32.0, 32.0),
+        jnp.asarray(anchors), jnp.asarray(var),
+        pre_nms_top_n=16, post_nms_top_n=4, nms_thresh=0.5, min_size=2.0)
+    s = np.asarray(s)
+    assert bool(np.asarray(valid)[0]) and s[0] == 5.0 and s[1] == 4.0
+    # the two kept proposals are the two distinct high-score cells
+    r = np.asarray(rois)
+    assert r[0][0] < 8 and r[1][2] > 24
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = jnp.asarray([[0, 0, 10, 10],       # small -> low level
+                        [0, 0, 200, 200]], jnp.float32)
+    lvl, order = V.distribute_fpn_proposals(rois, 2, 5, 4, 224.0)
+    lv = np.asarray(lvl)
+    assert lv[0] < lv[1]
+    out_r, out_s = V.collect_fpn_proposals(
+        [rois[:1], rois[1:]], [jnp.asarray([0.3]), jnp.asarray([0.9])],
+        post_nms_top_n=2)
+    np.testing.assert_allclose(np.asarray(out_s), [0.9, 0.3])
+
+
+def test_target_assign():
+    x = jnp.asarray([[1.0, 2], [3, 4], [5, 6]])
+    mi = jnp.asarray([2, -1, 0, 1])
+    out, w = V.target_assign(x, mi, mismatch_value=-9.0)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [[5, 6], [-9, -9], [1, 2], [3, 4]])
+    np.testing.assert_array_equal(np.asarray(w), [1, 0, 1, 1])
+
+
+def test_density_prior_box_shapes_and_bounds():
+    boxes = V.density_prior_box((2, 2), (32, 32), densities=[2],
+                                fixed_sizes=[8.0], fixed_ratios=[1.0])
+    assert boxes.shape == (2, 2, 4, 4)       # 2x2 density grid = 4 priors
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert (b[..., 2] > b[..., 0]).all()
+
+
+def test_generate_proposals_all_negative_scores_still_returns_topk():
+    """RPN scores are raw logits: a background-only image (all scores
+    negative) must still return the best post_nms_top_n boxes, not an
+    empty set (review r5 finding)."""
+    H = W = 2
+    A = 1
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            anchors[i, j, 0] = [j * 16.0, i * 16.0, j * 16.0 + 12,
+                                i * 16.0 + 12]
+    var = np.ones((H, W, A, 4), np.float32)
+    scores = np.full((A, H, W), -3.0, np.float32)
+    scores[0, 1, 1] = -1.0
+    deltas = np.zeros((A * 4, H, W), np.float32)
+    rois, s, valid = V.generate_proposals(
+        jnp.asarray(scores), jnp.asarray(deltas), (32.0, 32.0),
+        jnp.asarray(anchors), jnp.asarray(var),
+        pre_nms_top_n=4, post_nms_top_n=2, nms_thresh=0.7, min_size=1.0)
+    v = np.asarray(valid)
+    assert v[0] and v[1]
+    assert np.asarray(s)[0] == -1.0
+
+
+def test_add_position_encoding_odd_embedding():
+    y = E.add_position_encoding(jnp.zeros((1, 3, 5)))
+    assert y.shape == (1, 3, 5)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_teacher_student_no_teacher_click_boundary():
+    """label in [-1, 0) means clicked-no-teacher (z=1); label < -1
+    means not-clicked-no-teacher (z=0) — the reference threshold is
+    -1.0 (review r5 finding)."""
+    x = jnp.asarray([2.0, 2.0])
+    out = np.asarray(E.teacher_student_sigmoid_loss(
+        x, jnp.asarray([-1.2, -0.8])))
+
+    def xent(x, z):
+        return max(x, 0) - x * z + np.log1p(np.exp(-abs(x)))
+
+    np.testing.assert_allclose(out[0], xent(2.0, 0.0), rtol=1e-6)
+    np.testing.assert_allclose(out[1], xent(2.0, 1.0), rtol=1e-6)
