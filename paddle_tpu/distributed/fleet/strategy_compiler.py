@@ -138,12 +138,19 @@ def build_train_step(model, optimizer, loss_fn=None, *,
         deg = strategy.parallel_degrees()
         # zero-1/2 compose (params replicated over the manual data axes;
         # only optimizer state is sharded — parity-tested). tp stays
-        # rejected: probed r4 — with no axis_names the shard_map is
-        # manual over ALL axes and would silently all-gather the Megatron
-        # shards (replicated compute), and the correct partial-manual
-        # form (axis_names={dp, fsdp}, tp automatic) hard-aborts XLA CPU
-        # today. pp/sp nest their own manual schedules; zero-3 shards
-        # params over the very axes the reduction is manual over.
+        # rejected: with no axis_names the shard_map is manual over ALL
+        # axes and would silently all-gather the Megatron shards
+        # (replicated compute), and the correct partial-manual form
+        # (axis_names={dp, fsdp}, tp automatic) is blocked upstream —
+        # distilled to tests/repros/fp16_ar_partial_manual_tp.py (r4:
+        # hard XLA-CPU abort; jax 0.9: ShardingTypeError — automatic-
+        # axis contractions inside a partial-manual region demand
+        # per-op out_sharding, which arbitrary layer code cannot
+        # carry). test_fleet.py::test_fp16_allreduce_tp_gate_cites_
+        # live_limitation re-probes every run and fails when upstream
+        # unblocks. pp/sp nest their own manual schedules; zero-3
+        # shards params over the very axes the reduction is manual
+        # over.
         bad = [a for a in ("tp", "pp", "sp") if deg.get(a, 1) > 1]
         if bad or (strategy.sharding.enable and strategy.sharding.stage >= 3):
             raise ValueError(

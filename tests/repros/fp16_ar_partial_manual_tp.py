@@ -1,0 +1,117 @@
+"""Minimal repro: fp16_allreduce ∘ tensor-parallel via a PARTIAL-manual
+shard_map (manual over the data axes, tp automatic) is blocked upstream.
+
+This is why the strategy compiler rejects
+``fp16_allreduce × {tp, pp, sp, zero-3}``
+(``distributed/fleet/strategy_compiler.py``, the ``use_fp16_ar`` gate):
+compressing the data-parallel gradient reduction requires a shard_map
+manual over the batch axes (the wire-dtype psum must be explicit —
+XLA's implicit backward reduction is always fp32), while the Megatron
+matmuls need tp to stay an *automatic* axis inside that region. The
+reference composes these freely because its fp16_allreduce pass
+rewrites the c_allreduce ops in a static graph
+(``python/paddle/distributed/fleet/meta_optimizers/
+fp16_allreduce_optimizer.py``) — there is no manual/automatic axis
+distinction to cross.
+
+History of the failure mode:
+- r4 (earlier jax): the partial-manual formulation hard-aborted XLA CPU
+  ("Fatal Python error: Aborted" during compilation) — the original
+  reason for the gate, then undistilled.
+- jax 0.9.0 (current): the abort is gone; the program now fails EARLIER
+  and more honestly, at trace time, in the sharding-in-types checker:
+
+      jax._src.core.ShardingTypeError: Contracting dimensions are
+      sharded and it is ambiguous how the output should be sharded.
+      Please specify the output sharding via the `out_sharding`
+      parameter. Got lhs_contracting_spec=('tp',) and
+      rhs_contracting_spec=('tp',)
+
+  i.e. inside a partially-manual region, an automatic-axis contraction
+  no longer gets the GSPMD treatment (insert the tp psum); it demands a
+  per-operation ``out_sharding`` annotation. Arbitrary model code (every
+  ``jnp.dot`` in every layer) cannot carry that annotation, so the
+  composition stays gated rather than half-supported.
+
+Run: python tests/repros/fp16_ar_partial_manual_tp.py
+Exit 0 either way; the message says whether the limitation still
+reproduces. If it stops reproducing (jax starts inserting the tp
+reduction automatically), the strategy-compiler gate can open for tp —
+``tests/test_fleet.py::test_fp16_allreduce_tp_gate_cites_live_limitation`` will
+flag it.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def reproduces() -> bool:
+    """True if the partial-manual fp16-allreduce-with-tp program still
+    fails to trace/compile."""
+    from jax import shard_map
+
+    mesh = jax.make_mesh((4, 2), ("dp", "tp"))
+    rs = np.random.RandomState(0)
+    w1 = jax.device_put(jnp.asarray(rs.randn(16, 32), jnp.float32),
+                        NamedSharding(mesh, P(None, "tp")))
+    w2 = jax.device_put(jnp.asarray(rs.randn(32, 16), jnp.float32),
+                        NamedSharding(mesh, P("tp", None)))
+    x = jax.device_put(jnp.asarray(rs.randn(8, 16), jnp.float32),
+                       NamedSharding(mesh, P("dp", None)))
+
+    def local(w1, w2, xb):
+        def loss(ws):
+            a, b = ws
+            h = jnp.maximum(xb @ a, 0.0)   # [B/dp, F] sharded tp on F
+            return jnp.mean((h @ b) ** 2)  # tp-contraction: needs psum
+
+        l, g = jax.value_and_grad(loss)((w1, w2))
+        n = jax.lax.psum(1, "dp")
+        g = jax.tree_util.tree_map(
+            lambda t: (jax.lax.psum(t.astype(jnp.bfloat16), "dp") / n
+                       ).astype(t.dtype), g)
+        return jax.lax.pmean(l, "dp"), g
+
+    try:
+        f = jax.jit(shard_map(
+            local, mesh=mesh, axis_names={"dp"},
+            in_specs=(P(None, None), P(None, None), P("dp", None)),
+            out_specs=(P(), (P(None, None), P(None, None))),
+            check_vma=False))
+        jax.block_until_ready(f(w1, w2, x))
+        return False
+    except Exception as e:
+        # only the DOCUMENTED failure counts as "still reproduces":
+        # anything else (e.g. a renamed shard_map kwarg) must propagate,
+        # or incidental API drift would mute this canary forever
+        if (type(e).__name__ == "ShardingTypeError"
+                or "out_sharding" in str(e)):
+            print(f"  failed as expected: {type(e).__name__}: "
+                  f"{str(e)[:200]}")
+            return True
+        raise
+
+
+def main():
+    if reproduces():
+        print("REPRODUCES: partial-manual fp16-allreduce with automatic "
+              "tp still fails — the strategy-compiler gate stands.")
+    else:
+        print("FIXED UPSTREAM: the composition now traces — revisit the "
+              "fp16_allreduce tp gate in strategy_compiler.py "
+              "(parity-test against the fp32 path, then open the gate).")
+
+
+if __name__ == "__main__":
+    main()

@@ -614,3 +614,24 @@ def test_strategy_json_roundtrip_all_configs():
     assert s2.expert_parallel.degree == 8
     assert s2.pipeline.schedule == "1f1b"
     assert s2.parallel_degrees() == s.parallel_degrees()
+
+
+def test_fp16_allreduce_tp_gate_cites_live_limitation(devices8):
+    """The fp16_allreduce × tp gate rests on a distilled, in-tree repro
+    (tests/repros/fp16_ar_partial_manual_tp.py): partial-manual
+    shard_map with an automatic tp axis rejects the Megatron
+    contraction (ShardingTypeError on jax 0.9; a hard XLA-CPU abort
+    before that). This test runs the repro — if jax starts accepting
+    the composition, it FAILS to flag that the gate can open."""
+    import importlib.util as _ilu
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "repros",
+                        "fp16_ar_partial_manual_tp.py")
+    spec = _ilu.spec_from_file_location("fp16_ar_repro", path)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.reproduces(), (
+        "upstream now accepts partial-manual fp16-allreduce with "
+        "automatic tp — revisit the strategy_compiler gate "
+        "(parity-test tp, then open it)")
