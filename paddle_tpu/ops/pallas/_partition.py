@@ -160,22 +160,28 @@ def _build(global_fn, plan, rule, *, need_replication=(), reduction=(),
 # flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_plan(mesh, arg_shapes):
-    """Shard over batch and heads; seq/head_dim replicated. The head
-    sharding must divide the kv heads too so each shard keeps whole GQA
+def _batch_head_plan(mesh, B, Hq, Hkv, b_entry, h_entry):
+    """Shared batch/head sharding selection for the attention units:
+    shard batch and heads, everything else replicated. The head
+    sharding must divide BOTH head counts so each shard keeps whole GQA
     groups (contiguous blocks: q heads [i·Hq/s, …) ↔ kv heads
     [i·Hkv/s, …))."""
-    B, Hq = arg_shapes[0].shape[0], arg_shapes[0].shape[1]
-    Hkv = arg_shapes[1].shape[1]
-    qspec = _spec_entries(_sharding_of(arg_shapes[0]), 4)
-    kspec = _spec_entries(_sharding_of(arg_shapes[1]), 4)
     used: set = set()
-    b = _valid_dim(mesh, qspec[0] or kspec[0], B, used)
-    h = qspec[1] or kspec[1]
+    b = _valid_dim(mesh, b_entry, B, used)
+    h = h_entry
     if _size(mesh, h) > 1 and (Hkv % _size(mesh, h) or Hq % _size(mesh, h)):
         h = None
     h = _valid_dim(mesh, h, math.gcd(Hq, Hkv), used)
     return b, h
+
+
+def _flash_plan(mesh, arg_shapes):
+    B, Hq = arg_shapes[0].shape[0], arg_shapes[0].shape[1]
+    Hkv = arg_shapes[1].shape[1]
+    qspec = _spec_entries(_sharding_of(arg_shapes[0]), 4)
+    kspec = _spec_entries(_sharding_of(arg_shapes[1]), 4)
+    return _batch_head_plan(mesh, B, Hq, Hkv, qspec[0] or kspec[0],
+                            qspec[1] or kspec[1])
 
 
 @functools.lru_cache(maxsize=None)
@@ -722,3 +728,49 @@ def selective_scan_bwd(k: int):
                   "b t e, b t e, n e, b t n, b t n, b c n e, b t e "
                   "-> b t e, b t e, b t n, b t n, b n e",
                   need_replication=("t", "n", "c"))
+
+
+# ---------------------------------------------------------------------------
+# decode attention (serving): shard over batch + kv heads
+# ---------------------------------------------------------------------------
+
+def _decode_plan(mesh, arg_shapes):
+    """args: (sp [2], q2 [B,Hq,D], kn2 [B,Hkv,D], vn2, kc [L,B,Hkv,S,D],
+    vc, [ks [L,B,Hkv,S], vs]). Shard batch + heads (whole GQA groups);
+    layer/seq/head_dim and the scalar-prefetch vector replicated."""
+    B, Hq = arg_shapes[1].shape[0], arg_shapes[1].shape[1]
+    Hkv = arg_shapes[2].shape[1]
+    qspec = _spec_entries(_sharding_of(arg_shapes[1]), 3)
+    cspec = _spec_entries(_sharding_of(arg_shapes[4]), 5)
+    return _batch_head_plan(mesh, B, Hq, Hkv, qspec[0] or cspec[1],
+                            qspec[1] or cspec[2])
+
+
+@functools.lru_cache(maxsize=None)
+def decode_attn(scale: float, group: int, quantized: bool):
+    DA = _mod("decode_attention")
+
+    def fn(ctx, sp, q2, kn2, vn2, *cache):
+        stats["decode_attn:kernel"] += 1
+        return DA.raw_call(sp, q2, kn2, vn2, *cache, scale=scale)
+
+    def plan(mesh, arg_shapes):
+        b, h = _decode_plan(mesh, arg_shapes)
+        q_like = P(b, h, None)
+        kv_like = P(b, h, None)
+        c_like = P(None, b, h, None, None)
+        args = [P(None), q_like, kv_like, kv_like, c_like, c_like]
+        if quantized:
+            args += [P(None, b, h, None), P(None, b, h, None)]
+        return tuple(args), (q_like,), None
+
+    hq = "(h g)" if group > 1 else "h"
+    if quantized:
+        rule = (f"z, b {hq} d, b h d, b h d, l b h s d, l b h s d, "
+                f"l b h s, l b h s -> b {hq} d")
+    else:
+        rule = (f"z, b {hq} d, b h d, b h d, l b h s d, l b h s d "
+                f"-> b {hq} d")
+    return _build(fn, plan, rule,
+                  need_replication=("z", "d", "l", "s"),
+                  factor_sizes=({"g": group} if group > 1 else None))

@@ -56,9 +56,15 @@ def _block_k(S: int) -> int:
 def supported(q, cache) -> bool:
     """Kernel gate; callers fall back to the einsum path when False.
     Decode chunks only (T == 1); prefill always takes the flash path.
-    ``cache`` holds the STACKED buffers ([L, B, Hkv, S, D])."""
+    ``cache`` holds the STACKED buffers ([L, B, Hkv, S, D]). Under a
+    multi-device mesh the custom_partitioning wrapper
+    (``_partition.decode_attn``) runs the kernel per batch/head shard —
+    TP-sharded serving keeps the kernel path (tp must divide
+    num_kv_heads, the same constraint correct Megatron attention
+    sharding already imposes; a larger tp fails inside jax's sharding
+    conversion before any fallback can intercept)."""
     mode = _support.dispatch_mode()
-    if mode not in ("raw",):
+    if mode not in ("raw", "partitioned"):
         return False
     if q.ndim != 4 or q.shape[1] != 1:
         return False
@@ -166,16 +172,34 @@ def decode_attention(q, k_new, v_new, cache, layer, index, *, scale: float):
     Hkv = k_new.shape[1]
     G = Hq // Hkv
     quantized = len(cache) == 4
-    kc, vc = cache[0], cache[1]
-    S = kc.shape[3]
-    bk = _block_k(S)
-    nk = S // bk
 
     q2 = q.reshape(B, Hq, D)
     kn2 = k_new.reshape(B, Hkv, D)
     vn2 = v_new.reshape(B, Hkv, D)
     sp = jnp.stack([jnp.asarray(layer, jnp.int32),
                     jnp.asarray(index, jnp.int32)])
+
+    if _support.dispatch_mode() == "partitioned":
+        from paddle_tpu.ops.pallas import _partition
+        out = _partition.decode_attn(float(scale), G, quantized)(
+            sp, q2, kn2, vn2, *cache)
+    else:
+        out = raw_call(sp, q2, kn2, vn2, *cache, scale=scale)
+    return out.reshape(B, 1, Hq, D)
+
+
+def raw_call(sp, q2, kn2, vn2, *cache, scale: float):
+    """The pallas_call on (per-shard) local shapes: sp = int32[2]
+    (layer, index); q2 [B, Hq, D]; kn2/vn2 [B, Hkv, D]; cache the
+    stacked buffers. Returns [B, Hq, D]."""
+    B, Hq, D = q2.shape
+    Hkv = kn2.shape[1]
+    G = Hq // Hkv
+    quantized = len(cache) == 4
+    kc, vc = cache[0], cache[1]
+    S = kc.shape[3]
+    bk = _block_k(S)
+    nk = S // bk
 
     def cache_map(b, j, sp_ref):
         last = jnp.maximum(sp_ref[1] - 1, 0) // bk
@@ -201,8 +225,8 @@ def decode_attention(q, k_new, v_new, cache, layer, index, *, scale: float):
 
     kernel = functools.partial(
         _kernel, scale=scale, bk=bk, nk=nk, G=G, Hkv=Hkv,
-        quantized=quantized, out_dtype=q.dtype)
-    out = pl.pallas_call(
+        quantized=quantized, out_dtype=q2.dtype)
+    return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -215,9 +239,8 @@ def decode_attention(q, k_new, v_new, cache, layer, index, *, scale: float):
                 pltpu.VMEM((Hq, LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q2.dtype),
         compiler_params=_support.compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_support.interpret(),
     )(sp, *args)
-    return out.reshape(B, 1, Hq, D)

@@ -145,3 +145,50 @@ def test_serving_concurrent_clients(saved_mlp):
         np.testing.assert_allclose(
             y, np.asarray(ref.run(np.full((2, 4), float(i), np.float32))),
             rtol=1e-6)
+
+
+def test_serving_concurrent_generate_clients(tmp_path):
+    """Concurrent clients against the LLM GENERATE endpoint (the r4
+    concurrency test covered plain predictors only): six threads drive
+    the compiled decode loop with distinct prompts; every client gets
+    ITS prompt's greedy continuation, bit-equal to a local generate."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.io import save_inference_model
+
+    paddle_tpu.seed(3)
+    cfg = LlamaConfig.tiny(vocab_size=128, num_layers=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(1)
+    proto = rs.randint(0, 128, (2, 8)).astype(np.int32)
+    path = str(tmp_path / "llm")
+    save_inference_model(path, model, [proto],
+                         forward=lambda m, ids: generate(m, ids, 12))
+
+    server = InferenceServer({"llm": path}).start()
+    prompts = {i: rs.randint(0, 128, (2, 8)).astype(np.int32)
+               for i in range(6)}
+    results, errs = {}, []
+
+    def worker(i):
+        try:
+            c = InferenceClient(server.endpoint)
+            (out,) = c.infer("llm", prompts[i])
+            results[i] = out
+            c.close()
+        except Exception as e:   # pragma: no cover - failure reporting
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in prompts]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    server.stop()
+    assert not errs and len(results) == 6, errs
+    for i, out in results.items():
+        ref = np.asarray(generate(model, jnp.asarray(prompts[i]), 12))
+        np.testing.assert_array_equal(out, ref, err_msg=f"client {i}")
