@@ -1,0 +1,167 @@
+"""Deterministic, flag-gated fault injection.
+
+Reference role: the failure-path testing the reference's elastic stack
+leaves implicit — proc-watcher restart + auto-checkpoint resume
+(``fluid/incubate/checkpoint/auto_checkpoint.py:71``) assumes the wire,
+the FS, and the checkpoint writer fail loudly; this registry lets tests
+and the chaos harness (``tools/chaos_check.py``) *make* them fail, on
+demand and reproducibly.
+
+Sites are dotted names hooked into the production paths:
+
+    ``wire.send`` / ``wire.recv``   — FrameClient request round-trip
+    ``fs.upload`` / ``fs.download`` — checkpoint FS transfers
+    ``ckpt.save``                   — orbax save (before manifest commit)
+
+A spec string (the ``fault_inject`` flag, or :func:`configure`) selects
+sites::
+
+    FLAGS_fault_inject="wire.send=1.0@2,fs.upload=0.5"
+
+``site=prob`` fires with probability ``prob`` per hit; ``@N`` caps total
+fires at N. Every site draws from its own ``random.Random`` seeded with
+``(fault_seed, site)``, so the fire pattern is reproducible per site
+regardless of how threads interleave *across* sites.
+
+Injection is hard-off by default: ``_ACTIVE`` is None and every hook is
+a single module-attribute read on the hot path. Fired faults raise
+:class:`InjectedFault` (a ``ConnectionError``, so wire retry paths treat
+them exactly like a dead peer) and increment ``fault/injected/<site>``
+in ``core/monitor``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from paddle_tpu.core.monitor import stat_add
+
+__all__ = ["InjectedFault", "inject", "enabled", "configure", "reset",
+           "inject_faults", "parse_spec", "site_counts"]
+
+
+class InjectedFault(ConnectionError):
+    """An injected failure. Subclasses ConnectionError so transport-level
+    handlers (retry/reconnect) treat it like a real peer failure."""
+
+
+class _Site:
+    __slots__ = ("name", "prob", "limit", "rng", "fired", "hits")
+
+    def __init__(self, name: str, prob: float, limit: int | None, seed: int):
+        self.name = name
+        self.prob = float(prob)
+        self.limit = limit
+        self.rng = random.Random(f"{seed}:{name}")
+        self.fired = 0
+        self.hits = 0
+
+
+_lock = threading.Lock()
+_ACTIVE: dict[str, _Site] | None = None   # None == injection fully off
+
+
+def parse_spec(spec) -> dict[str, tuple[float, int | None]]:
+    """``"a=1.0@2, b=0.5"`` → ``{"a": (1.0, 2), "b": (0.5, None)}``.
+    Dicts pass through (values: prob or (prob, limit))."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        out = {}
+        for site, v in spec.items():
+            prob, limit = v if isinstance(v, (tuple, list)) else (v, None)
+            out[site] = (float(prob), None if limit is None else int(limit))
+        return out
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, rest = part.partition("=")
+        rest = rest or "1.0"
+        probs, _, cap = rest.partition("@")
+        out[site.strip()] = (float(probs), int(cap) if cap else None)
+    return out
+
+
+def configure(spec, seed: int | None = None) -> None:
+    """(Re)configure injection from a spec (see :func:`parse_spec`).
+    Empty/None spec turns injection fully off. Reconfiguring resets all
+    per-site counters and RNG streams — chaos runs are reproducible."""
+    global _ACTIVE
+    parsed = parse_spec(spec)
+    if seed is None:
+        from paddle_tpu.core.flags import flag
+
+        seed = int(flag("fault_seed"))
+    with _lock:
+        if not parsed:
+            _ACTIVE = None
+            return
+        _ACTIVE = {site: _Site(site, prob, limit, seed)
+                   for site, (prob, limit) in parsed.items()}
+
+
+def reset() -> None:
+    """Turn injection off (the production default)."""
+    global _ACTIVE
+    with _lock:
+        _ACTIVE = None
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def site_counts() -> dict[str, tuple[int, int]]:
+    """{site: (hits, fired)} for the active config (empty when off)."""
+    active = _ACTIVE
+    if active is None:
+        return {}
+    with _lock:
+        return {s.name: (s.hits, s.fired) for s in active.values()}
+
+
+def inject(site: str) -> None:
+    """Injection hook. No-op unless injection is configured AND the spec
+    names ``site``; otherwise draws from the site's deterministic RNG
+    and raises :class:`InjectedFault` on a hit."""
+    active = _ACTIVE
+    if active is None:
+        return
+    s = active.get(site)
+    if s is None:
+        return
+    with _lock:
+        s.hits += 1
+        if s.limit is not None and s.fired >= s.limit:
+            return
+        if s.prob < 1.0 and s.rng.random() >= s.prob:
+            return
+        s.fired += 1
+        n = s.fired
+    stat_add(f"fault/injected/{site}")
+    raise InjectedFault(f"injected fault at {site!r} (#{n})")
+
+
+class inject_faults:
+    """Context manager for scoped chaos: ``with inject_faults({"wire.send":
+    (1.0, 2)}, seed=7): ...`` — restores the previous config on exit."""
+
+    def __init__(self, spec, seed: int | None = None):
+        self._spec = spec
+        self._seed = seed
+
+    def __enter__(self):
+        global _ACTIVE
+        with _lock:
+            self._prev = _ACTIVE
+        configure(self._spec, self._seed)
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        with _lock:
+            _ACTIVE = self._prev
+        return False

@@ -96,3 +96,53 @@ define_flag("deterministic", False,
 define_flag("amp_dtype", "bfloat16",
             "Autocast compute dtype for AMP (bf16 is TPU-native; fp16 kept "
             "for parity with reference AMP lists)")
+
+# --- fault-tolerance layer (core/fault.py, core/wire.py, io/checkpoint.py) ---
+define_flag("wire_timeout_s", 60.0,
+            "Connect + per-request deadline for frame-protocol clients "
+            "(serving, PS, ptfs). <= 0 disables the deadline (the old "
+            "block-forever behavior)")
+define_flag("wire_retries", 2,
+            "Retry budget for idempotent wire requests after a connection "
+            "failure/timeout (transparent reconnect between attempts). "
+            "0 disables retry")
+define_flag("wire_backoff_s", 0.05,
+            "Base of the exponential retry backoff (doubles per attempt, "
+            "+/-50% jitter)")
+define_flag("wire_backoff_max_s", 2.0,
+            "Cap on a single retry backoff sleep")
+define_flag("ckpt_manifest", True,
+            "Write + verify per-step checkpoint manifests (leaf names and "
+            "checksums); corrupt steps then fall back to the newest "
+            "verifiable one instead of crashing the resume")
+
+
+def _on_fault_seed(v) -> None:
+    try:
+        spec = flag("fault_inject")
+    except KeyError:
+        # fault_inject is defined right after fault_seed; its own on_set
+        # (re)configures with the seed set here (the env-var import path)
+        return
+    from paddle_tpu.core import fault
+
+    fault.configure(spec, seed=int(v))
+
+
+def _on_fault_inject(v) -> None:
+    from paddle_tpu.core import fault
+
+    fault.configure(v)
+
+
+# fault_seed must be defined BEFORE fault_inject: fault.configure reads it,
+# and a FLAGS_fault_inject env var fires on_set during this import.
+define_flag("fault_seed", 0,
+            "Seed for the deterministic per-site fault-injection RNGs "
+            "(set before fault_inject)", on_set=_on_fault_seed)
+define_flag("fault_inject", "",
+            "Fault-injection spec, e.g. 'wire.send=1.0@2,fs.upload=0.5' "
+            "(site=probability, optional @N total-fire cap). Empty string "
+            "— the default — disables injection entirely; production "
+            "paths then pay a single global read per site",
+            on_set=_on_fault_inject)

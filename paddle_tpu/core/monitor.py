@@ -22,7 +22,7 @@ from typing import Any
 
 __all__ = ["StatRegistry", "stats", "stat_add", "stat_set", "get_stat",
            "export_stats", "reset_stats", "StepTimer", "device_memory_stats",
-           "host_rss_bytes"]
+           "host_rss_bytes", "host_peak_rss_bytes"]
 
 
 class StatRegistry:
@@ -88,22 +88,29 @@ class StepTimer:
     def __init__(self, name: str = "train", window: int = 20):
         self.name = name
         self.window = window
-        self._times: list[float] = []
+        # (perf_counter, tokens) per tick; the first entry anchors the
+        # window, so token sums cover ticks 1..end (the steps the window
+        # interval actually spans)
+        self._ticks: list[tuple[float, int]] = []
 
     def tick(self, tokens: int | None = None) -> None:
         now = time.perf_counter()
-        self._times.append(now)
-        if len(self._times) > self.window + 1:
-            self._times.pop(0)
+        self._ticks.append((now, int(tokens or 0)))
+        if len(self._ticks) > self.window + 1:
+            self._ticks.pop(0)
         stat_add(f"{self.name}/steps", 1)
         if tokens:
             stat_add(f"{self.name}/tokens", tokens)
-        if len(self._times) >= 2:
-            dt = self._times[-1] - self._times[0]
-            sps = (len(self._times) - 1) / dt if dt > 0 else 0.0
+        if len(self._ticks) >= 2:
+            dt = self._ticks[-1][0] - self._ticks[0][0]
+            n = len(self._ticks) - 1
+            sps = n / dt if dt > 0 else 0.0
             stat_set(f"{self.name}/steps_per_sec", sps)
-            if tokens:
-                stat_set(f"{self.name}/tokens_per_sec", sps * tokens)
+            # windowed token sum, NOT last-tick-tokens * steps/sec —
+            # variable-length batches would misreport otherwise
+            tok = sum(t for _, t in self._ticks[1:])
+            if tok and dt > 0:
+                stat_set(f"{self.name}/tokens_per_sec", tok / dt)
 
 
 def device_memory_stats(device=None) -> dict[str, Any]:
@@ -116,7 +123,22 @@ def device_memory_stats(device=None) -> dict[str, Any]:
 
 
 def host_rss_bytes() -> int:
-    """Resident set size of this process (host-side memory monitor)."""
+    """CURRENT resident set size of this process, from /proc/self/status
+    VmRSS (ru_maxrss is the lifetime *peak*, not current — see
+    :func:`host_peak_rss_bytes`); falls back to the peak where /proc is
+    unavailable (macOS)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024     # value is kB
+    except (OSError, ValueError, IndexError):
+        pass
+    return host_peak_rss_bytes()
+
+
+def host_peak_rss_bytes() -> int:
+    """Peak resident set size over the process lifetime (ru_maxrss)."""
     import resource
 
     # ru_maxrss is KiB on Linux

@@ -17,11 +17,17 @@ still gate their mutating/admin ops before non-loopback exposure — see
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any
+import time
+from typing import Any, Iterable
+
+from paddle_tpu.core import fault as _fault
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import stat_add
 
 __all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
            "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES"]
@@ -93,6 +99,8 @@ class FrameService:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
                 try:
                     while True:
                         op, header, payload = recv_frame(self.request)
@@ -101,11 +109,16 @@ class FrameService:
                             return
                 except (ConnectionError, OSError):
                     return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: threading.Thread | None = None
@@ -125,6 +138,20 @@ class FrameService:
             self._server.shutdown()
             self._thread = None
         self._server.server_close()
+        # sever established connections too — a stopped service must look
+        # like a dead process to its clients (EOF/RST now), not leave
+        # handler threads silently serving stale sockets forever
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _dispatch(self, sock, op: int, header: dict,
                   payload: bytes) -> bool:  # pragma: no cover - abstract
@@ -133,30 +160,147 @@ class FrameService:
 
 class FrameClient:
     """Single-connection client over the frame protocol; thread-safe
-    request/response with server errors surfaced as RuntimeError."""
+    request/response with server errors surfaced as RuntimeError.
+
+    Fault tolerance (flags ``wire_timeout_s``/``wire_retries``/
+    ``wire_backoff_s``): connect and each request round-trip carry a
+    deadline, and ops named in ``idempotent`` are retried across a
+    transparent reconnect with exponential backoff + jitter when the
+    connection dies or times out — a restarted server is picked up
+    mid-stream. Non-idempotent ops (grad pushes, appends, barriers) fail
+    fast after closing the broken socket. Retries/reconnects/timeouts
+    increment ``wire/*`` stats in ``core/monitor``.
+    """
 
     def __init__(self, endpoint: str, ops: dict[str, int],
-                 service: str = "service"):
+                 service: str = "service", *, timeout: float | None = None,
+                 retries: int | None = None,
+                 idempotent: Iterable[str] = ()):
         host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)))
+        self.endpoint = endpoint
+        self._addr = (host, int(port))
+        self._timeout = (flag("wire_timeout_s") if timeout is None
+                         else timeout)
+        self._retries = (int(flag("wire_retries")) if retries is None
+                         else int(retries))
+        self._idempotent = frozenset(idempotent)
         self._lock = threading.Lock()
         self._ops = ops
         self._service = service
+        self._closed = False
+        self._sock: socket.socket | None = None
+        self._connect()
 
-    def _request(self, op: str, header: dict, payload: bytes = b""):
+    @property
+    def _deadline(self) -> float | None:
+        return self._timeout if self._timeout and self._timeout > 0 else None
+
+    def _connect(self) -> None:
+        t = self._deadline
+        sock = socket.create_connection(self._addr, timeout=t)
+        # Enforce the request deadline with kernel SO_RCVTIMEO/SO_SNDTIMEO
+        # on a BLOCKING socket: settimeout() would flip the socket to
+        # non-blocking and pay a poll() before every send/recv — the
+        # kernel option keeps the fast path at exactly the seed's syscall
+        # count (a timed-out op surfaces as EAGAIN).
+        sock.settimeout(None)
+        self._kernel_deadline = False
+        if t is not None:
+            try:
+                tv = struct.pack("ll", int(t), int((t % 1.0) * 1e6))
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+                self._kernel_deadline = True
+            except (OSError, struct.error):   # exotic platform: poll path
+                sock.settimeout(t)
+        self._sock = sock
+
+    def _backoff(self, attempt: int) -> float:
+        base = float(flag("wire_backoff_s")) * (2 ** attempt)
+        base = min(base, float(flag("wire_backoff_max_s")))
+        return base * (0.5 + random.random())      # +/-50% jitter
+
+    @staticmethod
+    def _is_timeout(e: BaseException) -> bool:
+        # settimeout path raises TimeoutError; the kernel SO_RCVTIMEO
+        # path surfaces as EAGAIN/EWOULDBLOCK on a blocking socket
+        import errno
+
+        return (isinstance(e, (TimeoutError, socket.timeout))
+                or getattr(e, "errno", None) in (errno.EAGAIN,
+                                                 errno.EWOULDBLOCK))
+
+    def _request(self, op: str, header: dict, payload: bytes = b"",
+                 idempotent: bool | None = None,
+                 timeout: float | None = None):
+        """``timeout`` overrides the client deadline for this request
+        only (ops with a known longer server-side wait, e.g. the PS
+        barrier); ``idempotent`` overrides the constructor's op set."""
+        if idempotent is None:
+            idempotent = op in self._idempotent
+        attempts = (self._retries if idempotent else 0) + 1
         with self._lock:
-            send_frame(self._sock, self._ops[op], header, payload)
-            # replies come from the server this client chose to connect
-            # to — no size cap (a large pull/infer reply is legitimate)
-            code, rheader, rpayload = recv_frame(self._sock,
-                                                 max_payload=None)
+            if self._closed:
+                raise ConnectionError(
+                    f"{self._service} client for {self.endpoint} is closed")
+            for attempt in range(attempts):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                        stat_add("wire/reconnects")
+                    if timeout is not None:
+                        self._sock.settimeout(
+                            timeout if timeout > 0 else None)
+                    if _fault._ACTIVE is not None:
+                        _fault.inject("wire.send")
+                    send_frame(self._sock, self._ops[op], header, payload)
+                    # replies come from the server this client chose to
+                    # connect to — no size cap (a large pull/infer reply
+                    # is legitimate)
+                    code, rheader, rpayload = recv_frame(self._sock,
+                                                         max_payload=None)
+                    if _fault._ACTIVE is not None:
+                        _fault.inject("wire.recv")
+                    if timeout is not None:
+                        # back to the standing deadline (kernel sockopts
+                        # still armed in the blocking-mode path)
+                        self._sock.settimeout(
+                            None if self._kernel_deadline
+                            else self._deadline)
+                    break
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    if self._is_timeout(e):
+                        stat_add("wire/timeouts")
+                    self._close_locked()
+                    if attempt + 1 >= attempts:
+                        raise ConnectionError(
+                            f"{self._service} {op} to {self.endpoint} "
+                            f"failed after {attempt + 1} attempt(s): "
+                            f"{type(e).__name__}: {e}") from e
+                    stat_add("wire/retries")
+                    time.sleep(self._backoff(attempt))
         if code != 0:
             raise RuntimeError(
                 f"{self._service} {op} failed: {rheader.get('error')}")
         return rheader, rpayload
 
+    def _close_locked(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        """Idempotent; a closed client refuses further requests."""
+        with self._lock:
+            self._closed = True
+            self._close_locked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

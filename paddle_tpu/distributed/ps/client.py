@@ -11,12 +11,12 @@ fast path). Multi-server sharding: ids are routed to servers by
 
 from __future__ import annotations
 
-import socket
 import threading
 
 import numpy as np
 
-from paddle_tpu.distributed.ps.server import OPS, recv_frame, send_frame
+from paddle_tpu.core.wire import FrameClient
+from paddle_tpu.distributed.ps.server import OPS
 from paddle_tpu.native import NativeSparseTable
 
 __all__ = ["PSClient", "InProcClient"]
@@ -68,37 +68,41 @@ class InProcClient:
         pass
 
 
-class _Conn:
-    def __init__(self, endpoint: str):
-        host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)))
-        self.lock = threading.Lock()
+# replayable PS ops: reads plus naturally idempotent mutations.
+# push_grad/push_delta are NOT here (a replayed push double-applies) and
+# neither is barrier (a replay could double-count the rendezvous).
+_IDEMPOTENT = ("create", "pull", "size", "keys", "save", "load",
+               "heartbeat", "lost")
 
-    def request(self, op: str, header: dict, payload: bytes = b""):
-        with self.lock:
-            send_frame(self.sock, OPS[op], header, payload)
-            # Replies come from the server this client chose to connect
-            # to — no size cap (a large pull is a legitimate response).
-            code, rheader, rpayload = recv_frame(self.sock, max_payload=None)
-        if code != 0:
-            raise RuntimeError(f"PS {op} failed: {rheader.get('error')}")
-        return rheader, rpayload
 
-    def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+class _Conn(FrameClient):
+    """One server connection: a FrameClient with the PS op table —
+    deadlines, reconnect, and idempotent-op retry come from the shared
+    wire layer (a dead pserver no longer hangs every worker forever)."""
+
+    def __init__(self, endpoint: str, timeout: float | None = None):
+        super().__init__(endpoint, OPS, service="PS", timeout=timeout,
+                         idempotent=_IDEMPOTENT)
+
+    request = FrameClient._request    # public name used by PSClient
 
 
 class PSClient:
-    """TCP client; ids shard across servers by hash (parameter_prefetch)."""
+    """TCP client; ids shard across servers by hash (parameter_prefetch).
 
-    def __init__(self, endpoints: list[str] | str):
+    ``timeout`` (default: flag ``wire_timeout_s``) bounds connect and
+    every request round-trip. NOTE: barrier blocks server-side up to
+    120s, so pass a larger timeout (or <= 0 for none) when using
+    barriers with small deadlines.
+    """
+
+    def __init__(self, endpoints: list[str] | str,
+                 timeout: float | None = None):
         if isinstance(endpoints, str):
             endpoints = [endpoints]
         self._endpoints = list(endpoints)
-        self._conns = [_Conn(e) for e in endpoints]
+        self._timeout = timeout
+        self._conns = [_Conn(e, timeout) for e in endpoints]
         self.n = len(self._conns)
         self._hb_conn: _Conn | None = None
         self._hb_lock = threading.Lock()
@@ -109,7 +113,7 @@ class PSClient:
         up to 120s, which would stall beats past the staleness window)."""
         with self._hb_lock:
             if self._hb_conn is None:
-                self._hb_conn = _Conn(self._endpoints[0])
+                self._hb_conn = _Conn(self._endpoints[0], self._timeout)
             return self._hb_conn
 
     def _route(self, ids: np.ndarray) -> np.ndarray:
@@ -196,8 +200,11 @@ class PSClient:
 
     def barrier(self, world: int):
         """Block until ``world`` workers reach this point (role-maker
-        barrier, served by server 0)."""
-        self._conns[0].request("barrier", {"world": int(world)})
+        barrier, served by server 0). The server waits up to 120s, so
+        this request gets its own deadline just past that instead of the
+        generic ``wire_timeout_s``."""
+        self._conns[0].request("barrier", {"world": int(world)},
+                               timeout=130.0)
 
     def heartbeat(self, worker_id: int, status: str = "running"):
         """Report liveness to the chief (server 0) heartbeat monitor —

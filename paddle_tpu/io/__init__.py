@@ -5,12 +5,15 @@ dygraph state-dict checkpoints (``fluid/dygraph/checkpoint.py``).
 """
 
 from paddle_tpu.io.checkpoint import (
+    CheckpointIntegrityError,
+    latest_step,
     load_checkpoint,
     save_checkpoint,
     load_state_dict,
     save_state_dict,
     state_dict,
     set_state_dict,
+    verify_step,
 )
 from paddle_tpu.io.export import (
     Predictor,
@@ -19,6 +22,10 @@ from paddle_tpu.io.export import (
     save_inference_model,
 )
 from paddle_tpu.io.auto_checkpoint import TrainEpochRange, train_epoch_range
+from paddle_tpu.io.guard import (
+    PreemptionHandler, RollbackBudgetExceeded, TrainGuard,
+    install_preemption_handler,
+)
 from paddle_tpu.io.fs import (
     FS, FSService, LocalFS, WireFS, fs_for_path, register_fs,
 )
@@ -34,4 +41,6 @@ __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
            "save_state_dict_encrypted", "load_state_dict_encrypted",
            "generate_key", "InferenceServer", "InferenceClient",
            "FS", "LocalFS", "WireFS", "FSService", "fs_for_path",
-           "register_fs"]
+           "register_fs", "latest_step", "verify_step",
+           "CheckpointIntegrityError", "TrainGuard", "PreemptionHandler",
+           "RollbackBudgetExceeded", "install_preemption_handler"]

@@ -28,6 +28,7 @@ from __future__ import annotations
 import time
 from typing import Any, Iterator
 
+from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.io import checkpoint as ckpt
 
 __all__ = ["TrainEpochRange", "train_epoch_range"]
@@ -58,21 +59,58 @@ class TrainEpochRange:
         self.save_interval_s = save_interval_s
         self.max_to_keep = max_to_keep
         self._last_save_t = time.monotonic()
+        self._stop_requested = False
+        self._last_saved_epoch: int | None = None
+        # cleared by io.guard.TrainGuard while the loss is bad: a
+        # diverged/NaN state must never overwrite a good checkpoint
+        self.healthy = True
 
-        latest = ckpt.latest_step(directory)
+        latest = ckpt.latest_step(directory)   # newest VERIFIABLE step
         if latest is None:
             self.start_epoch = 0
             self.state = state
         else:
-            # resume: epoch `latest` completed; restore its state
-            self.start_epoch = latest + 1
-            self.state = ckpt.load_checkpoint(state, directory, step=latest)
+            # resume: restore the newest step that actually verifies —
+            # a truncated/corrupt latest step rolls back to the previous
+            # good one instead of bricking the relaunch
+            self.state, used = ckpt.load_checkpoint(
+                state, directory, step=latest, return_step=True)
+            self.start_epoch = used + 1
+            self._last_saved_epoch = used
 
     @property
     def resumed(self) -> bool:
         return self.start_epoch > 0
 
+    @property
+    def stopped(self) -> bool:
+        """True once a graceful stop (preemption) was requested."""
+        return self._stop_requested
+
+    def request_stop(self) -> None:
+        """Ask the epoch loop to exit after the current epoch, saving a
+        final step first. Only sets a flag — safe to call from a signal
+        handler (see ``io.guard.PreemptionHandler``)."""
+        self._stop_requested = True
+
+    def rollback(self):
+        """Restore ``self.state`` from the newest verifiable checkpoint
+        (the loss-spike/divergence recovery path — see
+        ``io.guard.TrainGuard``). Returns the step restored, or None when
+        no checkpoint exists yet. Counted in ``ckpt/rollbacks``."""
+        step = ckpt.latest_step(self.directory)
+        if step is None:
+            return None
+        self.state, used = ckpt.load_checkpoint(
+            self.state, self.directory, step=step, return_step=True)
+        self._last_saved_epoch = used
+        stat_add("ckpt/rollbacks")
+        return used
+
     def _should_save(self, epoch: int) -> bool:
+        if not self.healthy:
+            stat_add("ckpt/saves_skipped_unhealthy")
+            return False
         if (epoch + 1) % self.save_interval == 0:
             return True
         if (self.save_interval_s is not None
@@ -85,6 +123,7 @@ class TrainEpochRange:
         ckpt.save_checkpoint(self.state, self.directory, step=epoch,
                              max_to_keep=self.max_to_keep)
         self._last_save_t = time.monotonic()
+        self._last_saved_epoch = epoch
 
     def flush(self) -> None:
         """Block until pending async saves are durable (call before a
@@ -94,6 +133,15 @@ class TrainEpochRange:
     def __iter__(self) -> Iterator[int]:
         for epoch in range(self.start_epoch, self.max_epoch_num):
             yield epoch
+            if self._stop_requested:
+                # preemption: persist THIS epoch (even off-interval),
+                # drain the async save, and exit the loop cleanly —
+                # the relaunch resumes from here
+                if self.healthy and self._last_saved_epoch != epoch:
+                    self.save(epoch)
+                self.flush()
+                stat_add("train/preempted_exits")
+                return
             if self._should_save(epoch):
                 self.save(epoch)
 

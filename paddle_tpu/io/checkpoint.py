@@ -16,18 +16,29 @@ Two tiers, mirroring the reference's two paths:
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
+from paddle_tpu.core import fault as _fault
+from paddle_tpu.core.flags import flag
 from paddle_tpu.core.module import Module, named_parameters, path_str
+from paddle_tpu.core.monitor import stat_add
 
 __all__ = ["state_dict", "set_state_dict", "save_state_dict",
            "load_state_dict", "save_checkpoint", "load_checkpoint",
-           "wait_until_finished", "reset_remote_cache"]
+           "wait_until_finished", "reset_remote_cache", "latest_step",
+           "verify_step", "CheckpointIntegrityError"]
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint step failed manifest verification (missing leaves,
+    checksum mismatch, or a missing manifest in a manifested directory)."""
 
 
 # ---------------------------------------------------------------------------
@@ -170,17 +181,155 @@ def _flatten_named(tree):
     return flat, treedef
 
 
+# ---------------------------------------------------------------------------
+# per-step integrity manifests (leaf names + checksums)
+# ---------------------------------------------------------------------------
+
+def _local_root(directory: str) -> str:
+    """The directory orbax actually writes (local staging dir for a
+    remote URL)."""
+    stage = _stage_for(directory)
+    return (stage.local_dir if stage is not None
+            else os.path.abspath(directory))
+
+
+def _manifest_path(root: str, step: int) -> str:
+    # sibling of the orbax step dir (never inside it — orbax owns that
+    # layout); RemoteCheckpointDir pushes/fetches it by the same name
+    return os.path.join(root, f"manifest-{step}.json")
+
+
+def _leaf_entry(v) -> dict:
+    """Checksum record for one leaf. Leaves that cannot be gathered to
+    host (non-addressable multi-host shards) record ``crc32: null`` and
+    are skipped at verify time — names/shapes still checked."""
+    try:
+        a = np.ascontiguousarray(np.asarray(v))
+    except Exception:
+        return {"crc32": None, "nbytes": None,
+                "dtype": str(getattr(v, "dtype", "?")),
+                "shape": list(getattr(v, "shape", ()))}
+    return {"crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF,
+            "nbytes": int(a.nbytes), "dtype": str(a.dtype),
+            "shape": list(a.shape)}
+
+
+def _write_manifest(root: str, step: int, flat: dict) -> None:
+    doc = {"step": int(step),
+           "leaves": {k: _leaf_entry(v) for k, v in flat.items()}}
+    path = _manifest_path(root, step)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)      # atomic: a torn manifest never exists
+
+
+def _read_manifest(root: str, step: int) -> dict | None:
+    path = _manifest_path(root, step)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None            # torn/corrupt manifest == unverifiable
+
+
+def _manifests_in_use(root: str, steps) -> bool:
+    return any(os.path.isfile(_manifest_path(root, s)) for s in steps)
+
+
+def _disk_steps(mgr) -> list[int]:
+    """Finalized steps as currently on disk (not the manager's in-memory
+    cache — integrity decisions must see external deletions/corruption
+    cleanup). ``reload()`` resets the cache where available (orbax >=
+    0.5), with the deprecated ``all_steps(read=True)`` as fallback."""
+    if hasattr(mgr, "reload"):
+        mgr.reload()
+        return sorted(int(s) for s in mgr.all_steps())
+    return sorted(int(s) for s in mgr.all_steps(read=True))
+
+
+def _verify_restored(root: str, step: int, restored: dict, steps) -> None:
+    """Deep verification of a restored step against its manifest.
+    Missing manifest is fatal only when OTHER steps in this directory
+    carry manifests (a pre-manifest directory loads as before)."""
+    man = _read_manifest(root, step)
+    if man is None:
+        if _manifests_in_use(root, steps):
+            raise CheckpointIntegrityError(
+                f"step {step} has no integrity manifest but this "
+                "directory uses them (save crashed before commit?)")
+        stat_add("ckpt/unverified_loads")
+        return
+    want = man.get("leaves", {})
+    if set(want) != set(restored):
+        missing = sorted(set(want) ^ set(restored))[:5]
+        raise CheckpointIntegrityError(
+            f"step {step} leaf set differs from manifest (e.g. {missing})")
+    for name, entry in want.items():
+        if entry.get("crc32") is None:
+            continue
+        got = _leaf_entry(restored[name])
+        if got["crc32"] != entry["crc32"]:
+            raise CheckpointIntegrityError(
+                f"step {step} leaf {name!r} checksum mismatch "
+                f"(manifest {entry['crc32']}, restored {got['crc32']})")
+    stat_add("ckpt/verified_loads")
+
+
+def verify_step(directory: str, step: int) -> bool:
+    """Light structural check: the step is finalized by orbax (remote:
+    marker-certified) and its manifest is present when this directory
+    uses manifests. Content checksums run at load time."""
+    stage = _stage_for(directory)
+    if stage is not None:
+        return step in stage.remote_steps()
+    mgr = _get_manager(directory)
+    steps = _disk_steps(mgr)
+    if step not in steps:
+        return False
+    root = os.path.abspath(directory)
+    if not flag("ckpt_manifest") or not _manifests_in_use(root, steps):
+        return True
+    return _read_manifest(root, step) is not None
+
+
 def save_checkpoint(tree, directory: str, step: int,
                     max_to_keep: int = 5) -> None:
     """Async sharded save of an arbitrary pytree at ``step``. A remote
     ``directory`` (``scheme://…``) stages locally; the completed step is
     uploaded synchronously (durability beats async there — the point of
-    a remote checkpoint is surviving the node)."""
+    a remote checkpoint is surviving the node).
+
+    With flag ``ckpt_manifest`` (default on) an integrity manifest (leaf
+    names + crc32 checksums, computed from the in-memory arrays) is
+    committed next to the step; resume falls back past steps whose
+    manifest is missing or whose restored bytes mismatch it."""
     import orbax.checkpoint as ocp
 
     flat, _ = _flatten_named(tree)
     mgr = _get_manager(directory, max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(flat))
+    stat_add("ckpt/saves")
+    # chaos hook sits between the data save and the manifest commit: an
+    # injected crash here yields exactly the dangerous state (orbax step
+    # present, unverifiable) that resume must roll past
+    _fault.inject("ckpt.save")
+    root = _local_root(directory)
+    if flag("ckpt_manifest"):
+        _write_manifest(root, step, flat)
+        # drop manifests of steps orbax's max_to_keep already pruned
+        try:
+            kept = {int(s) for s in mgr.all_steps()}
+            for name in os.listdir(root):
+                if (name.startswith("manifest-") and name.endswith(".json")
+                        and not name.endswith(".json.tmp")):
+                    s = name[len("manifest-"):-len(".json")]
+                    if s.isdigit() and int(s) not in kept:
+                        os.remove(os.path.join(root, name))
+        except OSError:
+            pass
     stage = _stage_for(directory)
     if stage is not None:
         mgr.wait_until_finished()
@@ -188,32 +337,69 @@ def save_checkpoint(tree, directory: str, step: int,
         stage.prune(max_to_keep)
 
 
-def load_checkpoint(tree, directory: str, step: int | None = None):
+def load_checkpoint(tree, directory: str, step: int | None = None, *,
+                    fallback: bool = True, return_step: bool = False):
     """Restore into the structure (and shardings) of ``tree``; returns the
-    restored pytree. ``step=None`` loads the latest (for a remote
-    directory: the latest *complete* remote step, pulled into the local
-    cache first — a fresh node resumes with an empty cache)."""
+    restored pytree (or ``(pytree, step)`` with ``return_step=True``).
+    ``step=None`` loads the latest (for a remote directory: the latest
+    *complete* remote step, pulled into the local cache first — a fresh
+    node resumes with an empty cache).
+
+    With ``fallback`` (default), a step that fails to restore or fails
+    manifest verification (truncated file, bit rot, save crashed before
+    the manifest commit) is rolled past: the newest earlier step that
+    restores AND verifies wins, counted in the ``ckpt/rollbacks`` and
+    ``ckpt/corrupt_steps`` stats. ``fallback=False`` restores exactly
+    ``step`` or raises."""
     import orbax.checkpoint as ocp
 
     stage = _stage_for(directory)
-    if stage is not None:
-        if step is None:
-            step = stage.pull_latest()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {directory}")
-        else:
-            # fetch() enforces the .complete marker + atomic cache fill
-            stage.fetch(step)
     mgr = _get_manager(directory)
+    root = _local_root(directory)
+    if stage is not None:
+        steps = stage.remote_steps()
+    else:
+        steps = _disk_steps(mgr)
     if step is None:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {directory}")
+        latest = latest_step(directory)
+        candidates = ([] if latest is None
+                      else [latest] + [s for s in reversed(steps)
+                                       if s < latest])
+    else:
+        candidates = [int(step)] + [s for s in reversed(steps)
+                                    if s < int(step)]
+    if not fallback:
+        candidates = candidates[:1]
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints in {directory}")
+
     flat, treedef = _flatten_named(tree)
-    abstract = {k: ocp.utils.to_shape_dtype_struct(v) for k, v in flat.items()}
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    return jax.tree_util.tree_unflatten(treedef,
-                                        [restored[k] for k in flat])
+    abstract = {k: ocp.utils.to_shape_dtype_struct(v)
+                for k, v in flat.items()}
+    errors: list[tuple[int, Exception]] = []
+    for use in candidates:
+        try:
+            if stage is not None:
+                # fetch() enforces the .complete marker + atomic cache fill
+                stage.fetch(use)
+            restored = mgr.restore(use,
+                                   args=ocp.args.StandardRestore(abstract))
+            if flag("ckpt_manifest"):
+                _verify_restored(root, use, restored, steps)
+        except Exception as e:   # corrupt/truncated/unverifiable step
+            stat_add("ckpt/corrupt_steps")
+            errors.append((use, e))
+            continue
+        if errors:               # we rolled past >= 1 broken step
+            stat_add("ckpt/rollbacks")
+        out = jax.tree_util.tree_unflatten(treedef,
+                                           [restored[k] for k in flat])
+        return (out, use) if return_step else out
+    detail = "; ".join(f"step {s}: {type(e).__name__}: {e}"
+                       for s, e in errors[:3])
+    raise CheckpointIntegrityError(
+        f"no loadable checkpoint in {directory} "
+        f"(tried {[s for s, _ in errors]}): {detail}") from errors[-1][1]
 
 
 def wait_until_finished(directory: str) -> None:
@@ -226,11 +412,30 @@ def wait_until_finished(directory: str) -> None:
 
 
 def latest_step(directory: str) -> int | None:
-    """Latest step (remote directories: the latest complete remote step
-    — consulted BEFORE the local cache, so a relaunched node with an
-    empty or stale cache still resumes correctly)."""
+    """Latest *verifiable* step. Remote directories: the latest complete
+    remote step (marker-certified, consulted BEFORE the local cache, so
+    a relaunched node with an empty or stale cache still resumes
+    correctly). Local directories: the newest orbax-finalized step whose
+    integrity manifest is present — a save that crashed between the data
+    write and the manifest commit is skipped (``ckpt/unverified_skipped``)
+    so resume lands on the previous good step. Directories written
+    before manifests existed (none present at all) keep the old
+    newest-step behavior."""
     stage = _stage_for(directory)
     if stage is not None:
         steps = stage.remote_steps()
         return steps[-1] if steps else None
-    return _get_manager(directory).latest_step()
+    mgr = _get_manager(directory)
+    steps = _disk_steps(mgr)
+    if not steps:
+        return None
+    root = os.path.abspath(directory)
+    if not flag("ckpt_manifest") or not _manifests_in_use(root, steps):
+        return steps[-1]
+    manifested = [s for s in steps
+                  if _read_manifest(root, s) is not None]
+    if not manifested:
+        return None
+    if manifested[-1] != steps[-1]:
+        stat_add("ckpt/unverified_skipped")
+    return manifested[-1]

@@ -25,6 +25,7 @@ import os
 import shutil
 from typing import Callable
 
+from paddle_tpu.core import fault as _fault
 from paddle_tpu.core.wire import FrameClient, FrameService
 
 __all__ = ["FS", "LocalFS", "WireFS", "FSService", "register_fs",
@@ -113,9 +114,11 @@ class LocalFS(FS):
             pass
 
     def upload(self, local_path, remote_path):
+        _fault.inject("fs.upload")
         self._copy(local_path, remote_path)
 
     def download(self, remote_path, local_path):
+        _fault.inject("fs.download")
         self._copy(remote_path, local_path)
 
     @staticmethod
@@ -218,8 +221,17 @@ class WireFS(FS):
 
     scheme = "ptfs"
 
-    def __init__(self, endpoint: str):
-        self._client = FrameClient(endpoint, _OPS, service="ptfs")
+    # safely replayable ops: reads, stats, and the naturally idempotent
+    # mutations. NOT mv (a retried rename can race its own success) and
+    # NOT appending writes (a replay would double-append) — those fail
+    # fast and the caller's marker protocol handles the partial state.
+    _IDEMPOTENT = ("ls", "stat", "read", "mkdirs", "delete", "touch")
+
+    def __init__(self, endpoint: str, *, timeout: float | None = None,
+                 retries: int | None = None):
+        self._client = FrameClient(endpoint, _OPS, service="ptfs",
+                                   timeout=timeout, retries=retries,
+                                   idempotent=self._IDEMPOTENT)
         self.endpoint = endpoint
 
     @staticmethod
@@ -270,6 +282,7 @@ class WireFS(FS):
         self._client._request("touch", {"path": self._rel(path)})
 
     def upload(self, local_path, remote_path):
+        _fault.inject("fs.upload")
         rel = self._rel(remote_path)
         if os.path.isdir(local_path):
             self.mkdirs(rel)
@@ -283,14 +296,18 @@ class WireFS(FS):
                 data = f.read(CHUNK_BYTES)
                 if not data and append:
                     break
+                # the first (truncating) write is replayable; appends are
+                # not — a retried append could double a chunk
                 self._client._request(
                     "write", {"path": rel, "nbytes": len(data),
-                              "append": append}, data)
+                              "append": append}, data,
+                    idempotent=not append)
                 append = True
                 if len(data) < CHUNK_BYTES:
                     break
 
     def download(self, remote_path, local_path):
+        _fault.inject("fs.download")
         rel = self._rel(remote_path)
         st = self._stat(rel)
         if st["is_dir"]:
@@ -424,6 +441,12 @@ class RemoteCheckpointDir:
     def _marker_local(self, step: int) -> str:
         return os.path.join(self.local_dir, f"{step}.complete")
 
+    # integrity manifest written by io.checkpoint next to the step dir
+    # (same naming convention as checkpoint._manifest_path)
+    @staticmethod
+    def _manifest_name(step: int) -> str:
+        return f"manifest-{step}.json"
+
     def _read_remote_marker(self, step: int) -> bytes | None:
         if not self.fs.is_exist(self._marker_remote(step)):
             return None
@@ -460,6 +483,10 @@ class RemoteCheckpointDir:
         tmp = local_step + ".tmp"
         shutil.rmtree(tmp, ignore_errors=True)
         self.fs.download(self._remote(step), tmp)
+        mf = self._manifest_name(step)
+        if self.fs.is_exist(self._remote(mf)):
+            self.fs.download(self._remote(mf),
+                             os.path.join(self.local_dir, mf))
         os.rename(tmp, local_step)
         with open(mk, "wb") as f:
             f.write(marker)
@@ -480,6 +507,9 @@ class RemoteCheckpointDir:
         self.fs.delete(self._marker_remote(step))
         self.fs.delete(self._remote(step))
         self.fs.upload(local_step, self._remote(step))
+        mf = os.path.join(self.local_dir, self._manifest_name(step))
+        if os.path.isfile(mf):   # integrity manifest rides with the step
+            self.fs.upload(mf, self._remote(self._manifest_name(step)))
         token = f"{uuid.uuid4().hex}\n".encode()
         tokenfile = os.path.join(self.local_dir, f"{step}.token")
         with open(tokenfile, "wb") as f:
@@ -494,3 +524,4 @@ class RemoteCheckpointDir:
         for old in steps[:-max_to_keep] if max_to_keep else []:
             self.fs.delete(self._marker_remote(old))
             self.fs.delete(self._remote(old))
+            self.fs.delete(self._remote(self._manifest_name(old)))

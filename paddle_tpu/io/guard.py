@@ -1,0 +1,176 @@
+"""Training guards: divergence sentinel with checkpoint rollback, and
+preemption (SIGTERM) handling for the epoch-range loop.
+
+Reference role: the run-side half of the elastic story. The reference's
+proc watcher restarts a dead pod and ``auto_checkpoint`` resumes it;
+these guards cover the failures that do NOT kill the process — a
+diverging run (NaN/Inf or spiking loss, the host-level escalation of the
+in-graph skip in ``optimizer/transform.apply_if_finite``) and a
+preemption notice (SIGTERM from the scheduler) that grants seconds, not
+a relaunch.
+
+``TrainGuard`` watches the per-step loss: non-finite or spiking steps
+count toward a consecutive-bad-step patience, after which the train
+state is rolled back to the last good checkpoint via
+``TrainEpochRange.rollback()`` — with a bounded rollback budget, so a
+permanently poisoned run fails loudly instead of thrashing forever.
+
+``PreemptionHandler`` maps SIGTERM onto ``TrainEpochRange.request_stop``:
+the loop finishes the current epoch, persists it (even off the save
+interval), drains the in-flight async save, and exits — the relaunched
+job resumes exactly there.
+
+Every event increments a ``core/monitor`` stat
+(``train/steps_skipped_nonfinite``, ``train/loss_spikes``,
+``train/guard_rollbacks``, ``train/preemptions``, ``ckpt/rollbacks``).
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import statistics
+
+from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.io.auto_checkpoint import TrainEpochRange
+
+__all__ = ["TrainGuard", "RollbackBudgetExceeded", "PreemptionHandler",
+           "install_preemption_handler"]
+
+
+class RollbackBudgetExceeded(RuntimeError):
+    """The guard rolled back ``max_rollbacks`` times and the run is still
+    diverging — recovery by rollback is not working; crash loudly."""
+
+
+class TrainGuard:
+    """Loss-spike / non-finite sentinel around a :class:`TrainEpochRange`.
+
+    Usage::
+
+        guard = io.TrainGuard(r, patience=3, max_rollbacks=2,
+                              spike_factor=10.0)
+        for epoch in r:
+            state, metrics = step(state, batch, key)
+            state = guard.observe(state, metrics["loss"])
+            r.state = state
+
+    A *bad* step is a non-finite loss, or — when ``spike_factor`` is set
+    — a loss above ``spike_factor`` x the rolling median of recent good
+    losses. After ``patience`` consecutive bad steps the guard restores
+    the last good checkpoint (``TrainEpochRange.rollback``) and returns
+    the restored state; beyond ``max_rollbacks`` total rollbacks it
+    raises :class:`RollbackBudgetExceeded`.
+    """
+
+    def __init__(self, epoch_range: TrainEpochRange, *, patience: int = 3,
+                 max_rollbacks: int = 2, spike_factor: float | None = None,
+                 window: int = 32):
+        self.epoch_range = epoch_range
+        self.patience = max(int(patience), 1)
+        self.max_rollbacks = int(max_rollbacks)
+        self.spike_factor = spike_factor
+        self.window = max(int(window), 4)
+        self._good: list[float] = []
+        self._streak = 0
+        self.rollbacks = 0
+
+    def _is_spike(self, loss: float) -> bool:
+        if self.spike_factor is None or len(self._good) < 4:
+            return False
+        ref = statistics.median(self._good)
+        return loss > self.spike_factor * max(abs(ref), 1e-12)
+
+    def healthy(self, loss) -> bool:
+        loss = float(loss)
+        return math.isfinite(loss) and not self._is_spike(loss)
+
+    def observe(self, state, loss):
+        """Record one step's loss; returns the state training should
+        continue from (``state`` when healthy, the rolled-back
+        checkpoint state after ``patience`` consecutive bad steps)."""
+        loss = float(loss)
+        if math.isfinite(loss) and not self._is_spike(loss):
+            self._streak = 0
+            self.epoch_range.healthy = True
+            self._good.append(loss)
+            if len(self._good) > self.window:
+                self._good.pop(0)
+            return state
+        # bad step: block epoch-end saves until health returns — the
+        # poisoned state must not overwrite a good checkpoint
+        self.epoch_range.healthy = False
+        if not math.isfinite(loss):
+            stat_add("train/steps_skipped_nonfinite")
+        else:
+            stat_add("train/loss_spikes")
+        self._streak += 1
+        if self._streak < self.patience:
+            return state
+        # patience exhausted: roll back to the last good checkpoint
+        if self.rollbacks >= self.max_rollbacks:
+            raise RollbackBudgetExceeded(
+                f"run still diverging after {self.rollbacks} rollbacks "
+                f"(patience={self.patience}); refusing to thrash")
+        step = self.epoch_range.rollback()   # counts ckpt/rollbacks
+        self.rollbacks += 1
+        self._streak = 0
+        self.epoch_range.healthy = True      # restored state is good
+        stat_add("train/guard_rollbacks")
+        if step is None:
+            # nothing ever checkpointed: keep the incoming state; the
+            # budget still bounds how often we end up here
+            return state
+        return self.epoch_range.state
+
+
+class PreemptionHandler:
+    """Route preemption signals (default SIGTERM) to
+    ``TrainEpochRange.request_stop`` for a save-and-exit shutdown.
+
+    Context manager; restores the previous handlers on exit. Installing
+    a handler is only possible on the main thread — elsewhere this
+    degrades to a no-op with ``installed == False`` (the loop can still
+    be stopped by calling ``request_stop`` directly).
+    """
+
+    def __init__(self, epoch_range: TrainEpochRange,
+                 signals=(signal.SIGTERM,)):
+        self.epoch_range = epoch_range
+        self.signals = tuple(signals)
+        self.installed = False
+        self.preempted = False
+        self._prev: dict = {}
+
+    def _handle(self, signum, frame) -> None:
+        self.preempted = True
+        stat_add("train/preemptions")
+        self.epoch_range.request_stop()
+
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handle)
+                self.installed = True
+            except ValueError:      # not the main thread
+                break
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+        return False
+
+
+def install_preemption_handler(epoch_range: TrainEpochRange,
+                               signals=(signal.SIGTERM,)) -> PreemptionHandler:
+    """Install-and-forget form of :class:`PreemptionHandler` (no context
+    manager); returns the handler (use it as ``__exit__``-less — or call
+    ``.__exit__()`` to restore the previous signal handlers)."""
+    handler = PreemptionHandler(epoch_range, signals)
+    handler.__enter__()
+    return handler

@@ -137,10 +137,18 @@ class InferenceServer(FrameService):
 
 
 class InferenceClient(FrameClient):
-    """Client for :class:`InferenceServer`."""
+    """Client for :class:`InferenceServer`.
 
-    def __init__(self, endpoint: str):
-        super().__init__(endpoint, SERVING_OPS, service="serving")
+    ``infer``/``list_models``/``load_model`` are idempotent and retried
+    across reconnects (flags ``wire_retries``/``wire_timeout_s``), so a
+    client survives a server restart; ``stop`` fails fast.
+    """
+
+    def __init__(self, endpoint: str, *, timeout: float | None = None,
+                 retries: int | None = None):
+        super().__init__(endpoint, SERVING_OPS, service="serving",
+                         timeout=timeout, retries=retries,
+                         idempotent=("infer", "list_models", "load_model"))
 
     def infer(self, model: str, *inputs) -> list[np.ndarray]:
         specs, payload = _pack_arrays(inputs)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Chaos harness: drive the fault-tolerance layer end to end with
+injection enabled and assert the recovery stats.
+
+Scenarios (all CPU-only, single process):
+
+1. **serving-wire**: an InferenceClient keeps answering through injected
+   ``wire.send`` faults (retry/reconnect) AND through a real
+   kill-and-restart of the server on the same port.
+2. **checkpoint**: a corrupted latest step (bit-flip + truncation) rolls
+   back to the newest verifiable step on load.
+3. **elastic-resume**: a TrainEpochRange run crashed by an injected
+   ``ckpt.save`` fault resumes from the previous verifiable step.
+
+Also asserts the production posture: every fault/retry flag defaults to
+hard-off/zero-cost.
+
+Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py``. Exits nonzero
+(with a JSON report on stdout) if any recovery path or stat fails.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np       # noqa: E402
+
+import paddle_tpu                              # noqa: E402
+from paddle_tpu import io, nn                  # noqa: E402
+from paddle_tpu.core import fault, monitor     # noqa: E402
+from paddle_tpu.core.flags import get_flags    # noqa: E402
+
+CHECKS: list[tuple[str, bool, str]] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    CHECKS.append((name, bool(ok), detail))
+
+
+def check_defaults_off() -> None:
+    f = get_flags(["fault_inject", "fault_seed", "wire_retries",
+                   "wire_timeout_s", "ckpt_manifest"])
+    check("defaults/injection_off", f["fault_inject"] == ""
+          and not fault.enabled(), str(f))
+    check("defaults/deadline_finite", f["wire_timeout_s"] > 0, str(f))
+
+
+def scenario_serving_wire(tmp: str) -> None:
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = os.path.join(tmp, "mlp")
+    io.save_inference_model(path, net, [np.zeros((2, 4), np.float32)])
+
+    srv = io.InferenceServer({"m": path}).start()
+    port = srv.port
+    client = io.InferenceClient(srv.endpoint, timeout=10.0)
+    x = np.ones((2, 4), np.float32)
+    monitor.reset_stats("wire/")
+    monitor.reset_stats("fault/")
+
+    # injected send faults ride the retry path transparently
+    with fault.inject_faults({"wire.send": (1.0, 2)}, seed=7):
+        (y1,) = client.infer("m", x)
+    check("wire/injected_faults_fired",
+          monitor.get_stat("fault/injected/wire.send") == 2)
+    check("wire/retries_recovered", monitor.get_stat("wire/retries") >= 2)
+
+    # real kill + restart on the same port
+    srv.stop()
+    srv2 = io.InferenceServer({"m": path}, port=port).start()
+    (y2,) = client.infer("m", x)
+    check("wire/survives_restart", np.allclose(y1, y2))
+    check("wire/reconnects", monitor.get_stat("wire/reconnects") >= 1)
+    client.stop_server()
+    client.close()
+    srv2.stop()
+
+
+def _tpl(v=0.0, step=0):
+    return {"w": jnp.full((8, 8), float(v)), "step": jnp.asarray(int(step))}
+
+
+def scenario_checkpoint(tmp: str) -> None:
+    d = os.path.join(tmp, "ck")
+    for s in (1, 2, 3):
+        io.save_checkpoint(_tpl(s, s), d, step=s)
+    io.checkpoint.wait_until_finished(d)
+    # corrupt the latest step: flip + truncate every substantial file
+    for root, _, files in os.walk(os.path.join(d, "3")):
+        for name in files:
+            p = os.path.join(root, name)
+            size = os.path.getsize(p)
+            if size < 8:
+                continue
+            with open(p, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+                f.truncate(max(size // 2, 8))
+    monitor.reset_stats("ckpt/")
+    restored, used = io.load_checkpoint(_tpl(), d, return_step=True)
+    check("ckpt/fell_back_to_good_step",
+          used == 2 and float(restored["w"][0, 0]) == 2.0)
+    check("ckpt/rollbacks_stat", monitor.get_stat("ckpt/rollbacks") >= 1)
+    check("ckpt/corrupt_steps_stat",
+          monitor.get_stat("ckpt/corrupt_steps") >= 1)
+
+
+def scenario_elastic_resume(tmp: str) -> None:
+    d = os.path.join(tmp, "run")
+    monitor.reset_stats("fault/")
+    r = io.TrainEpochRange(6, d, state=_tpl(-1, -1))
+    crashed = False
+    try:
+        for epoch in r:
+            r.state = _tpl(epoch, epoch)
+            if epoch == 2:
+                fault.configure({"ckpt.save": 1.0}, seed=0)
+    except fault.InjectedFault:
+        crashed = True
+    finally:
+        fault.reset()
+    io.checkpoint.wait_until_finished(d)
+    r2 = io.TrainEpochRange(6, d, state=_tpl())
+    check("resume/crashed_as_injected", crashed
+          and monitor.get_stat("fault/injected/ckpt.save") == 1)
+    check("resume/rolled_to_verifiable",
+          r2.resumed and r2.start_epoch == 2
+          and int(r2.state["step"]) == 1)
+
+
+def main() -> int:
+    check_defaults_off()
+    with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
+        os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
+        scenario_serving_wire(tmp)
+        scenario_checkpoint(tmp)
+        scenario_elastic_resume(tmp)
+    ok = all(c[1] for c in CHECKS)
+    print(json.dumps({
+        "ok": ok,
+        "checks": {name: passed for name, passed, _ in CHECKS},
+        "failures": [{"check": n, "detail": d}
+                     for n, p, d in CHECKS if not p],
+        "stats": {k: v for k, v in monitor.export_stats().items()
+                  if k.split("/")[0] in ("wire", "ckpt", "fault", "train")},
+    }, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
