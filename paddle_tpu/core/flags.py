@@ -111,6 +111,28 @@ define_flag("wire_backoff_s", 0.05,
             "+/-50% jitter)")
 define_flag("wire_backoff_max_s", 2.0,
             "Cap on a single retry backoff sleep")
+# --- server-side overload protection (core/wire.py FrameService) ---
+define_flag("wire_max_inflight", 0,
+            "Cap on concurrent in-flight requests per FrameService; excess "
+            "requests are shed fast with the retryable status code 2 "
+            "(header carries retry_after_s) instead of queueing "
+            "unboundedly. 0 = unlimited")
+define_flag("wire_max_conns", 0,
+            "Cap on accepted connections per FrameService; an over-cap "
+            "connection gets one shed frame (code 2, closing) in reply to "
+            "its first request and is closed. 0 = unlimited")
+define_flag("wire_server_idle_s", 0.0,
+            "Per-connection server idle timeout: a client silent this long "
+            "is reaped (wire/idle_closed stat) instead of pinning a "
+            "handler thread forever. 0 = off")
+define_flag("wire_drain_s", 5.0,
+            "Graceful-drain deadline used by the wire 'stop' ops and "
+            "io.PreemptionHandler: stop accepting, let in-flight requests "
+            "finish for this many seconds, then sever")
+define_flag("ps_barrier_timeout_s", 120.0,
+            "Server-side wait bound for the PS generation barrier; the "
+            "client's barrier request deadline tracks it +10s. "
+            "<= 0 waits forever")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

@@ -44,9 +44,12 @@ class StatRegistry:
         with self._lock:
             return self._stats.get(name, default)
 
-    def export(self) -> dict[str, float]:
+    def export(self, prefix: str | None = None) -> dict[str, float]:
         with self._lock:
-            return dict(self._stats)
+            if prefix is None:
+                return dict(self._stats)
+            return {k: v for k, v in self._stats.items()
+                    if k.startswith(prefix)}
 
     def reset(self, prefix: str | None = None) -> None:
         with self._lock:
@@ -73,8 +76,8 @@ def get_stat(name: str, default: float = 0) -> float:
     return stats.get(name, default)
 
 
-def export_stats() -> dict[str, float]:
-    return stats.export()
+def export_stats(prefix: str | None = None) -> dict[str, float]:
+    return stats.export(prefix)
 
 
 def reset_stats(prefix: str | None = None) -> None:

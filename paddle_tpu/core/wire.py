@@ -27,10 +27,21 @@ from typing import Any, Iterable
 
 from paddle_tpu.core import fault as _fault
 from paddle_tpu.core.flags import flag
-from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.core.monitor import export_stats, stat_add
 
 __all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
-           "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES"]
+           "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES", "CODE_SHED",
+           "HEALTH_OP"]
+
+# Response status codes. 0 = ok, 1 = error (request ran or was malformed).
+# CODE_SHED rejections happen BEFORE execution (admission control, drain,
+# connection cap), so clients may retry them for ANY op — including
+# non-idempotent ones — honoring the header's ``retry_after_s`` hint.
+CODE_SHED = 2
+
+# Op number reserved by FrameService for the universal health probe;
+# subclass op tables start at 1, so 0 never reaches ``_dispatch``.
+HEALTH_OP = 0
 
 # Hard caps on request frames arriving at a server. Header/payload lengths
 # come from the (untrusted) peer; without a bound a single corrupt frame
@@ -92,6 +103,23 @@ class FrameService:
     ``_dispatch``; ``start``/``stop`` manage the accept loop — shared so
     lifecycle fixes (e.g. shutdown() hanging when the loop never ran)
     exist in exactly one place.
+
+    Overload protection (the reference's BRPC ``max_concurrency`` /
+    heartbeat role, shared by every service built on this class):
+
+    - **Admission control** — ``FLAGS_wire_max_inflight`` caps concurrent
+      in-flight requests and ``FLAGS_wire_max_conns`` caps accepted
+      connections; excess work is shed fast with :data:`CODE_SHED`
+      (``{"error": ..., "retry_after_s": t}``) instead of queueing
+      unboundedly behind a slow model.
+    - **Universal health op** — op :data:`HEALTH_OP` is answered by this
+      class itself (never ``_dispatch``) with liveness, in-flight/conn
+      depth, uptime, and a monitor-stats snapshot, and is never shed, so
+      load balancers can probe any service uniformly even under overload.
+    - **Graceful drain** — :meth:`drain` stops accepting, sheds new
+      requests, lets in-flight ones finish up to a deadline, then severs.
+    - **Idle reap** — ``FLAGS_wire_server_idle_s`` bounds how long a
+      silent connection may pin a handler thread (``wire/idle_closed``).
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
@@ -99,19 +127,63 @@ class FrameService:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
                 with outer._conns_lock:
-                    outer._conns.add(self.request)
+                    late = outer._stopping
+                    if not late:
+                        outer._conns.add(sock)
+                        n_conns = len(outer._conns)
+                if late:
+                    # accepted while stop() was severing: the sweep has
+                    # already read _conns, so never serve this socket
+                    # (BaseServer closes it after handle() returns)
+                    return
                 try:
+                    max_conns = int(flag("wire_max_conns"))
+                    if max_conns > 0 and n_conns > max_conns:
+                        # over the connection cap: answer the first
+                        # request with a shed frame (so the client backs
+                        # off instead of seeing an opaque reset), close
+                        stat_add("wire/shed_conns")
+                        sock.settimeout(5.0)
+                        try:
+                            recv_frame(sock)
+                            outer._shed_frame(sock, "connection limit "
+                                              "reached", closing=True)
+                        except (ConnectionError, OSError):
+                            pass
+                        return
+                    idle = float(flag("wire_server_idle_s"))
+                    if idle > 0:
+                        sock.settimeout(idle)
                     while True:
-                        op, header, payload = recv_frame(self.request)
-                        if not outer._dispatch(self.request, op, header,
-                                               payload):
+                        try:
+                            op, header, payload = recv_frame(sock)
+                        except TimeoutError:
+                            stat_add("wire/idle_closed")
+                            return
+                        if op == HEALTH_OP:
+                            # served here, never by subclasses — and
+                            # never shed: probes must answer under load
+                            send_frame(sock, 0, outer.health())
+                            continue
+                        admitted, reason = outer._try_admit()
+                        if not admitted:
+                            stat_add("wire/shed_server")
+                            outer._shed_frame(sock, reason)
+                            continue
+                        try:
+                            keep = outer._dispatch(sock, op, header,
+                                                   payload)
+                        finally:
+                            outer._release()
+                        if not keep:
                             return
                 except (ConnectionError, OSError):
                     return
                 finally:
                     with outer._conns_lock:
-                        outer._conns.discard(self.request)
+                        outer._conns.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -119,6 +191,12 @@ class FrameService:
 
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        self._load_cv = threading.Condition()
+        self._inflight = 0
+        self._draining = False
+        self._stopping = False
+        self._started: float | None = None
+        self._lifecycle_lock = threading.Lock()
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: threading.Thread | None = None
@@ -131,17 +209,103 @@ class FrameService:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._started = time.monotonic()
         return self
 
-    def stop(self) -> None:
-        if self._thread is not None:  # shutdown() hangs unless serving
-            self._server.shutdown()
-            self._thread = None
-        self._server.server_close()
+    # -- admission control -------------------------------------------------
+    def _try_admit(self) -> tuple[bool, str | None]:
+        """Atomic admit-or-shed decision: check and increment under one
+        lock, so the in-flight count can never overshoot the cap."""
+        with self._load_cv:
+            if self._draining or self._stopping:
+                return False, "draining"
+            cap = int(flag("wire_max_inflight"))
+            if cap > 0 and self._inflight >= cap:
+                return False, "overloaded"
+            self._inflight += 1
+            return True, None
+
+    def _release(self) -> None:
+        with self._load_cv:
+            self._inflight -= 1
+            self._load_cv.notify_all()
+
+    def _shed_frame(self, sock, reason: str, *, closing: bool = False):
+        """Fast rejection: the request was NOT executed; the client may
+        retry any op after ``retry_after_s``."""
+        retry_after = float(flag("wire_backoff_s"))
+        if reason == "draining":
+            retry_after = max(retry_after, 0.5)   # we are going away
+        header: dict[str, Any] = {
+            "error": f"{type(self).__name__} {reason}",
+            "retry_after_s": retry_after}
+        if closing:
+            header["closing"] = True
+        send_frame(sock, CODE_SHED, header)
+
+    # -- health ------------------------------------------------------------
+    def health(self) -> dict:
+        """Uniform liveness/load snapshot, also served to any client as
+        op :data:`HEALTH_OP` (``FrameClient.health()``)."""
+        with self._load_cv:
+            inflight = self._inflight
+            draining = self._draining or self._stopping
+        with self._conns_lock:
+            conns = len(self._conns)
+        return {
+            "status": "draining" if draining else "ok",
+            "service": type(self).__name__,
+            "endpoint": self.endpoint,
+            "inflight": inflight,
+            "conns": conns,
+            "max_inflight": int(flag("wire_max_inflight")),
+            "max_conns": int(flag("wire_max_conns")),
+            "uptime_s": (time.monotonic() - self._started
+                         if self._started is not None else 0.0),
+            "stats": export_stats(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def _stop_accepting(self) -> None:
+        with self._lifecycle_lock:
+            if self._thread is not None:  # shutdown() hangs unless serving
+                self._server.shutdown()
+                self._thread = None
+            self._server.server_close()
+
+    def drain(self, deadline: float | None = None) -> bool:
+        """Graceful shutdown: stop accepting new connections, shed new
+        requests (:data:`CODE_SHED` ``draining``), wait up to ``deadline``
+        seconds for in-flight requests to finish, then sever whatever is
+        left. Returns True when everything in flight completed."""
+        with self._load_cv:
+            self._draining = True
+        self._stop_accepting()
+        stat_add("wire/drains")
+        with self._load_cv:
+            clean = self._load_cv.wait_for(lambda: self._inflight == 0,
+                                           timeout=deadline)
+        if not clean:
+            stat_add("wire/drain_severed")
+        self.stop()
+        return clean
+
+    def stop(self, drain_s: float | None = None) -> None:
+        """Stop the service. With ``drain_s`` (seconds) the shutdown is
+        graceful — in-flight requests get that long to finish (see
+        :meth:`drain`); without it, connections are severed immediately."""
+        if drain_s is not None and drain_s > 0:
+            self.drain(drain_s)   # ends with a hard stop() of its own
+            return
+        self._stop_accepting()
         # sever established connections too — a stopped service must look
         # like a dead process to its clients (EOF/RST now), not leave
-        # handler threads silently serving stale sockets forever
+        # handler threads silently serving stale sockets forever.
+        # _stopping is flipped under the conns lock BEFORE the sweep so a
+        # connection accepted during it closes itself instead of being
+        # added after the sweep already read the set.
         with self._conns_lock:
+            self._stopping = True
             conns, self._conns = list(self._conns), set()
         for sock in conns:
             try:
@@ -170,6 +334,12 @@ class FrameClient:
     mid-stream. Non-idempotent ops (grad pushes, appends, barriers) fail
     fast after closing the broken socket. Retries/reconnects/timeouts
     increment ``wire/*`` stats in ``core/monitor``.
+
+    Overload cooperation: a :data:`CODE_SHED` response means the server
+    rejected the request *before executing it* (admission control or
+    drain), so it is retried with backoff — honoring the server's
+    ``retry_after_s`` hint and counting ``wire/shed`` — for every op,
+    idempotent or not.
     """
 
     def __init__(self, endpoint: str, ops: dict[str, int],
@@ -230,6 +400,12 @@ class FrameClient:
                 or getattr(e, "errno", None) in (errno.EAGAIN,
                                                  errno.EWOULDBLOCK))
 
+    def health(self) -> dict:
+        """Probe the server's universal health op (:data:`HEALTH_OP`,
+        served by ``FrameService`` itself for every service): liveness,
+        in-flight/connection depth, drain status, uptime, stats."""
+        return self._request("health", {}, idempotent=True)[0]
+
     def _request(self, op: str, header: dict, payload: bytes = b"",
                  idempotent: bool | None = None,
                  timeout: float | None = None):
@@ -238,12 +414,24 @@ class FrameClient:
         barrier); ``idempotent`` overrides the constructor's op set."""
         if idempotent is None:
             idempotent = op in self._idempotent
-        attempts = (self._retries if idempotent else 0) + 1
+        try:
+            opnum = self._ops[op]
+        except KeyError:
+            if op != "health":
+                raise
+            opnum = HEALTH_OP   # universal probe, outside every op table
+        # Two independent retry budgets (both sized by wire_retries):
+        # connection failures/timeouts are retried only for idempotent
+        # ops, but CODE_SHED rejections were never executed server-side,
+        # so they are retryable-with-backoff for EVERY op.
+        conn_budget = (self._retries if idempotent else 0) + 1
+        shed_budget = self._retries + 1
+        conn_fails = sheds = 0
         with self._lock:
             if self._closed:
                 raise ConnectionError(
                     f"{self._service} client for {self.endpoint} is closed")
-            for attempt in range(attempts):
+            while True:
                 try:
                     if self._sock is None:
                         self._connect()
@@ -253,7 +441,7 @@ class FrameClient:
                             timeout if timeout > 0 else None)
                     if _fault._ACTIVE is not None:
                         _fault.inject("wire.send")
-                    send_frame(self._sock, self._ops[op], header, payload)
+                    send_frame(self._sock, opnum, header, payload)
                     # replies come from the server this client chose to
                     # connect to — no size cap (a large pull/infer reply
                     # is legitimate)
@@ -267,18 +455,35 @@ class FrameClient:
                         self._sock.settimeout(
                             None if self._kernel_deadline
                             else self._deadline)
-                    break
                 except (ConnectionError, TimeoutError, OSError) as e:
                     if self._is_timeout(e):
                         stat_add("wire/timeouts")
                     self._close_locked()
-                    if attempt + 1 >= attempts:
+                    conn_fails += 1
+                    if conn_fails >= conn_budget:
                         raise ConnectionError(
                             f"{self._service} {op} to {self.endpoint} "
-                            f"failed after {attempt + 1} attempt(s): "
+                            f"failed after {conn_fails} attempt(s): "
                             f"{type(e).__name__}: {e}") from e
                     stat_add("wire/retries")
-                    time.sleep(self._backoff(attempt))
+                    time.sleep(self._backoff(conn_fails - 1))
+                    continue
+                if code == CODE_SHED:
+                    # admission control turned the request away before it
+                    # ran: back off (honoring the server's hint) and retry
+                    stat_add("wire/shed")
+                    if rheader.get("closing"):
+                        self._close_locked()   # server is hanging up
+                    sheds += 1
+                    if sheds >= shed_budget:
+                        raise RuntimeError(
+                            f"{self._service} {op} shed by {self.endpoint} "
+                            f"after {sheds} attempt(s): "
+                            f"{rheader.get('error')}")
+                    time.sleep(max(float(rheader.get("retry_after_s", 0.0)),
+                                   self._backoff(sheds - 1)))
+                    continue
+                break
         if code != 0:
             raise RuntimeError(
                 f"{self._service} {op} failed: {rheader.get('error')}")

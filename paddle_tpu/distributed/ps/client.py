@@ -15,6 +15,7 @@ import threading
 
 import numpy as np
 
+from paddle_tpu.core.flags import flag
 from paddle_tpu.core.wire import FrameClient
 from paddle_tpu.distributed.ps.server import OPS
 from paddle_tpu.native import NativeSparseTable
@@ -64,6 +65,11 @@ class InProcClient:
     def lost_workers(self) -> list[int]:
         return []
 
+    def health(self, server: int = 0) -> dict:
+        """Interface parity with PSClient; in-process is always alive."""
+        return {"status": "ok", "service": "InProcClient", "inflight": 0,
+                "conns": 0}
+
     def close(self):
         pass
 
@@ -92,8 +98,8 @@ class PSClient:
 
     ``timeout`` (default: flag ``wire_timeout_s``) bounds connect and
     every request round-trip. NOTE: barrier blocks server-side up to
-    120s, so pass a larger timeout (or <= 0 for none) when using
-    barriers with small deadlines.
+    ``FLAGS_ps_barrier_timeout_s`` (default 120s); its request carries
+    its own deadline tracking that flag, not the generic timeout.
     """
 
     def __init__(self, endpoints: list[str] | str,
@@ -200,11 +206,13 @@ class PSClient:
 
     def barrier(self, world: int):
         """Block until ``world`` workers reach this point (role-maker
-        barrier, served by server 0). The server waits up to 120s, so
-        this request gets its own deadline just past that instead of the
-        generic ``wire_timeout_s``."""
+        barrier, served by server 0). The server waits up to
+        ``FLAGS_ps_barrier_timeout_s``, so this request gets its own
+        deadline just past that instead of the generic
+        ``wire_timeout_s`` (a non-positive flag waits forever)."""
+        t = float(flag("ps_barrier_timeout_s"))
         self._conns[0].request("barrier", {"world": int(world)},
-                               timeout=130.0)
+                               timeout=t + 10.0 if t > 0 else 0.0)
 
     def heartbeat(self, worker_id: int, status: str = "running"):
         """Report liveness to the chief (server 0) heartbeat monitor —
@@ -216,6 +224,11 @@ class PSClient:
         """Workers the chief's monitor has flagged as stale."""
         h, _ = self._heartbeat_conn().request("lost", {})
         return list(h.get("lost", []))
+
+    def health(self, server: int = 0) -> dict:
+        """Probe one parameter server's universal health op (liveness,
+        in-flight depth, drain status) — never shed, works under load."""
+        return self._conns[server].health()
 
     def stop_servers(self):
         for c in self._conns:

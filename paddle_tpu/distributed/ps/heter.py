@@ -24,6 +24,7 @@ from typing import Callable
 
 import numpy as np
 
+from paddle_tpu.core.flags import flag
 from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
 
 __all__ = ["HeterWorker", "HeterClient"]
@@ -68,7 +69,12 @@ class HeterWorker(FrameService):
         try:
             if name == "stop":
                 send_frame(sock, 0, {})
-                threading.Thread(target=self.stop, daemon=True).start()
+                # graceful: an in-flight forward_backward gets
+                # wire_drain_s to finish before the socket is severed
+                threading.Thread(
+                    target=self.stop,
+                    kwargs={"drain_s": float(flag("wire_drain_s"))},
+                    daemon=True).start()
                 return False
             if name == "info":
                 import jax

@@ -19,6 +19,8 @@ import threading
 
 import numpy as np
 
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.native import NativeSparseTable
 
 __all__ = ["ParameterServer", "HeartBeatMonitor", "OPS"]
@@ -58,6 +60,7 @@ class _TableRegistry:
             return self._tables[name]
 
     def barrier(self, world: int) -> None:
+        timeout = float(flag("ps_barrier_timeout_s"))
         with self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
@@ -67,15 +70,18 @@ class _TableRegistry:
                 self._barrier_cv.notify_all()
             else:
                 ok = self._barrier_cv.wait_for(
-                    lambda: self._barrier_gen != gen, timeout=120)
+                    lambda: self._barrier_gen != gen,
+                    timeout=timeout if timeout > 0 else None)
                 if not ok:
                     # Undo our arrival so later barriers aren't skewed by
                     # the phantom count, then surface the hang to the
                     # caller (it is returned to the client as an error
                     # frame by _dispatch).
                     self._barrier_count = max(0, self._barrier_count - 1)
+                    stat_add("ps/barrier_timeouts")
                     raise TimeoutError(
-                        "barrier timed out after 120s: a worker is hung "
+                        f"barrier timed out after {timeout:g}s "
+                        "(FLAGS_ps_barrier_timeout_s): a worker is hung "
                         "or the configured world size is wrong")
 
 
@@ -192,9 +198,9 @@ class ParameterServer(FrameService):
         self.monitor.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float | None = None) -> None:
         self.monitor.stop()
-        super().stop()
+        super().stop(drain_s)
 
     # -- request dispatch --------------------------------------------------
     def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
@@ -202,7 +208,12 @@ class ParameterServer(FrameService):
         try:
             if name == "stop":
                 send_frame(sock, 0, {})
-                threading.Thread(target=self.stop, daemon=True).start()
+                # graceful: in-flight pulls/pushes get wire_drain_s to
+                # finish before their sockets are severed
+                threading.Thread(
+                    target=self.stop,
+                    kwargs={"drain_s": float(flag("wire_drain_s"))},
+                    daemon=True).start()
                 return False
             if name == "create":
                 self.registry.create(header["name"], dim=header["dim"],

@@ -333,6 +333,10 @@ class WireFS(FS):
     def need_upload_download(self):
         return True
 
+    def health(self) -> dict:
+        """Probe the FSService's universal health op (core/wire.py)."""
+        return self._client.health()
+
     def close(self):
         self._client.close()
 

@@ -30,7 +30,9 @@ from __future__ import annotations
 import math
 import signal
 import statistics
+import threading
 
+from paddle_tpu.core.flags import flag
 from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.io.auto_checkpoint import TrainEpochRange
 
@@ -125,8 +127,13 @@ class TrainGuard:
 
 
 class PreemptionHandler:
-    """Route preemption signals (default SIGTERM) to
-    ``TrainEpochRange.request_stop`` for a save-and-exit shutdown.
+    """Route preemption signals (default SIGTERM) to a save-and-exit
+    shutdown: ``TrainEpochRange.request_stop`` for the training loop,
+    and a graceful drain (``FrameService.stop(drain_s=...)``) for any
+    wire services this process hosts — in-flight requests finish up to
+    ``drain_s`` (default ``FLAGS_wire_drain_s``) before the sockets are
+    severed, so SIGTERM on a serving/PS node never drops a request
+    mid-execution.
 
     Context manager; restores the previous handlers on exit. Installing
     a handler is only possible on the main thread — elsewhere this
@@ -134,18 +141,30 @@ class PreemptionHandler:
     be stopped by calling ``request_stop`` directly).
     """
 
-    def __init__(self, epoch_range: TrainEpochRange,
-                 signals=(signal.SIGTERM,)):
+    def __init__(self, epoch_range: TrainEpochRange | None = None,
+                 signals=(signal.SIGTERM,), *, services=(),
+                 drain_s: float | None = None):
         self.epoch_range = epoch_range
+        self.services = tuple(services)
         self.signals = tuple(signals)
         self.installed = False
         self.preempted = False
+        self._drain_s = drain_s
         self._prev: dict = {}
 
     def _handle(self, signum, frame) -> None:
         self.preempted = True
         stat_add("train/preemptions")
-        self.epoch_range.request_stop()
+        if self.epoch_range is not None:
+            self.epoch_range.request_stop()
+        drain_s = (float(flag("wire_drain_s")) if self._drain_s is None
+                   else self._drain_s)
+        for svc in self.services:
+            # drain blocks up to the deadline; a signal handler must
+            # return fast, so each service drains on its own thread
+            threading.Thread(target=svc.stop,
+                             kwargs={"drain_s": drain_s},
+                             daemon=True).start()
 
     def __enter__(self):
         for s in self.signals:
@@ -166,11 +185,14 @@ class PreemptionHandler:
         return False
 
 
-def install_preemption_handler(epoch_range: TrainEpochRange,
-                               signals=(signal.SIGTERM,)) -> PreemptionHandler:
+def install_preemption_handler(epoch_range: TrainEpochRange | None = None,
+                               signals=(signal.SIGTERM,), *, services=(),
+                               drain_s: float | None = None,
+                               ) -> PreemptionHandler:
     """Install-and-forget form of :class:`PreemptionHandler` (no context
     manager); returns the handler (use it as ``__exit__``-less — or call
     ``.__exit__()`` to restore the previous signal handlers)."""
-    handler = PreemptionHandler(epoch_range, signals)
+    handler = PreemptionHandler(epoch_range, signals, services=services,
+                                drain_s=drain_s)
     handler.__enter__()
     return handler

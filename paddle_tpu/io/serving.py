@@ -25,6 +25,7 @@ from typing import Any
 
 import numpy as np
 
+from paddle_tpu.core.flags import flag
 from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
 
 __all__ = ["InferenceServer", "InferenceClient"]
@@ -102,7 +103,12 @@ class InferenceServer(FrameService):
                 return True
             if name == "stop":
                 send_frame(sock, 0, {})
-                threading.Thread(target=self.stop, daemon=True).start()
+                # graceful: other in-flight infers get wire_drain_s to
+                # finish before their sockets are severed
+                threading.Thread(
+                    target=self.stop,
+                    kwargs={"drain_s": float(flag("wire_drain_s"))},
+                    daemon=True).start()
                 return False
             if name == "list_models":
                 with self._lock:

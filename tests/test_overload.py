@@ -1,0 +1,396 @@
+"""Server-side overload protection: admission control (shed with the
+retryable status code 2), the universal health op, graceful drain, the
+barrier-timeout flag, idle-connection reaping, and the stop() race fix.
+All CPU-only and tier-1 fast."""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import io
+from paddle_tpu.core import monitor
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.core.wire import (CODE_SHED, FrameClient, FrameService,
+                                  send_frame)
+from paddle_tpu.distributed.ps import ParameterServer, PSClient
+from paddle_tpu.distributed.ps.heter import HeterWorker
+
+pytestmark = pytest.mark.overload
+
+_FLAGS = ["wire_max_inflight", "wire_max_conns", "wire_server_idle_s",
+          "wire_drain_s", "ps_barrier_timeout_s", "wire_backoff_max_s"]
+
+
+@pytest.fixture(autouse=True)
+def _restore_overload_flags():
+    """Every cap must be back at its production default (off/unlimited)
+    after each test — a leaked cap would shed unrelated suites."""
+    saved = get_flags(_FLAGS)
+    yield
+    set_flags(saved)
+
+
+class _SlowPredictor:
+    """Stand-in Predictor: holds the in-flight slot for ``delay`` seconds
+    (InferenceServer.add_model accepts any object with run/specs)."""
+
+    input_specs = [{"shape": [None], "dtype": "float32"}]
+    output_specs = [{"shape": [None], "dtype": "float32"}]
+
+    def __init__(self, delay: float = 0.05):
+        self.delay = delay
+
+    def run(self, x):
+        time.sleep(self.delay)
+        return np.asarray(x)
+
+
+class _Echo(FrameService):
+    def _dispatch(self, sock, op, header, payload):
+        send_frame(sock, 0, {"echo": header.get("x")})
+        return True
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_shed_under_load_then_all_recover():
+    """Acceptance scenario: cap=1, a simultaneous burst of 8 infers —
+    some are shed with code 2, every client succeeds after retry, and
+    both sides of the shed show up in monitor stats."""
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor(0.05))
+    srv.start()
+    set_flags({"wire_max_inflight": 1, "wire_backoff_max_s": 0.2})
+    monitor.reset_stats("wire/")
+    x = np.ones((4,), np.float32)
+    results, errors = [], []
+    gate = threading.Barrier(8)
+
+    def worker():
+        c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=32)
+        try:
+            gate.wait()
+            (y,) = c.infer("slow", x)
+            results.append(y)
+        except Exception as e:              # noqa: BLE001 - collected
+            errors.append(e)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, f"shed requests must succeed on retry: {errors[:2]}"
+    assert len(results) == 8
+    assert all(np.allclose(y, x) for y in results)
+    assert monitor.get_stat("wire/shed") >= 1, "cap=1 + burst must shed"
+    assert monitor.get_stat("wire/shed_server") >= 1
+    srv.stop()
+
+
+class _ShedOnce(FrameService):
+    """Replies code-2 to the first request, then serves normally — the
+    deterministic unit of the client's shed-retry contract."""
+
+    def __init__(self):
+        self.seen = 0
+        super().__init__()
+
+    def _dispatch(self, sock, op, header, payload):
+        self.seen += 1
+        if self.seen == 1:
+            send_frame(sock, CODE_SHED,
+                       {"error": "overloaded", "retry_after_s": 0.01})
+        else:
+            send_frame(sock, 0, {"ok": True})
+        return True
+
+
+class _ShedAlways(FrameService):
+    def _dispatch(self, sock, op, header, payload):
+        send_frame(sock, CODE_SHED,
+                   {"error": "overloaded", "retry_after_s": 0.0})
+        return True
+
+
+def test_shed_retried_even_for_non_idempotent_ops():
+    """A shed request never executed, so it must be retried even for an
+    op outside the idempotent set — without burning conn-retry stats."""
+    srv = _ShedOnce().start()
+    monitor.reset_stats("wire/")
+    c = FrameClient(srv.endpoint, {"push": 1}, service="test", timeout=5.0,
+                    retries=2)                  # "push" NOT idempotent
+    h, _ = c._request("push", {})
+    assert h["ok"] is True
+    assert monitor.get_stat("wire/shed") == 1
+    assert monitor.get_stat("wire/retries") == 0, "shed != conn retry"
+    c.close()
+    srv.stop()
+
+
+def test_shed_budget_exhaustion_surfaces_error():
+    srv = _ShedAlways().start()
+    c = FrameClient(srv.endpoint, {"push": 1}, service="test", timeout=5.0,
+                    retries=1)
+    with pytest.raises(RuntimeError, match="shed .* after 2 attempt"):
+        c._request("push", {})
+    c.close()
+    srv.stop()
+
+
+def test_connection_cap_sheds_excess_connection():
+    srv = _Echo().start()
+    c1 = FrameClient(srv.endpoint, {"e": 1}, timeout=5.0)
+    assert c1._request("e", {"x": 1})[0]["echo"] == 1   # conn 1 admitted
+    set_flags({"wire_max_conns": 1})
+    monitor.reset_stats("wire/")
+    c2 = FrameClient(srv.endpoint, {"e": 1}, timeout=5.0, retries=0)
+    with pytest.raises(RuntimeError, match="shed"):
+        c2._request("e", {"x": 2})
+    assert monitor.get_stat("wire/shed_conns") >= 1
+    # the incumbent connection is unaffected
+    assert c1._request("e", {"x": 3})[0]["echo"] == 3
+    c1.close()
+    c2.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# universal health op
+# ---------------------------------------------------------------------------
+
+def _build_step():
+    def step_fn(feats, labels):
+        return 0.0, feats
+
+    def eval_fn(feats, labels):
+        return 0.0
+
+    return step_fn, eval_fn
+
+
+def test_health_served_by_every_service(tmp_path):
+    services = {
+        "InferenceServer": io.InferenceServer(),
+        "ParameterServer": ParameterServer(),
+        "HeterWorker": HeterWorker(_build_step),
+        "FSService": io.FSService(str(tmp_path / "root")),
+    }
+    for name, srv in services.items():
+        srv.start()
+        # ops table is empty: health is universal, outside every op table
+        with FrameClient(srv.endpoint, {}, service="probe",
+                         timeout=5.0) as probe:
+            h = probe.health()
+        assert h["status"] == "ok"
+        assert h["service"] == name
+        assert h["inflight"] == 0 and h["conns"] >= 1
+        assert h["uptime_s"] >= 0.0
+        assert isinstance(h["stats"], dict)
+        srv.stop()
+
+
+def test_health_via_service_clients(tmp_path):
+    ps = ParameterServer().start()
+    pc = PSClient(ps.endpoint, timeout=5.0)
+    assert pc.health()["service"] == "ParameterServer"
+    pc.close()
+    ps.stop()
+
+    fssrv = io.FSService(str(tmp_path / "r")).start()
+    wfs = io.WireFS(fssrv.endpoint, timeout=5.0)
+    assert wfs.health()["status"] == "ok"
+    wfs.close()
+    fssrv.stop()
+
+
+def test_health_never_shed_under_full_load():
+    """The probe must answer while the admission cap is saturated."""
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor(0.5))
+    srv.start()
+    set_flags({"wire_max_inflight": 1})
+    c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=0)
+    t = threading.Thread(
+        target=lambda: c.infer("slow", np.ones((2,), np.float32)))
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and srv.health()["inflight"] < 1:
+            time.sleep(0.01)
+        assert srv.health()["inflight"] == 1
+        with FrameClient(srv.endpoint, {}, timeout=5.0) as probe:
+            h = probe.health()          # not shed despite the full cap
+        assert h["inflight"] == 1 and h["max_inflight"] == 1
+    finally:
+        t.join(timeout=10)
+        c.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_drain_completes_inflight_before_sever():
+    """Acceptance scenario: drain() lets the in-flight infer finish (and
+    deliver its response) before the socket is severed."""
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor(0.4))
+    srv.start()
+    c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=0)
+    x = np.arange(3, dtype=np.float32)
+    out = {}
+
+    def worker():
+        out["y"] = c.infer("slow", x)[0]
+
+    t = threading.Thread(target=worker)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and srv.health()["inflight"] < 1:
+        time.sleep(0.01)
+    assert srv.health()["inflight"] == 1, "infer must be in flight"
+
+    clean = srv.drain(5.0)
+    t.join(timeout=10)
+    assert clean is True
+    assert "y" in out and np.allclose(out["y"], x)
+    # drained service is gone: new connections are refused
+    with pytest.raises(OSError):
+        io.InferenceClient(srv.endpoint, timeout=1.0, retries=0)
+    c.close()
+
+
+def test_health_reports_draining_and_new_requests_shed():
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor(0.6))
+    srv.start()
+    c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=0)
+    probe = FrameClient(srv.endpoint, {"infer": 1}, service="probe",
+                        timeout=5.0, retries=0)
+    # warm the probe connection: a conn still in the accept backlog when
+    # drain closes the listener is reset (= shed, nothing executed); a
+    # served one survives until the final sever — the persistent-probe
+    # pattern a load balancer uses
+    assert probe.health()["status"] == "ok"
+    t = threading.Thread(
+        target=lambda: c.infer("slow", np.ones((2,), np.float32)))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and srv.health()["inflight"] < 1:
+        time.sleep(0.01)
+    d = threading.Thread(target=srv.drain, args=(5.0,))
+    d.start()
+    saw_draining = saw_shed = False
+    while d.is_alive():
+        try:
+            h = probe.health()
+            saw_draining |= h["status"] == "draining"
+            if not saw_shed:
+                try:
+                    probe._request(
+                        "infer", {"model": "slow", "inputs": [], "nbytes": 0})
+                except RuntimeError as e:
+                    saw_shed = "shed" in str(e)
+        except (ConnectionError, OSError):
+            break                      # drain finished and severed us
+        time.sleep(0.02)
+    d.join(timeout=10)
+    t.join(timeout=10)
+    assert saw_draining, "health must report draining during the drain"
+    assert saw_shed, "new requests during drain must be shed (code 2)"
+    probe.close()
+    c.close()
+
+
+def test_preemption_handler_drains_hosted_services():
+    """SIGTERM on a serving process: the handler drains the service — the
+    in-flight request completes, then the listener goes away."""
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor(0.3))
+    srv.start()
+    host, port = srv.host, srv.port
+    c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=0)
+    out = {}
+
+    def worker():
+        out["y"] = c.infer("slow", np.ones((2,), np.float32))[0]
+
+    with io.PreemptionHandler(services=[srv], drain_s=5.0) as h:
+        t = threading.Thread(target=worker)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and srv.health()["inflight"] < 1):
+            time.sleep(0.01)
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.join(timeout=10)
+    assert h.installed and h.preempted
+    assert "y" in out, "in-flight request survived the SIGTERM drain"
+    deadline = time.monotonic() + 5.0
+    gone = False
+    while time.monotonic() < deadline and not gone:
+        try:
+            socket.create_connection((host, port), timeout=0.2).close()
+            time.sleep(0.05)
+        except OSError:
+            gone = True
+    assert gone, "drained service must stop listening"
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: barrier flag, idle reap, stop() race
+# ---------------------------------------------------------------------------
+
+def test_ps_barrier_timeout_flag():
+    set_flags({"ps_barrier_timeout_s": 0.2})
+    monitor.reset_stats("ps/")
+    ps = ParameterServer().start()
+    c = PSClient(ps.endpoint, timeout=5.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="barrier timed out"):
+        c.barrier(world=2)              # alone at a world-2 rendezvous
+    assert time.monotonic() - t0 < 5.0, "flag bounded the wait"
+    assert monitor.get_stat("ps/barrier_timeouts") == 1
+    c.close()
+    ps.stop()
+
+
+def test_idle_connection_reaped():
+    set_flags({"wire_server_idle_s": 0.3})
+    monitor.reset_stats("wire/")
+    srv = _Echo().start()
+    s = socket.create_connection((srv.host, srv.port))
+    s.settimeout(5.0)
+    t0 = time.monotonic()
+    assert s.recv(1) == b"", "silent connection must be closed by server"
+    assert time.monotonic() - t0 < 4.0
+    assert monitor.get_stat("wire/idle_closed") == 1
+    s.close()
+    srv.stop()
+
+
+def test_late_connection_during_stop_is_closed_immediately():
+    """The stop()/handler race: a connection that lands while stop() is
+    severing must be closed by the handler, not serve forever."""
+    srv = _Echo().start()
+    with srv._conns_lock:
+        srv._stopping = True            # simulate the severing window
+    s = socket.create_connection((srv.host, srv.port))
+    s.settimeout(5.0)
+    assert s.recv(1) == b"", "late connection must be refused service"
+    with srv._conns_lock:
+        assert not srv._conns, "late connection must not be registered"
+    s.close()
+    srv.stop()

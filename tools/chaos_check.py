@@ -11,12 +11,18 @@ Scenarios (all CPU-only, single process):
    back to the newest verifiable step on load.
 3. **elastic-resume**: a TrainEpochRange run crashed by an injected
    ``ckpt.save`` fault resumes from the previous verifiable step.
+4. **overload**: with ``wire_max_inflight=1`` a concurrent infer burst is
+   shed with the retryable status code 2, every client succeeds after
+   backoff, the health op answers throughout, and ``drain()`` finishes
+   in-flight work before severing.
 
-Also asserts the production posture: every fault/retry flag defaults to
-hard-off/zero-cost.
+Also asserts the production posture: every fault/retry/overload flag
+defaults to hard-off/zero-cost.
 
 Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py``. Exits nonzero
-(with a JSON report on stdout) if any recovery path or stat fails.
+(with a JSON report on stdout) if any recovery path or stat fails — a
+scenario that raises is recorded as a failed check, never a bare
+traceback, so the harness is CI-runnable as-is.
 """
 
 import json
@@ -30,10 +36,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax.numpy as jnp  # noqa: E402
 import numpy as np       # noqa: E402
 
-import paddle_tpu                              # noqa: E402
-from paddle_tpu import io, nn                  # noqa: E402
-from paddle_tpu.core import fault, monitor     # noqa: E402
-from paddle_tpu.core.flags import get_flags    # noqa: E402
+import paddle_tpu                                        # noqa: E402
+from paddle_tpu import io, nn                            # noqa: E402
+from paddle_tpu.core import fault, monitor               # noqa: E402
+from paddle_tpu.core.flags import get_flags, set_flags   # noqa: E402
 
 CHECKS: list[tuple[str, bool, str]] = []
 
@@ -48,6 +54,13 @@ def check_defaults_off() -> None:
     check("defaults/injection_off", f["fault_inject"] == ""
           and not fault.enabled(), str(f))
     check("defaults/deadline_finite", f["wire_timeout_s"] > 0, str(f))
+    o = get_flags(["wire_max_inflight", "wire_max_conns",
+                   "wire_server_idle_s", "ps_barrier_timeout_s"])
+    check("defaults/overload_caps_off", o["wire_max_inflight"] == 0
+          and o["wire_max_conns"] == 0 and o["wire_server_idle_s"] == 0,
+          str(o))
+    check("defaults/barrier_timeout_finite",
+          o["ps_barrier_timeout_s"] > 0, str(o))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -135,13 +148,65 @@ def scenario_elastic_resume(tmp: str) -> None:
           and int(r2.state["step"]) == 1)
 
 
+def scenario_overload(tmp: str) -> None:
+    import threading
+    import time
+
+    class _SlowPredictor:
+        input_specs = output_specs = []
+
+        def run(self, x):
+            time.sleep(0.05)
+            return np.asarray(x)
+
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor())
+    srv.start()
+    monitor.reset_stats("wire/")
+    set_flags({"wire_max_inflight": 1, "wire_backoff_max_s": 0.2})
+    try:
+        x = np.ones((4,), np.float32)
+        results, errors = [], []
+        gate = threading.Barrier(6)
+
+        def worker():
+            c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=32)
+            try:
+                gate.wait()
+                results.append(c.infer("slow", x)[0])
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        check("overload/all_recovered_after_shed",
+              not errors and len(results) == 6, repr(errors[:2]))
+        check("overload/shed_fired", monitor.get_stat("wire/shed") >= 1
+              and monitor.get_stat("wire/shed_server") >= 1)
+        h = srv.health()
+        check("overload/health_op", h["status"] == "ok"
+              and h["inflight"] == 0 and h["max_inflight"] == 1, str(h))
+    finally:
+        set_flags({"wire_max_inflight": 0, "wire_backoff_max_s": 2.0})
+    check("overload/drain_clean", srv.drain(5.0) is True)
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
         os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
-        scenario_serving_wire(tmp)
-        scenario_checkpoint(tmp)
-        scenario_elastic_resume(tmp)
+        for scenario in (scenario_serving_wire, scenario_checkpoint,
+                         scenario_elastic_resume, scenario_overload):
+            try:
+                scenario(tmp)
+            except Exception as e:   # a crash is a failed check, not a
+                check(f"{scenario.__name__}/completed", False,   # traceback
+                      f"{type(e).__name__}: {e}")
     ok = all(c[1] for c in CHECKS)
     print(json.dumps({
         "ok": ok,
