@@ -139,6 +139,47 @@ define_flag("ckpt_manifest", True,
             "verifiable one instead of crashing the resume")
 
 
+# --- observability (core/trace.py, core/monitor.py, core/logging.py) ---
+
+def _on_trace(v) -> None:
+    from paddle_tpu.core import trace
+
+    trace.configure(bool(v))
+
+
+def _on_trace_buffer(v) -> None:
+    from paddle_tpu.core import trace
+
+    if trace.enabled():                 # live resize; drops buffered spans
+        trace.configure(True, capacity=int(v))
+
+
+def _on_log_json(v) -> None:
+    from paddle_tpu.core import logging as logging_mod
+
+    logging_mod.set_json(bool(v))
+
+
+# trace_buffer must be defined BEFORE trace: trace.configure reads it when
+# a FLAGS_trace env var fires on_set during this import.
+define_flag("trace_buffer", 4096,
+            "Span ring-buffer capacity for the in-process tracer "
+            "(core/trace.py); oldest spans are evicted first",
+            on_set=_on_trace_buffer)
+define_flag("trace", False,
+            "Record framework spans (wire round-trips incl. cross-wire "
+            "trace-id propagation, PS ops, checkpoint save/load, train "
+            "epochs, serving predicts) into an in-process ring buffer "
+            "with per-op latency histograms. Hard-off default: the wire "
+            "fast path pays a single flag check",
+            on_set=_on_trace)
+define_flag("log_json", False,
+            "Structured logging: one JSON object per line (ts, level, "
+            "msg, trace_id of the active span) instead of the human "
+            "format — lets log lines join the trace timeline",
+            on_set=_on_log_json)
+
+
 def _on_fault_seed(v) -> None:
     try:
         spec = flag("fault_inject")

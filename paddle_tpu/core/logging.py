@@ -2,22 +2,57 @@
 
 Reference: glog init in ``paddle/fluid/pybind/pybind.cc:1717`` and VLOG use
 throughout the C++ core.
+
+``FLAGS_log_json`` switches the handler to structured output — one JSON
+object per line (``ts``, ``level``, ``msg``, plus the ``trace_id`` of the
+active ``core.trace`` span when tracing is on) so log lines correlate
+with the span timeline instead of living in a parallel universe.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 
 from paddle_tpu.core.flags import flag
 
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line; trace-correlated when a span is open."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {"ts": round(record.created, 6),
+               "level": record.levelname,
+               "logger": record.name,
+               "msg": record.getMessage()}
+        if record.exc_info and record.exc_info[0] is not None:
+            doc["exc"] = record.exc_info[0].__name__
+        from paddle_tpu.core import trace
+
+        cur = trace.current()
+        if cur is not None:
+            doc["trace_id"], doc["span_id"] = cur
+        return json.dumps(doc)
+
+
+_TEXT_FORMATTER = logging.Formatter(
+    "%(asctime)s %(levelname).1s paddle_tpu %(message)s", "%H:%M:%S")
+_JSON_FORMATTER = _JsonFormatter()
+
 _logger = logging.getLogger("paddle_tpu")
 if not _logger.handlers:
     h = logging.StreamHandler(sys.stderr)
-    h.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname).1s paddle_tpu %(message)s", "%H:%M:%S"))
+    h.setFormatter(_TEXT_FORMATTER)
     _logger.addHandler(h)
     _logger.setLevel(logging.INFO)
+
+
+def set_json(enable: bool) -> None:
+    """Swap the framework handler's formatter (wired to
+    ``FLAGS_log_json``)."""
+    for handler in _logger.handlers:
+        handler.setFormatter(_JSON_FORMATTER if enable else _TEXT_FORMATTER)
 
 
 def get_logger() -> logging.Logger:

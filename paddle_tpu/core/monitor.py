@@ -16,21 +16,86 @@ tokens/sec the way the reference's benchmark monitors do.
 
 from __future__ import annotations
 
+import bisect
+import math
+import re
 import threading
 import time
 from typing import Any
 
 __all__ = ["StatRegistry", "stats", "stat_add", "stat_set", "get_stat",
-           "export_stats", "reset_stats", "StepTimer", "device_memory_stats",
-           "host_rss_bytes", "host_peak_rss_bytes"]
+           "observe", "get_histogram", "export_stats", "export_histograms",
+           "export_prometheus", "reset_stats", "StepTimer",
+           "device_memory_stats", "host_rss_bytes", "host_peak_rss_bytes"]
+
+
+# Fixed log-spaced histogram buckets: 3 per decade from 1e-7 to 1e+3
+# (100 ns .. ~17 min when observing seconds) + one overflow bucket. Fixed
+# bounds keep observe() O(log n) with zero allocation and make histograms
+# mergeable across processes.
+_BUCKET_BOUNDS = tuple(10.0 ** (-7 + i / 3.0) for i in range(31))
+
+
+class _Histogram:
+    """Fixed-bucket latency/size histogram (quantiles via log
+    interpolation inside the landing bucket, clamped to observed
+    min/max). Mutated only under the owning registry's lock."""
+
+    __slots__ = ("counts", "sum", "count", "min", "max")
+
+    def __init__(self):
+        self.counts = [0] * (len(_BUCKET_BOUNDS) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(_BUCKET_BOUNDS, value)] += 1
+        self.sum += value
+        self.count += 1
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c:
+                lo = _BUCKET_BOUNDS[i - 1] if i > 0 else self.min
+                hi = (_BUCKET_BOUNDS[i] if i < len(_BUCKET_BOUNDS)
+                      else self.max)
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if lo <= 0 or hi <= lo:
+                    return hi
+                # log interpolation: fraction of this bucket's mass below
+                # the target maps onto the bucket's log-spaced width
+                frac = (target - (cum - c)) / c
+                return lo * (hi / lo) ** frac
+        return self.max
+
+    def summary(self) -> dict[str, float]:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
 
 
 class StatRegistry:
-    """Thread-safe named counters (int or float)."""
+    """Thread-safe named counters (int or float) + observation
+    histograms (``observe()``, fixed log-spaced buckets)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: dict[str, float] = {}
+        self._hists: dict[str, _Histogram] = {}
 
     def add(self, name: str, value: float = 1) -> None:
         with self._lock:
@@ -44,6 +109,22 @@ class StatRegistry:
         with self._lock:
             return self._stats.get(name, default)
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation (latency, size, wait) into the named
+        histogram — the p50/p95/p99 companion to ``add()`` counters."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram()
+            h.observe(float(value))
+
+    def histogram(self, name: str) -> dict[str, float] | None:
+        """count/sum/min/max/p50/p95/p99 summary, or None if never
+        observed."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.summary() if h is not None else None
+
     def export(self, prefix: str | None = None) -> dict[str, float]:
         with self._lock:
             if prefix is None:
@@ -51,13 +132,22 @@ class StatRegistry:
             return {k: v for k, v in self._stats.items()
                     if k.startswith(prefix)}
 
+    def export_histograms(self, prefix: str | None = None
+                          ) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {k: h.summary() for k, h in self._hists.items()
+                    if prefix is None or k.startswith(prefix)}
+
     def reset(self, prefix: str | None = None) -> None:
         with self._lock:
             if prefix is None:
                 self._stats.clear()
+                self._hists.clear()
             else:
                 for k in [k for k in self._stats if k.startswith(prefix)]:
                     del self._stats[k]
+                for k in [k for k in self._hists if k.startswith(prefix)]:
+                    del self._hists[k]
 
 
 stats = StatRegistry()          # the global registry (monitor.h pattern)
@@ -76,12 +166,55 @@ def get_stat(name: str, default: float = 0) -> float:
     return stats.get(name, default)
 
 
+def observe(name: str, value: float) -> None:
+    """Record a histogram observation in the global registry."""
+    stats.observe(name, value)
+
+
+def get_histogram(name: str) -> dict[str, float] | None:
+    return stats.histogram(name)
+
+
 def export_stats(prefix: str | None = None) -> dict[str, float]:
     return stats.export(prefix)
 
 
+def export_histograms(prefix: str | None = None
+                      ) -> dict[str, dict[str, float]]:
+    return stats.export_histograms(prefix)
+
+
 def reset_stats(prefix: str | None = None) -> None:
     stats.reset(prefix)
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    n = _PROM_BAD.sub("_", name)
+    return "_" + n if n[:1].isdigit() else n
+
+
+def export_prometheus(prefix: str | None = None) -> str:
+    """Prometheus text exposition of the registry: counters/gauges as
+    ``gauge`` lines, histograms as ``summary`` families (p50/p95/p99
+    ``quantile`` labels + ``_sum``/``_count``) — scrape-ready for the
+    fleet-wide dashboards the reference exported through monitor.h's
+    Python bindings."""
+    lines: list[str] = []
+    for name, value in sorted(stats.export(prefix).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {value:g}")
+    for name, h in sorted(stats.export_histograms(prefix).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(f'{pn}{{quantile="{q}"}} {h[key]:g}')
+        lines.append(f"{pn}_sum {h['sum']:g}")
+        lines.append(f"{pn}_count {h['count']:g}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class StepTimer:
@@ -93,25 +226,29 @@ class StepTimer:
         self.window = window
         # (perf_counter, tokens) per tick; the first entry anchors the
         # window, so token sums cover ticks 1..end (the steps the window
-        # interval actually spans)
+        # interval actually spans). Concurrent tickers (async eval thread
+        # + train loop) mutate the window under a lock, like StatRegistry.
+        self._lock = threading.Lock()
         self._ticks: list[tuple[float, int]] = []
 
     def tick(self, tokens: int | None = None) -> None:
         now = time.perf_counter()
-        self._ticks.append((now, int(tokens or 0)))
-        if len(self._ticks) > self.window + 1:
-            self._ticks.pop(0)
+        with self._lock:
+            self._ticks.append((now, int(tokens or 0)))
+            if len(self._ticks) > self.window + 1:
+                self._ticks.pop(0)
+            window = list(self._ticks)
         stat_add(f"{self.name}/steps", 1)
         if tokens:
             stat_add(f"{self.name}/tokens", tokens)
-        if len(self._ticks) >= 2:
-            dt = self._ticks[-1][0] - self._ticks[0][0]
-            n = len(self._ticks) - 1
+        if len(window) >= 2:
+            dt = window[-1][0] - window[0][0]
+            n = len(window) - 1
             sps = n / dt if dt > 0 else 0.0
             stat_set(f"{self.name}/steps_per_sec", sps)
             # windowed token sum, NOT last-tick-tokens * steps/sec —
             # variable-length batches would misreport otherwise
-            tok = sum(t for _, t in self._ticks[1:])
+            tok = sum(t for _, t in window[1:])
             if tok and dt > 0:
                 stat_set(f"{self.name}/tokens_per_sec", tok / dt)
 

@@ -27,6 +27,8 @@ from typing import Iterator
 
 import jax
 
+from paddle_tpu.core import trace as _trace
+
 __all__ = ["start_profiler", "stop_profiler", "profiler", "RecordEvent",
            "record_function", "annotate"]
 
@@ -68,6 +70,9 @@ class RecordEvent:
 
     Inside a jit trace it lowers to a named_scope (op metadata in the
     device timeline); at host level it opens a TraceAnnotation span.
+    With ``FLAGS_trace`` on it ALSO records a ``core.trace`` span, so
+    user annotations land on the same timeline as the framework's wire/
+    checkpoint spans (the reference RecordEvent → timeline.py pipeline).
     """
 
     def __init__(self, name: str):
@@ -81,6 +86,8 @@ class RecordEvent:
         # unused one is a no-op)
         self._stack.enter_context(jax.named_scope(self.name))
         self._stack.enter_context(jax.profiler.TraceAnnotation(self.name))
+        if _trace._ACTIVE is not None:
+            self._stack.enter_context(_trace.span(self.name))
         return self
 
     def __exit__(self, *exc):

@@ -1,0 +1,266 @@
+"""Flag-gated in-process distributed tracing.
+
+Reference role: the RAII ``RecordEvent`` span stack of
+``paddle/fluid/platform/profiler.h:127,209`` plus the Chrome-trace
+exporter ``tools/timeline.py:273`` — but framework-level rather than
+CUPTI-level: spans cover the *system* paths jax.profiler cannot see
+(wire round-trips, PS ops, checkpoint uploads, retries/sheds), and a
+trace id crosses the wire so one client request yields a joined
+client→server timeline.
+
+Design constraints, in order:
+
+1. **Hard-off zero overhead.** ``FLAGS_trace`` defaults off and the hot
+   paths guard on ``_ACTIVE is not None`` — a single module-attribute
+   read, the same pattern as ``core.fault``. :func:`span` itself returns
+   a shared no-op object when disabled, so non-hot call sites can use it
+   unconditionally.
+2. **Bounded memory.** Spans land in a thread-safe ring buffer
+   (``FLAGS_trace_buffer`` entries); a forgotten-enabled tracer can
+   never grow without bound.
+3. **Wire-portable.** A span is a plain JSON-safe dict; the wire
+   ``trace_dump`` op (``core/wire.py``) ships them to remote scrapers
+   and ``tools/obs_dump.py`` merges multiple services into one
+   Chrome/Perfetto timeline by trace id.
+
+Usage::
+
+    set_flags({"trace": True})
+    with trace.span("train/epoch", epoch=3):
+        ...
+    trace.export_chrome("timeline.json")      # chrome://tracing / Perfetto
+
+Cross-process linkage: the client side stamps its ``trace_id``/``span_id``
+into the request header; the server opens :func:`server_span` with those
+ids, so both halves share one trace id and the server span's parent is
+the client span.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from paddle_tpu.core.flags import flag
+
+__all__ = ["span", "server_span", "enabled", "configure", "current",
+           "get_spans", "clear", "snapshot", "export_chrome",
+           "to_chrome_events", "new_id"]
+
+
+class _Tracer:
+    """Thread-safe span ring buffer."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._buf: deque[dict] = deque(maxlen=max(self.capacity, 1))
+
+    def record(self, span_dict: dict) -> None:
+        with self._lock:
+            self._buf.append(span_dict)
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+# None == tracing fully off; hot paths gate on this single attribute read
+# (the core.fault._ACTIVE pattern).
+_ACTIVE: _Tracer | None = None
+_lock = threading.Lock()
+_ctx = threading.local()          # per-thread stack of (trace_id, span_id)
+
+
+def configure(enable: bool, capacity: int | None = None) -> None:
+    """(Re)configure tracing; wired to ``FLAGS_trace``. Reconfiguring
+    with a new capacity drops buffered spans (the buffer is a debugging
+    artifact, not durable state)."""
+    global _ACTIVE
+    with _lock:
+        if not enable:
+            _ACTIVE = None
+            return
+        if capacity is None:
+            try:
+                capacity = int(flag("trace_buffer"))
+            except KeyError:       # flag not registered yet (import order)
+                capacity = 4096
+        _ACTIVE = _Tracer(capacity)
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def new_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+def current() -> tuple[str, str] | None:
+    """(trace_id, span_id) of this thread's innermost open span."""
+    stack = getattr(_ctx, "stack", None)
+    return stack[-1] if stack else None
+
+
+class _NoopSpan:
+    """What :func:`span` returns while tracing is off: every operation a
+    no-op, shared singleton (no per-call allocation)."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """One open span; records itself into the ring buffer on exit."""
+
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id",
+                 "_ts", "_t0")
+
+    def __init__(self, name: str, attrs: dict,
+                 trace_id: str | None = None,
+                 parent_id: str | None = None):
+        self.name = name
+        self.attrs = attrs
+        if trace_id is None:
+            cur = current()
+            if cur is not None:
+                trace_id, parent_id = cur
+            else:
+                trace_id = new_id()
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.span_id = new_id()
+
+    def set(self, **attrs) -> None:
+        """Attach attributes to an open span (e.g. retry counts known
+        only at the end of the operation)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        stack = getattr(_ctx, "stack", None)
+        if stack is None:
+            stack = _ctx.stack = []
+        stack.append((self.trace_id, self.span_id))
+        self._ts = time.time()             # wall clock: cross-host merge
+        self._t0 = time.perf_counter()     # monotonic: exact duration
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        stack = getattr(_ctx, "stack", None)
+        if stack:
+            stack.pop()
+        tracer = _ACTIVE
+        if tracer is not None:             # disabled mid-span: drop it
+            if exc_type is not None:
+                self.attrs["error"] = exc_type.__name__
+            tracer.record({
+                "name": self.name, "ts": self._ts, "dur": dur,
+                "tid": threading.get_ident(), "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "attrs": self.attrs})
+        return False
+
+
+def span(name: str, **attrs: Any):
+    """Open a span: ``with trace.span("ckpt/save", step=3): ...``.
+    Returns a shared no-op when tracing is off — safe (and cheap) to
+    call unconditionally outside the per-request hot paths."""
+    if _ACTIVE is None:
+        return _NOOP
+    return _Span(name, attrs)
+
+
+def server_span(name: str, trace_id: str | None, parent_id: str | None,
+                **attrs: Any):
+    """Open a span linked to a remote parent (the server half of a wire
+    round-trip). ``trace_id=None`` (untraced client) starts a fresh
+    trace, so a traced server still records its side."""
+    if _ACTIVE is None:
+        return _NOOP
+    return _Span(name, attrs, trace_id=trace_id, parent_id=parent_id)
+
+
+def get_spans() -> list[dict]:
+    """Snapshot of the ring buffer (oldest first); [] when disabled."""
+    tracer = _ACTIVE
+    return tracer.spans() if tracer is not None else []
+
+
+def clear() -> None:
+    tracer = _ACTIVE
+    if tracer is not None:
+        tracer.clear()
+
+
+def snapshot(clear_after: bool = False) -> dict:
+    """JSON-safe dump for the wire ``trace_dump`` op and obs_dump."""
+    tracer = _ACTIVE
+    if tracer is None:
+        return {"enabled": False, "spans": []}
+    spans = tracer.spans()
+    if clear_after:
+        tracer.clear()
+    return {"enabled": True, "capacity": tracer.capacity, "spans": spans}
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export (reference tools/timeline.py:273)
+# ---------------------------------------------------------------------------
+
+def to_chrome_events(spans: list[dict], pid: int | str = 0,
+                     pid_name: str | None = None) -> list[dict]:
+    """Spans → Chrome trace-event dicts (``ph: "X"`` complete events,
+    microsecond timestamps). ``pid``/``pid_name`` group one process'
+    spans in the viewer — obs_dump gives each endpoint its own pid."""
+    events: list[dict] = []
+    if pid_name:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": pid_name}})
+    for s in spans:
+        args = {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        args.update(s.get("attrs") or {})
+        events.append({
+            "name": s["name"], "ph": "X",
+            "ts": s["ts"] * 1e6, "dur": s["dur"] * 1e6,
+            "pid": pid, "tid": s["tid"], "cat": s["name"].split("/")[0],
+            "args": args})
+    return events
+
+
+def export_chrome(path: str | None = None,
+                  spans: list[dict] | None = None) -> dict:
+    """Write the buffered spans (or an explicit span list) as a Chrome
+    trace JSON loadable in ``chrome://tracing`` / Perfetto; returns the
+    document (and writes it to ``path`` when given)."""
+    doc = {"traceEvents": to_chrome_events(
+        get_spans() if spans is None else spans),
+        "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

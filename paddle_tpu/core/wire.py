@@ -26,12 +26,13 @@ import time
 from typing import Any, Iterable
 
 from paddle_tpu.core import fault as _fault
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
-from paddle_tpu.core.monitor import export_stats, stat_add
+from paddle_tpu.core.monitor import export_stats, observe, stat_add
 
 __all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
            "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES", "CODE_SHED",
-           "HEALTH_OP"]
+           "HEALTH_OP", "TRACE_OP"]
 
 # Response status codes. 0 = ok, 1 = error (request ran or was malformed).
 # CODE_SHED rejections happen BEFORE execution (admission control, drain,
@@ -42,6 +43,16 @@ CODE_SHED = 2
 # Op number reserved by FrameService for the universal health probe;
 # subclass op tables start at 1, so 0 never reaches ``_dispatch``.
 HEALTH_OP = 0
+
+# Reserved (negative: outside every subclass op table) for the span
+# scrape — answered by FrameService itself and, like health, never shed,
+# so tools/obs_dump.py can pull timelines off an overloaded service.
+TRACE_OP = -1
+
+# Request-header keys carrying the client span's trace context across the
+# wire (kept short: they ride every traced request frame).
+_TRACE_ID_KEY = "tr"
+_TRACE_PARENT_KEY = "sp"
 
 # Hard caps on request frames arriving at a server. Header/payload lengths
 # come from the (untrusted) peer; without a bound a single corrupt frame
@@ -120,7 +131,20 @@ class FrameService:
       requests, lets in-flight ones finish up to a deadline, then severs.
     - **Idle reap** — ``FLAGS_wire_server_idle_s`` bounds how long a
       silent connection may pin a handler thread (``wire/idle_closed``).
+
+    Observability (``FLAGS_trace``): every dispatched request opens a
+    server-side span linked to the client's trace context (header keys
+    ``tr``/``sp``), records its latency into the
+    ``wire/server_latency_s/<Service>.<op>`` histogram, and the reserved
+    :data:`TRACE_OP` (never shed, like health) dumps the span ring
+    buffer to remote scrapers (``FrameClient.trace_dump()``,
+    ``tools/obs_dump.py``). Subclasses set :attr:`op_names` so spans
+    carry op names instead of numbers.
     """
+
+    # op number -> name, for span/histogram labeling (subclasses set it;
+    # unnamed ops fall back to "op<N>")
+    op_names: dict[int, str] = {}
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         outer = self
@@ -165,7 +189,14 @@ class FrameService:
                         if op == HEALTH_OP:
                             # served here, never by subclasses — and
                             # never shed: probes must answer under load
-                            send_frame(sock, 0, outer.health())
+                            send_frame(sock, 0, outer.health(
+                                header.get("stats_prefix")))
+                            continue
+                        if op == TRACE_OP:
+                            # span scrape: never shed either (observing
+                            # an overloaded service is the whole point)
+                            send_frame(sock, 0, outer.trace_dump(
+                                bool(header.get("clear"))))
                             continue
                         admitted, reason = outer._try_admit()
                         if not admitted:
@@ -173,8 +204,12 @@ class FrameService:
                             outer._shed_frame(sock, reason)
                             continue
                         try:
-                            keep = outer._dispatch(sock, op, header,
-                                                   payload)
+                            if _trace._ACTIVE is not None:
+                                keep = outer._traced_dispatch(
+                                    sock, op, header, payload)
+                            else:
+                                keep = outer._dispatch(sock, op, header,
+                                                       payload)
                         finally:
                             outer._release()
                         if not keep:
@@ -243,10 +278,42 @@ class FrameService:
             header["closing"] = True
         send_frame(sock, CODE_SHED, header)
 
+    # -- observability -----------------------------------------------------
+    def _op_name(self, op: int) -> str:
+        return self.op_names.get(op) or f"op{op}"
+
+    def _traced_dispatch(self, sock, op: int, header: dict,
+                         payload: bytes) -> bool:
+        """Dispatch wrapped in a server span linked to the client's
+        trace context (one trace id across the wire) + a per-op server
+        latency histogram. Only called while tracing is active."""
+        name = f"{type(self).__name__}.{self._op_name(op)}"
+        t0 = time.perf_counter()
+        with _trace.server_span(f"wire/{name}",
+                                header.get(_TRACE_ID_KEY),
+                                header.get(_TRACE_PARENT_KEY)):
+            keep = self._dispatch(sock, op, header, payload)
+        observe(f"wire/server_latency_s/{name}", time.perf_counter() - t0)
+        return keep
+
+    def trace_dump(self, clear: bool = False) -> dict:
+        """Span ring-buffer snapshot, served to any client as op
+        :data:`TRACE_OP` (``FrameClient.trace_dump()``) — never shed."""
+        doc = _trace.snapshot(clear_after=clear)
+        doc["service"] = type(self).__name__
+        doc["endpoint"] = self.endpoint
+        return doc
+
     # -- health ------------------------------------------------------------
-    def health(self) -> dict:
+    def health(self, stats_prefix: str | None = None) -> dict:
         """Uniform liveness/load snapshot, also served to any client as
-        op :data:`HEALTH_OP` (``FrameClient.health()``)."""
+        op :data:`HEALTH_OP` (``FrameClient.health()``). ``stats_prefix``
+        (probe-header ``stats_prefix``) filters the monitor-stats
+        snapshot so high-frequency pollers don't ship every counter each
+        probe (``""`` still means everything; pass a non-matching prefix
+        for none)."""
+        if stats_prefix is not None:
+            stats_prefix = str(stats_prefix)   # header value is untrusted
         with self._load_cv:
             inflight = self._inflight
             draining = self._draining or self._stopping
@@ -262,7 +329,7 @@ class FrameService:
             "max_conns": int(flag("wire_max_conns")),
             "uptime_s": (time.monotonic() - self._started
                          if self._started is not None else 0.0),
-            "stats": export_stats(),
+            "stats": export_stats(stats_prefix),
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -400,11 +467,21 @@ class FrameClient:
                 or getattr(e, "errno", None) in (errno.EAGAIN,
                                                  errno.EWOULDBLOCK))
 
-    def health(self) -> dict:
+    def health(self, stats_prefix: str | None = None) -> dict:
         """Probe the server's universal health op (:data:`HEALTH_OP`,
         served by ``FrameService`` itself for every service): liveness,
-        in-flight/connection depth, drain status, uptime, stats."""
-        return self._request("health", {}, idempotent=True)[0]
+        in-flight/connection depth, drain status, uptime, stats.
+        ``stats_prefix`` asks the server to filter the stats snapshot
+        (high-frequency pollers shouldn't ship every counter)."""
+        header = ({} if stats_prefix is None
+                  else {"stats_prefix": stats_prefix})
+        return self._request("health", header, idempotent=True)[0]
+
+    def trace_dump(self, clear: bool = False) -> dict:
+        """Scrape the server's span ring buffer (:data:`TRACE_OP`, never
+        shed). ``clear`` drains it server-side after the dump."""
+        header = {"clear": True} if clear else {}
+        return self._request("trace_dump", header, idempotent=True)[0]
 
     def _request(self, op: str, header: dict, payload: bytes = b"",
                  idempotent: bool | None = None,
@@ -417,9 +494,41 @@ class FrameClient:
         try:
             opnum = self._ops[op]
         except KeyError:
-            if op != "health":
+            # universal FrameService ops, outside every subclass op table
+            if op == "health":
+                opnum = HEALTH_OP
+            elif op == "trace_dump":
+                opnum = TRACE_OP
+            else:
                 raise
-            opnum = HEALTH_OP   # universal probe, outside every op table
+        # Tracing (FLAGS_trace, hard-off default — this is the only
+        # check the fast path pays): one client span covers the whole
+        # logical request including retries, and its ids ride the header
+        # so the server links its span into the same trace.
+        if _trace._ACTIVE is not None:
+            return self._traced_request(op, opnum, header, payload,
+                                        idempotent, timeout)
+        return self._request_inner(op, opnum, header, payload, idempotent,
+                                   timeout)
+
+    def _traced_request(self, op, opnum, header, payload, idempotent,
+                        timeout):
+        name = f"wire/{self._service}.{op}"
+        t0 = time.perf_counter()
+        with _trace.span(name, endpoint=self.endpoint) as sp:
+            if sp.trace_id is not None:     # tracing still on
+                header = dict(header)
+                header[_TRACE_ID_KEY] = sp.trace_id
+                header[_TRACE_PARENT_KEY] = sp.span_id
+            try:
+                return self._request_inner(op, opnum, header, payload,
+                                           idempotent, timeout)
+            finally:
+                observe(f"wire/op_latency_s/{self._service}.{op}",
+                        time.perf_counter() - t0)
+
+    def _request_inner(self, op, opnum, header, payload, idempotent,
+                       timeout):
         # Two independent retry budgets (both sized by wire_retries):
         # connection failures/timeouts are retried only for idempotent
         # ops, but CODE_SHED rejections were never executed server-side,
@@ -466,7 +575,13 @@ class FrameClient:
                             f"failed after {conn_fails} attempt(s): "
                             f"{type(e).__name__}: {e}") from e
                     stat_add("wire/retries")
-                    time.sleep(self._backoff(conn_fails - 1))
+                    wait = self._backoff(conn_fails - 1)
+                    observe("wire/retry_wait_s", wait)
+                    # child of the request span when tracing: retries are
+                    # visible on the timeline, not silent gaps
+                    with _trace.span("wire/retry_wait", op=op,
+                                     attempt=conn_fails):
+                        time.sleep(wait)
                     continue
                 if code == CODE_SHED:
                     # admission control turned the request away before it
@@ -480,8 +595,12 @@ class FrameClient:
                             f"{self._service} {op} shed by {self.endpoint} "
                             f"after {sheds} attempt(s): "
                             f"{rheader.get('error')}")
-                    time.sleep(max(float(rheader.get("retry_after_s", 0.0)),
-                                   self._backoff(sheds - 1)))
+                    wait = max(float(rheader.get("retry_after_s", 0.0)),
+                               self._backoff(sheds - 1))
+                    observe("wire/shed_wait_s", wait)
+                    with _trace.span("wire/shed_wait", op=op,
+                                     attempt=sheds):
+                        time.sleep(wait)
                     continue
                 break
         if code != 0:

@@ -65,10 +65,20 @@ class InProcClient:
     def lost_workers(self) -> list[int]:
         return []
 
-    def health(self, server: int = 0) -> dict:
+    def health(self, server: int = 0,
+               stats_prefix: str | None = None) -> dict:
         """Interface parity with PSClient; in-process is always alive."""
         return {"status": "ok", "service": "InProcClient", "inflight": 0,
                 "conns": 0}
+
+    def trace_dump(self, server: int = 0, clear: bool = False) -> dict:
+        """Interface parity with PSClient: the in-process 'server' shares
+        this process' tracer."""
+        from paddle_tpu.core import trace
+
+        doc = trace.snapshot(clear_after=clear)
+        doc["service"] = "InProcClient"
+        return doc
 
     def close(self):
         pass
@@ -225,10 +235,17 @@ class PSClient:
         h, _ = self._heartbeat_conn().request("lost", {})
         return list(h.get("lost", []))
 
-    def health(self, server: int = 0) -> dict:
+    def health(self, server: int = 0,
+               stats_prefix: str | None = None) -> dict:
         """Probe one parameter server's universal health op (liveness,
-        in-flight depth, drain status) — never shed, works under load."""
-        return self._conns[server].health()
+        in-flight depth, drain status) — never shed, works under load.
+        ``stats_prefix`` filters the stats snapshot server-side."""
+        return self._conns[server].health(stats_prefix)
+
+    def trace_dump(self, server: int = 0, clear: bool = False) -> dict:
+        """Scrape one parameter server's span ring buffer — never shed,
+        like health (core/trace.py + tools/obs_dump.py)."""
+        return self._conns[server].trace_dump(clear)
 
     def stop_servers(self):
         for c in self._conns:

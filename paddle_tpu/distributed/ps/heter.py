@@ -47,6 +47,8 @@ class HeterWorker(FrameService):
     - ``eval_fn(features, labels) -> loss`` — no-update evaluation.
     """
 
+    op_names = _OP_NAMES           # span/histogram labels (core/wire.py)
+
     def __init__(self, build_step: Callable, host: str = "127.0.0.1",
                  port: int = 0):
         self._step_fn, self._eval_fn = build_step()

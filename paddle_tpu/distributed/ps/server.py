@@ -187,6 +187,8 @@ class ParameterServer(FrameService):
     ``InProcClient`` can bypass TCP entirely for same-process workers.
     """
 
+    op_names = _OP_NAMES           # span/histogram labels (core/wire.py)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  heartbeat_interval: float = 900.0, on_lost=None):
         self.registry = _TableRegistry()

@@ -28,6 +28,7 @@ from __future__ import annotations
 import time
 from typing import Any, Iterator
 
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.io import checkpoint as ckpt
 
@@ -132,18 +133,22 @@ class TrainEpochRange:
 
     def __iter__(self) -> Iterator[int]:
         for epoch in range(self.start_epoch, self.max_epoch_num):
-            yield epoch
-            if self._stop_requested:
-                # preemption: persist THIS epoch (even off-interval),
-                # drain the async save, and exit the loop cleanly —
-                # the relaunch resumes from here
-                if self.healthy and self._last_saved_epoch != epoch:
+            # the span covers the user's epoch body (generator resumes
+            # inside the with-block) AND the epoch-end save below, so a
+            # traced run shows save time nested inside its epoch
+            with _trace.span("train/epoch", epoch=epoch):
+                yield epoch
+                if self._stop_requested:
+                    # preemption: persist THIS epoch (even off-interval),
+                    # drain the async save, and exit the loop cleanly —
+                    # the relaunch resumes from here
+                    if self.healthy and self._last_saved_epoch != epoch:
+                        self.save(epoch)
+                    self.flush()
+                    stat_add("train/preempted_exits")
+                    return
+                if self._should_save(epoch):
                     self.save(epoch)
-                self.flush()
-                stat_add("train/preempted_exits")
-                return
-            if self._should_save(epoch):
-                self.save(epoch)
 
 
 def train_epoch_range(max_epoch_num: int, directory: str, *, state: Any,

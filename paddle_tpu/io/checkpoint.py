@@ -25,10 +25,13 @@ from typing import Any
 import jax
 import numpy as np
 
+import time
+
 from paddle_tpu.core import fault as _fault
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.module import Module, named_parameters, path_str
-from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.core.monitor import observe, stat_add
 
 __all__ = ["state_dict", "set_state_dict", "save_state_dict",
            "load_state_dict", "save_checkpoint", "load_checkpoint",
@@ -305,36 +308,45 @@ def save_checkpoint(tree, directory: str, step: int,
     With flag ``ckpt_manifest`` (default on) an integrity manifest (leaf
     names + crc32 checksums, computed from the in-memory arrays) is
     committed next to the step; resume falls back past steps whose
-    manifest is missing or whose restored bytes mismatch it."""
+    manifest is missing or whose restored bytes mismatch it.
+
+    Observability: the save runs under a ``ckpt/save`` span (remote
+    uploads nest ``ckpt/push`` + ``fs/upload`` under it) and its
+    duration lands in the ``ckpt/save_s`` histogram."""
     import orbax.checkpoint as ocp
 
-    flat, _ = _flatten_named(tree)
-    mgr = _get_manager(directory, max_to_keep)
-    mgr.save(step, args=ocp.args.StandardSave(flat))
-    stat_add("ckpt/saves")
-    # chaos hook sits between the data save and the manifest commit: an
-    # injected crash here yields exactly the dangerous state (orbax step
-    # present, unverifiable) that resume must roll past
-    _fault.inject("ckpt.save")
-    root = _local_root(directory)
-    if flag("ckpt_manifest"):
-        _write_manifest(root, step, flat)
-        # drop manifests of steps orbax's max_to_keep already pruned
-        try:
-            kept = {int(s) for s in mgr.all_steps()}
-            for name in os.listdir(root):
-                if (name.startswith("manifest-") and name.endswith(".json")
-                        and not name.endswith(".json.tmp")):
-                    s = name[len("manifest-"):-len(".json")]
-                    if s.isdigit() and int(s) not in kept:
-                        os.remove(os.path.join(root, name))
-        except OSError:
-            pass
-    stage = _stage_for(directory)
-    if stage is not None:
-        mgr.wait_until_finished()
-        stage.push(step)
-        stage.prune(max_to_keep)
+    t0 = time.perf_counter()
+    with _trace.span("ckpt/save", step=int(step), directory=str(directory)):
+        flat, _ = _flatten_named(tree)
+        mgr = _get_manager(directory, max_to_keep)
+        mgr.save(step, args=ocp.args.StandardSave(flat))
+        stat_add("ckpt/saves")
+        # chaos hook sits between the data save and the manifest commit:
+        # an injected crash here yields exactly the dangerous state
+        # (orbax step present, unverifiable) that resume must roll past
+        _fault.inject("ckpt.save")
+        root = _local_root(directory)
+        if flag("ckpt_manifest"):
+            _write_manifest(root, step, flat)
+            # drop manifests of steps orbax's max_to_keep already pruned
+            try:
+                kept = {int(s) for s in mgr.all_steps()}
+                for name in os.listdir(root):
+                    if (name.startswith("manifest-")
+                            and name.endswith(".json")
+                            and not name.endswith(".json.tmp")):
+                        s = name[len("manifest-"):-len(".json")]
+                        if s.isdigit() and int(s) not in kept:
+                            os.remove(os.path.join(root, name))
+            except OSError:
+                pass
+        stage = _stage_for(directory)
+        if stage is not None:
+            mgr.wait_until_finished()
+            with _trace.span("ckpt/push", step=int(step)):
+                stage.push(step)
+            stage.prune(max_to_keep)
+    observe("ckpt/save_s", time.perf_counter() - t0)
 
 
 def load_checkpoint(tree, directory: str, step: int | None = None, *,
@@ -377,15 +389,19 @@ def load_checkpoint(tree, directory: str, step: int | None = None, *,
     abstract = {k: ocp.utils.to_shape_dtype_struct(v)
                 for k, v in flat.items()}
     errors: list[tuple[int, Exception]] = []
+    t0 = time.perf_counter()
     for use in candidates:
         try:
-            if stage is not None:
-                # fetch() enforces the .complete marker + atomic cache fill
-                stage.fetch(use)
-            restored = mgr.restore(use,
-                                   args=ocp.args.StandardRestore(abstract))
-            if flag("ckpt_manifest"):
-                _verify_restored(root, use, restored, steps)
+            with _trace.span("ckpt/load", step=int(use),
+                             directory=str(directory)):
+                if stage is not None:
+                    # fetch() enforces the .complete marker + atomic
+                    # cache fill
+                    stage.fetch(use)
+                restored = mgr.restore(
+                    use, args=ocp.args.StandardRestore(abstract))
+                if flag("ckpt_manifest"):
+                    _verify_restored(root, use, restored, steps)
         except Exception as e:   # corrupt/truncated/unverifiable step
             stat_add("ckpt/corrupt_steps")
             errors.append((use, e))
@@ -394,6 +410,7 @@ def load_checkpoint(tree, directory: str, step: int | None = None, *,
             stat_add("ckpt/rollbacks")
         out = jax.tree_util.tree_unflatten(treedef,
                                            [restored[k] for k in flat])
+        observe("ckpt/load_s", time.perf_counter() - t0)
         return (out, use) if return_step else out
     detail = "; ".join(f"step {s}: {type(e).__name__}: {e}"
                        for s, e in errors[:3])

@@ -26,6 +26,7 @@ import shutil
 from typing import Callable
 
 from paddle_tpu.core import fault as _fault
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.wire import FrameClient, FrameService
 
 __all__ = ["FS", "LocalFS", "WireFS", "FSService", "register_fs",
@@ -140,6 +141,7 @@ class LocalFS(FS):
 
 _OPS = {"ls": 1, "stat": 2, "read": 3, "write": 4, "mkdirs": 5,
         "delete": 6, "mv": 7, "touch": 8}
+_OP_NAMES = {v: k for k, v in _OPS.items()}
 
 # Files cross the wire in bounded chunks (read takes offset/length,
 # write takes an append flag) so a multi-GB orbax shard never
@@ -152,6 +154,8 @@ class FSService(FrameService):
     ``ptfs://``. Paths are confined to the root (``..`` escapes are
     rejected); bind beyond loopback only on trusted networks (the same
     posture as the PS services)."""
+
+    op_names = _OP_NAMES           # span/histogram labels (core/wire.py)
 
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
         super().__init__(host, port)
@@ -285,12 +289,14 @@ class WireFS(FS):
         _fault.inject("fs.upload")
         rel = self._rel(remote_path)
         if os.path.isdir(local_path):
-            self.mkdirs(rel)
-            for name in sorted(os.listdir(local_path)):
-                self.upload(os.path.join(local_path, name),
-                            f"{rel}/{name}")
+            with _trace.span("fs/upload_tree", path=rel):
+                self.mkdirs(rel)
+                for name in sorted(os.listdir(local_path)):
+                    self.upload(os.path.join(local_path, name),
+                                f"{rel}/{name}")
             return
-        with open(local_path, "rb") as f:
+        with _trace.span("fs/upload", path=rel), \
+                open(local_path, "rb") as f:
             append = False
             while True:
                 data = f.read(CHUNK_BYTES)
@@ -311,15 +317,17 @@ class WireFS(FS):
         rel = self._rel(remote_path)
         st = self._stat(rel)
         if st["is_dir"]:
-            os.makedirs(local_path, exist_ok=True)
-            dirs, files = self.ls_dir(rel)
-            for name in dirs + files:
-                self.download(f"{rel}/{name}",
-                              os.path.join(local_path, name))
+            with _trace.span("fs/download_tree", path=rel):
+                os.makedirs(local_path, exist_ok=True)
+                dirs, files = self.ls_dir(rel)
+                for name in dirs + files:
+                    self.download(f"{rel}/{name}",
+                                  os.path.join(local_path, name))
             return
         os.makedirs(os.path.dirname(os.path.abspath(local_path)),
                     exist_ok=True)
-        with open(local_path, "wb") as f:
+        with _trace.span("fs/download", path=rel), \
+                open(local_path, "wb") as f:
             offset = 0
             while True:
                 h, data = self._client._request(
@@ -333,9 +341,13 @@ class WireFS(FS):
     def need_upload_download(self):
         return True
 
-    def health(self) -> dict:
+    def health(self, stats_prefix: str | None = None) -> dict:
         """Probe the FSService's universal health op (core/wire.py)."""
-        return self._client.health()
+        return self._client.health(stats_prefix)
+
+    def trace_dump(self, clear: bool = False) -> dict:
+        """Scrape the FSService's span ring buffer (core/trace.py)."""
+        return self._client.trace_dump(clear)
 
     def close(self):
         self._client.close()

@@ -25,6 +25,7 @@ from typing import Any
 
 import numpy as np
 
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
 
@@ -72,6 +73,8 @@ class InferenceServer(FrameService):
     localhost, the data-plane ``infer``/``list_models`` stay available
     and admin must be opted into explicitly.
     """
+
+    op_names = _OP_NAMES           # span/histogram labels (core/wire.py)
 
     def __init__(self, models: dict[str, Any] | None = None,
                  host: str = "127.0.0.1", port: int = 0,
@@ -130,7 +133,10 @@ class InferenceServer(FrameService):
                 raise KeyError(f"no model {header['model']!r}; loaded: "
                                f"{sorted(self._models)}")
             inputs = _unpack_arrays(header["inputs"], payload)
-            outs = pred.run(*inputs)
+            # nested under the wire server span: a traced request shows
+            # model time separate from framing/dispatch time
+            with _trace.span("serving/predict", model=header["model"]):
+                outs = pred.run(*inputs)
             if not isinstance(outs, (tuple, list)):
                 outs = (outs,)
             specs, body = _pack_arrays(np.asarray(o) for o in outs)

@@ -1,0 +1,346 @@
+"""Observability subsystem: span recorder (nesting, ring cap, disabled
+no-op), cross-wire trace-id propagation, latency histograms + Prometheus
+export, Chrome-trace JSON validity, structured JSON logging, health
+stats-prefix filtering, and the StepTimer lock fix. All CPU-only and
+tier-1 fast."""
+
+import json
+import logging
+import threading
+
+import pytest
+
+from paddle_tpu.core import monitor, trace
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
+
+pytestmark = pytest.mark.obs
+
+_FLAGS = ["trace", "trace_buffer", "log_json"]
+
+
+@pytest.fixture(autouse=True)
+def _restore_obs_flags():
+    """Tracing/logging must be back at production defaults (off) after
+    each test — a leaked tracer would record every other suite."""
+    saved = get_flags(_FLAGS)
+    yield
+    set_flags(saved)
+    trace.clear()
+
+
+def _tracing_on(capacity=4096):
+    set_flags({"trace_buffer": capacity, "trace": True})
+
+
+class _Echo(FrameService):
+    op_names = {1: "echo"}
+
+    def _dispatch(self, sock, op, header, payload):
+        send_frame(sock, 0, {"echo": header.get("x")})
+        return True
+
+
+# ---------------------------------------------------------------------------
+# span recorder
+# ---------------------------------------------------------------------------
+
+def test_disabled_mode_is_noop():
+    """Production default: no tracer, span() returns one shared no-op
+    object (no per-call allocation), nothing is recorded."""
+    assert not trace.enabled()
+    s = trace.span("x", k=1)
+    assert s is trace.span("y"), "disabled span must be a shared singleton"
+    with s:
+        assert trace.current() is None
+    assert trace.get_spans() == []
+    assert trace.snapshot() == {"enabled": False, "spans": []}
+
+
+def test_span_nesting_and_linkage():
+    _tracing_on()
+    with trace.span("outer", phase="a") as outer:
+        assert trace.current() == (outer.trace_id, outer.span_id)
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert trace.current() is None, "stack must unwind"
+    names = [s["name"] for s in trace.get_spans()]
+    assert names == ["inner", "outer"], "children record before parents"
+    outer_rec = trace.get_spans()[1]
+    assert outer_rec["attrs"] == {"phase": "a"}
+    assert outer_rec["parent_id"] is None
+    assert outer_rec["dur"] >= 0
+
+
+def test_sibling_traces_get_distinct_ids():
+    _tracing_on()
+    with trace.span("a"):
+        pass
+    with trace.span("b"):
+        pass
+    a, b = trace.get_spans()
+    assert a["trace_id"] != b["trace_id"]
+
+
+def test_ring_buffer_caps_memory():
+    _tracing_on(capacity=8)
+    for n in range(30):
+        with trace.span(f"s{n}"):
+            pass
+    spans = trace.get_spans()
+    assert len(spans) == 8, "ring must evict oldest"
+    assert [s["name"] for s in spans] == [f"s{n}" for n in range(22, 30)]
+
+
+def test_span_records_exception_type():
+    _tracing_on()
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("x")
+    assert trace.get_spans()[-1]["attrs"]["error"] == "ValueError"
+
+
+def test_record_event_emits_span():
+    from paddle_tpu.core import profiler
+
+    _tracing_on()
+    with profiler.RecordEvent("annotated"):
+        pass
+    assert any(s["name"] == "annotated" for s in trace.get_spans())
+
+
+# ---------------------------------------------------------------------------
+# cross-wire propagation (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_wire_round_trip_joins_one_trace():
+    """Acceptance: a traced round-trip produces a client span and a
+    server span sharing one trace id, the server's parent being the
+    client span; both latency histograms fill; trace_dump scrapes it."""
+    _tracing_on()
+    monitor.reset_stats("wire/")
+    srv = _Echo().start()
+    c = FrameClient(srv.endpoint, {"echo": 1}, service="test", timeout=5.0)
+    assert c._request("echo", {"x": 7})[0]["echo"] == 7
+
+    spans = trace.get_spans()
+    client = [s for s in spans if s["name"] == "wire/test.echo"]
+    server = [s for s in spans if s["name"] == "wire/_Echo.echo"]
+    assert len(client) == 1 and len(server) == 1
+    assert client[0]["trace_id"] == server[0]["trace_id"]
+    assert server[0]["parent_id"] == client[0]["span_id"]
+    assert client[0]["tid"] != server[0]["tid"]
+
+    hists = monitor.export_histograms("wire/")
+    assert hists["wire/op_latency_s/test.echo"]["count"] == 1
+    assert hists["wire/server_latency_s/_Echo.echo"]["count"] == 1
+
+    # remote scrape returns the same spans (server shares the process
+    # tracer here; the op itself is what obs_dump uses cross-process)
+    dump = c.trace_dump()
+    assert dump["enabled"] and dump["service"] == "_Echo"
+    assert {s["span_id"] for s in dump["spans"]} >= {
+        client[0]["span_id"], server[0]["span_id"]}
+    c.close()
+    srv.stop()
+
+
+def test_untraced_client_headers_are_clean():
+    """With FLAGS_trace off no trace keys ride the wire."""
+    captured = {}
+
+    class _Capture(FrameService):
+        def _dispatch(self, sock, op, header, payload):
+            captured.update(header)
+            send_frame(sock, 0, {})
+            return True
+
+    srv = _Capture().start()
+    c = FrameClient(srv.endpoint, {"go": 1}, timeout=5.0)
+    c._request("go", {"x": 1})
+    assert "tr" not in captured and "sp" not in captured
+    c.close()
+    srv.stop()
+
+
+def test_trace_dump_clear_drains_server_buffer():
+    _tracing_on()
+    srv = _Echo().start()
+    c = FrameClient(srv.endpoint, {"echo": 1}, service="t", timeout=5.0)
+    c._request("echo", {})
+    assert c.trace_dump(clear=True)["spans"]
+    # buffer now holds only spans recorded after the drain (the dump
+    # request itself lands post-snapshot)
+    remaining = {s["name"] for s in c.trace_dump()["spans"]}
+    assert "wire/t.echo" not in remaining
+    c.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# histograms + exporters (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles():
+    monitor.reset_stats("t/")
+    for v in [0.001] * 50 + [0.010] * 45 + [0.100] * 5:
+        monitor.observe("t/lat_s", v)
+    h = monitor.get_histogram("t/lat_s")
+    assert h["count"] == 100
+    assert h["sum"] == pytest.approx(1.0)
+    assert h["min"] == pytest.approx(0.001)
+    assert h["max"] == pytest.approx(0.100)
+    assert h["p50"] <= h["p95"] <= h["p99"] <= h["max"]
+    assert 0.0005 <= h["p50"] <= 0.002
+    assert 0.005 <= h["p95"] <= 0.02
+    assert monitor.get_histogram("t/never") is None
+    monitor.reset_stats("t/")
+    assert monitor.get_histogram("t/lat_s") is None, "reset clears hists"
+
+
+def test_export_prometheus_emits_wire_quantiles():
+    """Acceptance: export_prometheus() carries histogram quantiles for
+    wire/* op latency after a traced round-trip."""
+    _tracing_on()
+    monitor.reset_stats("wire/")
+    srv = _Echo().start()
+    with FrameClient(srv.endpoint, {"echo": 1}, service="svc",
+                     timeout=5.0) as c:
+        c._request("echo", {})
+    srv.stop()
+    text = monitor.export_prometheus("wire/")
+    assert 'wire_op_latency_s_svc_echo{quantile="0.5"}' in text
+    assert 'wire_op_latency_s_svc_echo{quantile="0.99"}' in text
+    assert "wire_op_latency_s_svc_echo_count 1" in text
+    assert "# TYPE wire_op_latency_s_svc_echo summary" in text
+
+
+def test_export_chrome_is_valid_json(tmp_path):
+    """Acceptance: export_chrome output is valid JSON with well-formed
+    Chrome trace events."""
+    _tracing_on()
+    with trace.span("parent", step=1):
+        with trace.span("child"):
+            pass
+    path = str(tmp_path / "trace.json")
+    trace.export_chrome(path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for e in events:
+        assert e["ph"] == "X"
+        assert set(e) >= {"name", "ts", "dur", "pid", "tid", "args"}
+        assert e["args"]["trace_id"]
+    child = next(e for e in events if e["name"] == "child")
+    parent = next(e for e in events if e["name"] == "parent")
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    assert parent["args"]["step"] == 1
+
+
+def test_obs_dump_merges_endpoints(tmp_path):
+    """tools/obs_dump.py probes two live services and writes one merged
+    Chrome trace with per-endpoint pids."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_dump", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                 "obs_dump.py"))
+    obs_dump = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_dump)
+    _tracing_on()
+    a, b = _Echo().start(), _Echo().start()
+    with FrameClient(a.endpoint, {"echo": 1}, timeout=5.0) as c:
+        c._request("echo", {})
+    out = str(tmp_path / "fleet.json")
+    rc = obs_dump.main([a.endpoint, b.endpoint, "-o", out,
+                        "--stats-prefix", "wire/"])
+    assert rc == 0
+    with open(out) as f:
+        doc = json.load(f)
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids >= {1, 2}, "each endpoint gets its own pid"
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "process_name" in names
+    a.stop()
+    b.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: health stats prefix, JSON logs, StepTimer lock
+# ---------------------------------------------------------------------------
+
+def test_health_stats_prefix_filters_payload():
+    monitor.reset_stats()
+    monitor.stat_add("wire/x", 1)
+    monitor.stat_add("ckpt/y", 2)
+    srv = _Echo().start()
+    with FrameClient(srv.endpoint, {}, timeout=5.0) as probe:
+        full = probe.health()
+        wire_only = probe.health(stats_prefix="wire/")
+        none = probe.health(stats_prefix="no-such-prefix/")
+    assert "ckpt/y" in full["stats"]
+    assert "wire/x" in wire_only["stats"]
+    assert not any(not k.startswith("wire/") for k in wire_only["stats"])
+    assert none["stats"] == {}
+    # the filtered probe still carries the load fields
+    assert wire_only["status"] == "ok" and "inflight" in wire_only
+    srv.stop()
+
+
+def test_log_json_mode_correlates_with_trace(capsys):
+    from paddle_tpu.core import logging as plog
+
+    _tracing_on()
+    records = []
+
+    class _Sink(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    sink = _Sink()
+    logger = plog.get_logger()
+    logger.addHandler(sink)
+    try:
+        set_flags({"log_json": True})
+        with trace.span("op") as sp:
+            plog.info("inside %s", "span")
+        plog.warning("outside")
+    finally:
+        set_flags({"log_json": False})
+        logger.removeHandler(sink)
+    inside = json.loads(records[0])
+    outside = json.loads(records[1])
+    assert inside["msg"] == "inside span"
+    assert inside["level"] == "INFO"
+    assert inside["trace_id"] == sp.trace_id
+    assert inside["span_id"] == sp.span_id
+    assert isinstance(inside["ts"], float)
+    assert outside["level"] == "WARNING" and "trace_id" not in outside
+
+
+def test_step_timer_concurrent_ticks():
+    """The PR-2 era StepTimer mutated its window list unlocked; hammer it
+    from threads and assert the window stays consistent."""
+    monitor.reset_stats("race/")
+    t = monitor.StepTimer("race", window=8)
+    errors = []
+
+    def hammer():
+        try:
+            for _ in range(500):
+                t.tick(tokens=4)
+        except Exception as e:              # noqa: BLE001 - collected
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errors
+    assert monitor.get_stat("race/steps") == 2000
+    assert len(t._ticks) == t.window + 1, "window must not over/undergrow"
+    assert monitor.get_stat("race/steps_per_sec") > 0

@@ -15,6 +15,10 @@ Scenarios (all CPU-only, single process):
    shed with the retryable status code 2, every client succeeds after
    backoff, the health op answers throughout, and ``drain()`` finishes
    in-flight work before severing.
+5. **obs**: with ``FLAGS_trace`` on, a wire exchange under fault
+   injection + an admission-cap shed records spans for the round-trip,
+   the retries, and the shed waits — one trace id joins client and
+   server — and the Chrome export parses as valid JSON.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -38,7 +42,7 @@ import numpy as np       # noqa: E402
 
 import paddle_tpu                                        # noqa: E402
 from paddle_tpu import io, nn                            # noqa: E402
-from paddle_tpu.core import fault, monitor               # noqa: E402
+from paddle_tpu.core import fault, monitor, trace        # noqa: E402
 from paddle_tpu.core.flags import get_flags, set_flags   # noqa: E402
 
 CHECKS: list[tuple[str, bool, str]] = []
@@ -53,6 +57,9 @@ def check_defaults_off() -> None:
                    "wire_timeout_s", "ckpt_manifest"])
     check("defaults/injection_off", f["fault_inject"] == ""
           and not fault.enabled(), str(f))
+    t = get_flags(["trace", "log_json"])
+    check("defaults/trace_off", not t["trace"] and not trace.enabled()
+          and not t["log_json"], str(t))
     check("defaults/deadline_finite", f["wire_timeout_s"] > 0, str(f))
     o = get_flags(["wire_max_inflight", "wire_max_conns",
                    "wire_server_idle_s", "ps_barrier_timeout_s"])
@@ -196,12 +203,94 @@ def scenario_overload(tmp: str) -> None:
     check("overload/drain_clean", srv.drain(5.0) is True)
 
 
+def scenario_obs(tmp: str) -> None:
+    import threading
+    import time
+
+    class _SlowPredictor:
+        input_specs = output_specs = []
+
+        def run(self, x):
+            time.sleep(0.03)
+            return np.asarray(x)
+
+    srv = io.InferenceServer()
+    srv.add_model("slow", _SlowPredictor())
+    srv.start()
+    set_flags({"trace": True, "wire_backoff_max_s": 0.2})
+    monitor.reset_stats("wire/")
+    trace.clear()
+    try:
+        x = np.ones((4,), np.float32)
+        client = io.InferenceClient(srv.endpoint, timeout=10.0, retries=32)
+
+        # 1. retries under fault injection leave wire/retry_wait spans
+        with fault.inject_faults({"wire.send": (1.0, 2)}, seed=7):
+            client.infer("slow", x)
+
+        # 2. an admission-cap burst leaves wire/shed_wait spans
+        set_flags({"wire_max_inflight": 1})
+        gate = threading.Barrier(3)
+        errors = []
+
+        def worker():
+            c = io.InferenceClient(srv.endpoint, timeout=10.0, retries=32)
+            try:
+                gate.wait()
+                c.infer("slow", x)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        set_flags({"wire_max_inflight": 0})
+
+        spans = trace.get_spans()
+        names = [s["name"] for s in spans]
+        check("obs/burst_recovered", not errors, repr(errors[:2]))
+        check("obs/retry_spans_recorded",
+              names.count("wire/retry_wait") >= 2, str(names))
+        check("obs/shed_spans_recorded", "wire/shed_wait" in names,
+              str(names))
+        clients = [s for s in spans if s["name"] == "wire/serving.infer"]
+        servers = [s for s in spans
+                   if s["name"] == "wire/InferenceServer.infer"]
+        joined = {s["trace_id"] for s in clients} & {
+            s["trace_id"] for s in servers}
+        check("obs/cross_wire_trace_joined", len(joined) >= 1,
+              f"{len(clients)} client / {len(servers)} server spans")
+        check("obs/predict_spans_nested",
+              any(s["name"] == "serving/predict" for s in spans))
+
+        out = os.path.join(tmp, "chaos_trace.json")
+        trace.export_chrome(out)
+        with open(out) as f:
+            doc = json.load(f)
+        check("obs/chrome_export_parses",
+              len(doc["traceEvents"]) >= len(spans))
+        prom = monitor.export_prometheus("wire/")
+        check("obs/prometheus_quantiles",
+              'quantile="0.99"' in prom and "wire_op_latency_s" in prom)
+        client.stop_server()
+        client.close()
+    finally:
+        set_flags({"trace": False, "wire_max_inflight": 0,
+                   "wire_backoff_max_s": 2.0})
+        srv.stop()
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
         os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
         for scenario in (scenario_serving_wire, scenario_checkpoint,
-                         scenario_elastic_resume, scenario_overload):
+                         scenario_elastic_resume, scenario_overload,
+                         scenario_obs):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a

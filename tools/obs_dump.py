@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Fleet observability scraper: probe N wire endpoints, pull their span
+ring buffers (the never-shed ``trace_dump`` op) and health/stats, merge
+everything into ONE Chrome trace keyed by trace id, and optionally emit
+the combined Prometheus text.
+
+Any frame-protocol service qualifies — InferenceServer, ParameterServer,
+HeterWorker, FSService — because ``trace_dump`` (like ``health``) is
+served by ``FrameService`` itself, outside every subclass op table.
+Spans that crossed the wire share a trace id, so a client request
+scraped from one endpoint joins its server-side half scraped from
+another: load the output in ``chrome://tracing`` / Perfetto and the
+fleet-wide request timeline reads as one picture (the reference's
+``tools/timeline.py`` multi-profile merge, live over the wire instead of
+from profile dumps).
+
+Usage::
+
+    python tools/obs_dump.py HOST:PORT [HOST:PORT ...] \
+        [-o fleet_trace.json] [--clear] [--stats-prefix wire/] [--prom]
+
+Exits nonzero if every endpoint is unreachable; unreachable endpoints
+are reported and skipped (a fleet dump must not die because one node
+did).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.core import trace  # noqa: E402
+from paddle_tpu.core.wire import FrameClient  # noqa: E402
+
+
+def scrape(endpoint: str, *, clear: bool, stats_prefix: str | None,
+           timeout: float) -> dict:
+    """One endpoint → {service, health, spans}; raises on wire errors."""
+    # empty op table: health/trace_dump are universal FrameService ops
+    with FrameClient(endpoint, {}, service="obs", timeout=timeout,
+                     retries=0) as client:
+        health = client.health(stats_prefix)
+        dump = client.trace_dump(clear)
+    return {"endpoint": endpoint,
+            "service": dump.get("service", "?"),
+            "tracing": dump.get("enabled", False),
+            "health": health,
+            "spans": dump.get("spans", [])}
+
+
+def merge_chrome(scrapes: list[dict]) -> dict:
+    """All endpoints' spans → one Chrome trace document, one pid per
+    endpoint (named), events sorted by wall-clock so shared trace ids
+    line up across processes."""
+    events: list[dict] = []
+    for pid, s in enumerate(scrapes, start=1):
+        events.extend(trace.to_chrome_events(
+            s["spans"], pid=pid,
+            pid_name=f"{s['service']} {s['endpoint']}"))
+    # metadata events (ph: M) carry no ts; keep them first
+    events.sort(key=lambda e: e.get("ts", -1.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("endpoints", nargs="+", metavar="HOST:PORT")
+    ap.add_argument("-o", "--out", default="fleet_trace.json",
+                    help="merged Chrome-trace output path")
+    ap.add_argument("--clear", action="store_true",
+                    help="drain each server's span buffer after scraping")
+    ap.add_argument("--stats-prefix", default=None,
+                    help="only ship stats under this prefix (e.g. wire/)")
+    ap.add_argument("--prom", action="store_true",
+                    help="also print THIS process' registry as Prometheus "
+                         "text (remote stats ride the health snapshots)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    scrapes, failed = [], []
+    for ep in args.endpoints:
+        try:
+            scrapes.append(scrape(ep, clear=args.clear,
+                                  stats_prefix=args.stats_prefix,
+                                  timeout=args.timeout))
+        except (ConnectionError, RuntimeError, OSError) as e:
+            failed.append({"endpoint": ep,
+                           "error": f"{type(e).__name__}: {e}"})
+    if not scrapes:
+        print(json.dumps({"ok": False, "failed": failed}, indent=2))
+        return 1
+
+    doc = merge_chrome(scrapes)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+
+    traces: set[str] = set()
+    joined: set[str] = set()       # trace ids seen on >1 endpoint
+    for s in scrapes:
+        mine = {sp["trace_id"] for sp in s["spans"]}
+        joined |= traces & mine
+        traces |= mine
+    report = {
+        "ok": True,
+        "out": args.out,
+        "endpoints": [{
+            "endpoint": s["endpoint"], "service": s["service"],
+            "tracing": s["tracing"], "spans": len(s["spans"]),
+            "status": s["health"]["status"],
+            "inflight": s["health"]["inflight"],
+        } for s in scrapes],
+        "failed": failed,
+        "trace_ids": len(traces),
+        "cross_endpoint_trace_ids": len(joined),
+        "events": len(doc["traceEvents"]),
+    }
+    print(json.dumps(report, indent=2))
+    if args.prom:
+        from paddle_tpu.core.monitor import export_prometheus
+
+        print(export_prometheus())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
