@@ -133,6 +133,23 @@ define_flag("ps_barrier_timeout_s", 120.0,
             "Server-side wait bound for the PS generation barrier; the "
             "client's barrier request deadline tracks it +10s. "
             "<= 0 waits forever")
+# --- serving at scale (paddle_tpu/serving: batcher + router) ---
+define_flag("serving_batch_max", 0,
+            "Cross-request dynamic batching in InferenceServer: max rows "
+            "(batch-axis elements) coalesced into one Predictor run. "
+            "0 or 1 — the default — disables batching entirely; the "
+            "serving path is then byte-identical to the unbatched one "
+            "(one flag read per infer, the FLAGS_trace pattern). Only "
+            "models exported with dynamic_batch=True participate")
+define_flag("serving_batch_timeout_s", 0.005,
+            "How long an infer request may wait for co-batchable requests "
+            "before the partial batch is flushed (the Orca/Clipper-style "
+            "batching window). Only read when serving_batch_max > 1")
+define_flag("serving_probe_interval_s", 1.0,
+            "Health-probe cadence of serving.RoutedClient: each replica's "
+            "universal health op is polled this often to drive routed "
+            "membership (unreachable/draining replicas stop receiving "
+            "new requests; recovered ones rejoin)")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

@@ -25,8 +25,9 @@ from typing import Any
 
 __all__ = ["StatRegistry", "stats", "stat_add", "stat_set", "get_stat",
            "observe", "get_histogram", "export_stats", "export_histograms",
-           "export_prometheus", "reset_stats", "StepTimer",
-           "device_memory_stats", "host_rss_bytes", "host_peak_rss_bytes"]
+           "export_prometheus", "merge_histograms", "reset_stats",
+           "StepTimer", "device_memory_stats", "host_rss_bytes",
+           "host_peak_rss_bytes"]
 
 
 # Fixed log-spaced histogram buckets: 3 per decade from 1e-7 to 1e+3
@@ -80,12 +81,39 @@ class _Histogram:
                 return lo * (hi / lo) ** frac
         return self.max
 
-    def summary(self) -> dict[str, float]:
-        return {"count": self.count, "sum": self.sum,
-                "min": self.min if self.count else 0.0,
-                "max": self.max if self.count else 0.0,
-                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
-                "p99": self.quantile(0.99)}
+    def summary(self, raw: bool = False) -> dict[str, Any]:
+        doc: dict[str, Any] = {
+            "count": self.count, "sum": self.sum,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+            "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99)}
+        if raw:
+            # bucket counts ride along so histograms from different
+            # processes can be MERGED (fixed bounds make counts addable)
+            # instead of having their quantiles averaged, which is wrong
+            doc["buckets"] = list(self.counts)
+        return doc
+
+    @classmethod
+    def from_raw(cls, doc: dict[str, Any]) -> "_Histogram":
+        h = cls()
+        buckets = list(doc.get("buckets") or ())
+        if len(buckets) == len(h.counts):
+            h.counts = [int(c) for c in buckets]
+        h.sum = float(doc.get("sum", 0.0))
+        h.count = int(doc.get("count", 0))
+        if h.count:
+            h.min = float(doc.get("min", 0.0))
+            h.max = float(doc.get("max", 0.0))
+        return h
+
+    def merge(self, other: "_Histogram") -> None:
+        self.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        self.sum += other.sum
+        self.count += other.count
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
 
 
 class StatRegistry:
@@ -132,10 +160,11 @@ class StatRegistry:
             return {k: v for k, v in self._stats.items()
                     if k.startswith(prefix)}
 
-    def export_histograms(self, prefix: str | None = None
-                          ) -> dict[str, dict[str, float]]:
+    def export_histograms(self, prefix: str | None = None,
+                          raw: bool = False
+                          ) -> dict[str, dict[str, Any]]:
         with self._lock:
-            return {k: h.summary() for k, h in self._hists.items()
+            return {k: h.summary(raw) for k, h in self._hists.items()
                     if prefix is None or k.startswith(prefix)}
 
     def reset(self, prefix: str | None = None) -> None:
@@ -179,9 +208,25 @@ def export_stats(prefix: str | None = None) -> dict[str, float]:
     return stats.export(prefix)
 
 
-def export_histograms(prefix: str | None = None
-                      ) -> dict[str, dict[str, float]]:
-    return stats.export_histograms(prefix)
+def export_histograms(prefix: str | None = None, raw: bool = False
+                      ) -> dict[str, dict[str, Any]]:
+    """Histogram summaries from the global registry. ``raw=True`` adds
+    each histogram's fixed-bound bucket counts so snapshots from
+    different processes can be combined with :func:`merge_histograms`
+    (the wire ``health`` op ships these to fleet scrapers)."""
+    return stats.export_histograms(prefix, raw)
+
+
+def merge_histograms(docs: list[dict[str, Any]],
+                     raw: bool = False) -> dict[str, Any]:
+    """Merge raw histogram snapshots (``export_histograms(raw=True)``
+    entries, e.g. one per fleet endpoint) into a single summary with
+    exact combined quantiles — possible because every process shares the
+    same fixed log-spaced bucket bounds."""
+    merged = _Histogram()
+    for doc in docs:
+        merged.merge(_Histogram.from_raw(doc))
+    return merged.summary(raw)
 
 
 def reset_stats(prefix: str | None = None) -> None:

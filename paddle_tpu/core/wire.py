@@ -28,11 +28,22 @@ from typing import Any, Iterable
 from paddle_tpu.core import fault as _fault
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
-from paddle_tpu.core.monitor import export_stats, observe, stat_add
+from paddle_tpu.core.monitor import (
+    export_histograms, export_stats, observe, stat_add,
+)
 
 __all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
            "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES", "CODE_SHED",
-           "HEALTH_OP", "TRACE_OP"]
+           "HEALTH_OP", "TRACE_OP", "WireShedError"]
+
+
+class WireShedError(RuntimeError):
+    """A request exhausted its shed-retry budget: every attempt was
+    turned away by the server's admission control (:data:`CODE_SHED`)
+    before execution. Subclasses RuntimeError for compatibility; typed
+    so routers can treat "this replica is overloaded" differently from
+    "this request failed" — the request is safe to re-issue anywhere
+    (it never ran)."""
 
 # Response status codes. 0 = ok, 1 = error (request ran or was malformed).
 # CODE_SHED rejections happen BEFORE execution (admission control, drain,
@@ -190,7 +201,8 @@ class FrameService:
                             # served here, never by subclasses — and
                             # never shed: probes must answer under load
                             send_frame(sock, 0, outer.health(
-                                header.get("stats_prefix")))
+                                header.get("stats_prefix"),
+                                bool(header.get("histograms"))))
                             continue
                         if op == TRACE_OP:
                             # span scrape: never shed either (observing
@@ -305,13 +317,17 @@ class FrameService:
         return doc
 
     # -- health ------------------------------------------------------------
-    def health(self, stats_prefix: str | None = None) -> dict:
+    def health(self, stats_prefix: str | None = None,
+               histograms: bool = False) -> dict:
         """Uniform liveness/load snapshot, also served to any client as
         op :data:`HEALTH_OP` (``FrameClient.health()``). ``stats_prefix``
         (probe-header ``stats_prefix``) filters the monitor-stats
         snapshot so high-frequency pollers don't ship every counter each
         probe (``""`` still means everything; pass a non-matching prefix
-        for none)."""
+        for none). ``histograms`` (probe-header ``histograms``) adds the
+        matching latency histograms with raw bucket counts, so fleet
+        scrapers (``tools/obs_dump.py``) can merge distributions across
+        endpoints instead of averaging quantiles."""
         if stats_prefix is not None:
             stats_prefix = str(stats_prefix)   # header value is untrusted
         with self._load_cv:
@@ -319,7 +335,7 @@ class FrameService:
             draining = self._draining or self._stopping
         with self._conns_lock:
             conns = len(self._conns)
-        return {
+        doc = {
             "status": "draining" if draining else "ok",
             "service": type(self).__name__,
             "endpoint": self.endpoint,
@@ -331,6 +347,9 @@ class FrameService:
                          if self._started is not None else 0.0),
             "stats": export_stats(stats_prefix),
         }
+        if histograms:
+            doc["histograms"] = export_histograms(stats_prefix, raw=True)
+        return doc
 
     # -- lifecycle ---------------------------------------------------------
     def _stop_accepting(self) -> None:
@@ -422,6 +441,11 @@ class FrameClient:
                          else int(retries))
         self._idempotent = frozenset(idempotent)
         self._lock = threading.Lock()
+        # Per-op in-flight counts (requests submitted but not yet
+        # answered, INCLUDING ones queued on the connection lock): the
+        # load signal serving.RoutedClient balances replicas on.
+        self._inflight_lock = threading.Lock()
+        self._inflight_by_op: dict[str, int] = {}
         self._ops = ops
         self._service = service
         self._closed = False
@@ -467,14 +491,33 @@ class FrameClient:
                 or getattr(e, "errno", None) in (errno.EAGAIN,
                                                  errno.EWOULDBLOCK))
 
-    def health(self, stats_prefix: str | None = None) -> dict:
+    @property
+    def inflight(self) -> int:
+        """Requests currently submitted through this client and not yet
+        answered (executing or queued on the connection)."""
+        with self._inflight_lock:
+            return sum(self._inflight_by_op.values())
+
+    def inflight_by_op(self) -> dict[str, int]:
+        """Snapshot of the per-op in-flight counts (ops at zero are
+        omitted)."""
+        with self._inflight_lock:
+            return {k: v for k, v in self._inflight_by_op.items() if v}
+
+    def health(self, stats_prefix: str | None = None,
+               histograms: bool = False) -> dict:
         """Probe the server's universal health op (:data:`HEALTH_OP`,
         served by ``FrameService`` itself for every service): liveness,
         in-flight/connection depth, drain status, uptime, stats.
         ``stats_prefix`` asks the server to filter the stats snapshot
-        (high-frequency pollers shouldn't ship every counter)."""
-        header = ({} if stats_prefix is None
-                  else {"stats_prefix": stats_prefix})
+        (high-frequency pollers shouldn't ship every counter);
+        ``histograms`` also ships the matching raw-bucket histograms
+        (mergeable across endpoints — see ``monitor.merge_histograms``)."""
+        header: dict[str, Any] = {}
+        if stats_prefix is not None:
+            header["stats_prefix"] = stats_prefix
+        if histograms:
+            header["histograms"] = True
         return self._request("health", header, idempotent=True)[0]
 
     def trace_dump(self, clear: bool = False) -> dict:
@@ -501,15 +544,22 @@ class FrameClient:
                 opnum = TRACE_OP
             else:
                 raise
-        # Tracing (FLAGS_trace, hard-off default — this is the only
-        # check the fast path pays): one client span covers the whole
-        # logical request including retries, and its ids ride the header
-        # so the server links its span into the same trace.
-        if _trace._ACTIVE is not None:
-            return self._traced_request(op, opnum, header, payload,
-                                        idempotent, timeout)
-        return self._request_inner(op, opnum, header, payload, idempotent,
-                                   timeout)
+        with self._inflight_lock:
+            self._inflight_by_op[op] = self._inflight_by_op.get(op, 0) + 1
+        try:
+            # Tracing (FLAGS_trace, hard-off default — this is the only
+            # check the fast path pays beyond the inflight count): one
+            # client span covers the whole logical request including
+            # retries, and its ids ride the header so the server links
+            # its span into the same trace.
+            if _trace._ACTIVE is not None:
+                return self._traced_request(op, opnum, header, payload,
+                                            idempotent, timeout)
+            return self._request_inner(op, opnum, header, payload,
+                                       idempotent, timeout)
+        finally:
+            with self._inflight_lock:
+                self._inflight_by_op[op] -= 1
 
     def _traced_request(self, op, opnum, header, payload, idempotent,
                         timeout):
@@ -591,7 +641,7 @@ class FrameClient:
                         self._close_locked()   # server is hanging up
                     sheds += 1
                     if sheds >= shed_budget:
-                        raise RuntimeError(
+                        raise WireShedError(
                             f"{self._service} {op} shed by {self.endpoint} "
                             f"after {sheds} attempt(s): "
                             f"{rheader.get('error')}")

@@ -33,19 +33,42 @@ _ARTIFACT = "model.stablehlo"
 _META = "meta.json"
 
 
-def _export(fn: Callable, example_args: Sequence):
-    specs = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype),
-        tuple(example_args))
+def _export(fn: Callable, example_args: Sequence,
+            dynamic_batch: bool = False):
+    if dynamic_batch:
+        # One shared symbol ties every input's leading dim: callers pass
+        # any batch size, but all inputs must agree on it.
+        (b,) = jax_export.symbolic_shape("b")
+
+        def spec(a):
+            shape = jnp.shape(a)
+            if not shape:
+                raise ValueError(
+                    "dynamic_batch=True requires every input to have a "
+                    "leading batch axis; got a scalar input")
+            return jax.ShapeDtypeStruct((b,) + tuple(shape[1:]),
+                                        jnp.asarray(a).dtype)
+    else:
+        def spec(a):
+            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
+
+    specs = jax.tree_util.tree_map(spec, tuple(example_args))
     return jax_export.export(jax.jit(fn))(*specs)
 
 
+def _dim(d) -> int | None:
+    """Meta-file dim: symbolic dims (dynamic batch) serialize as null."""
+    return int(d) if isinstance(d, int) else None
+
+
 def export_function(fn: Callable, example_args: Sequence,
-                    path: str | None = None) -> bytes:
+                    path: str | None = None, *,
+                    dynamic_batch: bool = False) -> bytes:
     """Serialize ``jit(fn)`` at the example arguments' shapes/dtypes to a
     portable StableHLO artifact (bytes; also written to ``path`` if
-    given)."""
-    data = _export(fn, example_args).serialize()
+    given). ``dynamic_batch`` exports the leading axis of every argument
+    as one shared symbolic dimension."""
+    data = _export(fn, example_args, dynamic_batch).serialize()
     if path is not None:
         with open(path, "wb") as f:
             f.write(data)
@@ -53,12 +76,19 @@ def export_function(fn: Callable, example_args: Sequence,
 
 
 def save_inference_model(path: str, model, example_inputs: Sequence,
-                         *, forward: Callable | None = None) -> None:
+                         *, forward: Callable | None = None,
+                         dynamic_batch: bool = False) -> None:
     """Save ``model``'s forward as a self-contained inference artifact.
 
     ``forward(model, *inputs)`` defaults to ``model(*inputs)``. Weights
     are baked into the artifact as constants — the saved directory is the
     complete deployable unit (reference ``fluid/io.py:1411`` semantics).
+
+    ``dynamic_batch=True`` exports the leading axis of every input as one
+    shared *symbolic* dimension, so the Predictor accepts any batch size
+    (each distinct size compiles once, so pair it with bucketing — the
+    serving batcher does). Required for a model to participate in
+    cross-request dynamic batching (``FLAGS_serving_batch_max``).
     """
     os.makedirs(path, exist_ok=True)
     fwd = forward if forward is not None else (lambda m, *xs: m(*xs))
@@ -67,19 +97,20 @@ def save_inference_model(path: str, model, example_inputs: Sequence,
         return fwd(model, *xs)
 
     example_inputs = tuple(example_inputs)
-    exported = _export(fn, example_inputs)   # one trace: avals come from it
+    # one trace: avals come from it
+    exported = _export(fn, example_inputs, dynamic_batch)
     data = exported.serialize()
     with open(os.path.join(path, _ARTIFACT), "wb") as f:
         f.write(data)
     meta = {
         "inputs": [
-            {"shape": list(jnp.shape(a)),
-             "dtype": str(jnp.asarray(a).dtype)}
-            for a in example_inputs],
+            {"shape": [_dim(d) for d in s.shape], "dtype": str(s.dtype)}
+            for s in exported.in_avals],
         "outputs": [
-            {"shape": list(s.shape), "dtype": str(s.dtype)}
+            {"shape": [_dim(d) for d in s.shape], "dtype": str(s.dtype)}
             for s in exported.out_avals],
         "format": "jax.export/stablehlo",
+        "dynamic_batch": bool(dynamic_batch),
         "artifact_bytes": len(data),
     }
     with open(os.path.join(path, _META), "w") as f:
@@ -105,10 +136,21 @@ class Predictor:
     def output_specs(self) -> list[dict]:
         return self.meta["outputs"]
 
+    @property
+    def supports_batching(self) -> bool:
+        """True when the artifact was exported with ``dynamic_batch`` and
+        every output carries the batch axis — i.e. a concatenated
+        multi-request batch can be run once and split back per request
+        (what the serving batcher needs)."""
+        return bool(self.meta.get("dynamic_batch")) and all(
+            s["shape"] and s["shape"][0] is None
+            for s in self.meta["outputs"])
+
     def run(self, *inputs) -> Any:
         """Execute on the current default device. Validates shapes AND
         dtypes against the saved specs (ZeroCopyRun-style explicit
-        contract) — no silent casting."""
+        contract) — no silent casting. A ``null`` spec dim (symbolic
+        batch axis of a ``dynamic_batch`` export) matches any size."""
         if len(inputs) != len(self.meta["inputs"]):
             raise ValueError(
                 f"expected {len(self.meta['inputs'])} inputs, "
@@ -117,7 +159,9 @@ class Predictor:
         for i, (x, spec) in enumerate(zip(inputs, self.meta["inputs"])):
             a = np.asarray(x)   # dtype checked pre-jnp: jnp.asarray would
             # silently downcast f64/i64 under the default x32 mode
-            if list(a.shape) != spec["shape"]:
+            if len(a.shape) != len(spec["shape"]) or any(
+                    e is not None and d != e
+                    for d, e in zip(a.shape, spec["shape"])):
                 raise ValueError(
                     f"input {i}: shape {list(a.shape)} != exported "
                     f"{spec['shape']}")

@@ -20,6 +20,7 @@ specs + buffers.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any
 
@@ -80,10 +81,14 @@ class InferenceServer(FrameService):
                  host: str = "127.0.0.1", port: int = 0,
                  admin_ops: bool | None = None):
         from paddle_tpu.io.export import Predictor
+        from paddle_tpu.serving.batcher import DynamicBatcher
 
         self._predictor_cls = Predictor
         self._models: dict[str, Any] = {}
         self._lock = threading.Lock()
+        # per-server coalescer; consulted only when FLAGS_serving_batch_max
+        # enables batching (one flag read per infer otherwise)
+        self._batcher = DynamicBatcher()
         for name, m in (models or {}).items():
             self.add_model(name, m)
         if admin_ops is None:
@@ -92,8 +97,27 @@ class InferenceServer(FrameService):
         super().__init__(host, port)
 
     def add_model(self, name: str, model) -> None:
-        pred = (model if not isinstance(model, str)
-                else self._predictor_cls(model))
+        """Register a Predictor (or construct one from a saved-model
+        path). A path is validated HERE — artifact + meta must exist and
+        deserialize — so a bad ``load_model`` fails at registration with
+        a wire error, not at some later caller's first ``infer``."""
+        if isinstance(model, str):
+            from paddle_tpu.io.export import _ARTIFACT, _META
+
+            for part in (_ARTIFACT, _META):
+                if not os.path.isfile(os.path.join(model, part)):
+                    raise ValueError(
+                        f"{model!r} is not an inference-model directory "
+                        f"(missing {part}); expected the layout written "
+                        "by save_inference_model")
+            try:
+                pred = self._predictor_cls(model)
+            except Exception as e:
+                raise ValueError(
+                    f"failed to load inference model from {model!r}: "
+                    f"{type(e).__name__}: {e}") from e
+        else:
+            pred = model
         with self._lock:
             self._models[name] = pred
 
@@ -133,10 +157,18 @@ class InferenceServer(FrameService):
                 raise KeyError(f"no model {header['model']!r}; loaded: "
                                f"{sorted(self._models)}")
             inputs = _unpack_arrays(header["inputs"], payload)
-            # nested under the wire server span: a traced request shows
-            # model time separate from framing/dispatch time
-            with _trace.span("serving/predict", model=header["model"]):
-                outs = pred.run(*inputs)
+            # Cross-request dynamic batching (FLAGS_serving_batch_max,
+            # hard-off default — this flag read is all the unbatched
+            # path pays): dynamic-batch models coalesce concurrent
+            # requests into one bucketed Predictor.run.
+            if (int(flag("serving_batch_max")) > 1
+                    and self._batcher.can_batch(pred)):
+                outs = self._batcher.submit(header["model"], pred, inputs)
+            else:
+                # nested under the wire server span: a traced request
+                # shows model time separate from framing/dispatch time
+                with _trace.span("serving/predict", model=header["model"]):
+                    outs = pred.run(*inputs)
             if not isinstance(outs, (tuple, list)):
                 outs = (outs,)
             specs, body = _pack_arrays(np.asarray(o) for o in outs)

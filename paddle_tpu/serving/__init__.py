@@ -1,0 +1,21 @@
+"""paddle_tpu.serving — serving at scale: cross-request dynamic batching
+and health-aware replica routing.
+
+Reference role: the Paddle Serving deployment tier around the inference
+engine — a fleet of ``AnalysisPredictor`` replicas behind a router
+(``inference/api/analysis_predictor.h:82``, ``inference/capi/
+pd_predictor.cc``). TPU-native formulation: the **server half**
+(:class:`~paddle_tpu.serving.batcher.DynamicBatcher`, wired into
+``io.InferenceServer``) coalesces concurrent ``infer`` requests for the
+same model into one bucketed ``Predictor.run`` — the Orca/Clipper-style
+micro-batching a TPU wants; the **client half**
+(:class:`~paddle_tpu.serving.router.RoutedClient`) spreads idempotent
+requests across N replicas by least-inflight pick with health-probe
+membership and shed/connect failover, so a replica kill degrades to the
+survivors instead of failing callers.
+"""
+
+from paddle_tpu.serving.batcher import DynamicBatcher
+from paddle_tpu.serving.router import ReplicaState, RoutedClient
+
+__all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState"]

@@ -1,0 +1,334 @@
+"""Health-aware client-side routing across inference-server replicas.
+
+Reference role: the load-balancing tier in front of a Paddle Serving
+fleet (N predictor-replica processes behind a router/BRPC channel
+group). Here it is a *client library*: :class:`RoutedClient` holds one
+:class:`~paddle_tpu.io.serving.InferenceClient` per replica endpoint and
+spreads idempotent requests across them:
+
+- **Least-inflight pick** — each wire client counts its submitted-but-
+  unanswered requests (``FrameClient.inflight``, per-op via
+  ``inflight_by_op()``); a request goes to the healthy replica with the
+  fewest, ties broken round-robin. Slow replicas shed load automatically
+  without any server cooperation.
+- **Health-probe membership** — a daemon thread probes every replica's
+  universal ``health`` op (never shed, answered even under overload)
+  every ``FLAGS_serving_probe_interval_s``; unreachable or *draining*
+  replicas stop receiving new requests and rejoin when the probe sees
+  ``ok`` again. ``add_endpoint``/``remove_endpoint`` change membership
+  live.
+- **Failover** — a connect error/timeout marks the replica down and the
+  request retries on the next pick; a :class:`~paddle_tpu.core.wire.
+  WireShedError` (admission control turned the request away *before*
+  execution) reroutes without marking the replica down. Both are safe
+  for the idempotent serving ops this client routes (``infer``,
+  ``list_models``, ``load_model``); the shed case is safe for any op.
+  Each failing replica is tried at most once per request; when every
+  member has failed, the last error surfaces.
+
+Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
+``serving/router/marked_down``, ``serving/router/recovered``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.core.wire import FrameClient, WireShedError
+from paddle_tpu.io.serving import InferenceClient
+
+__all__ = ["RoutedClient", "ReplicaState"]
+
+
+class ReplicaState:
+    """One replica's routing view: endpoint, a small connection pool
+    (lazy, rebuilt after failures), and probe-driven health.
+
+    The pool matters: one ``FrameClient`` serializes its requests behind
+    a connection lock, so a single shared connection could never present
+    concurrent same-model requests to the replica — exactly what the
+    server-side batcher coalesces. N pooled connections let one routed
+    client keep N requests in flight per replica."""
+
+    __slots__ = ("endpoint", "clients", "healthy", "last_error", "probes",
+                 "failures")
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self.clients: list[InferenceClient] = []
+        self.healthy = True           # optimistic until a probe/request
+        self.last_error: str | None = None
+        self.probes = 0
+        self.failures = 0
+
+    @property
+    def inflight(self) -> int:
+        return sum(c.inflight for c in self.clients)
+
+
+class RoutedClient:
+    """Route idempotent serving requests across replica endpoints.
+
+    ``endpoints`` may be empty at construction and grown later with
+    :meth:`add_endpoint`. Per-replica connections are built by
+    ``client_factory`` (default: ``InferenceClient(ep, timeout=timeout,
+    retries=retries)`` with ``retries=0`` so failover happens at the
+    router, not inside one replica's retry loop) and pooled up to
+    ``pool_size`` per replica — grown on demand when every pooled
+    connection is busy, so concurrent callers reach the replica
+    concurrently (a prerequisite for server-side batching to coalesce
+    them). ``probe_interval_s`` defaults to
+    ``FLAGS_serving_probe_interval_s``; pass 0 to disable background
+    probing (membership then only reacts to request errors).
+    """
+
+    def __init__(self, endpoints: list[str] | tuple[str, ...] = (), *,
+                 timeout: float | None = None, retries: int = 0,
+                 probe_interval_s: float | None = None,
+                 pool_size: int = 8,
+                 client_factory: Callable[[str], InferenceClient]
+                 | None = None):
+        self._factory = client_factory or (
+            lambda ep: InferenceClient(ep, timeout=timeout,
+                                       retries=retries))
+        self._timeout = timeout
+        self._pool_size = max(int(pool_size), 1)
+        self._lock = threading.Lock()
+        self._replicas: list[ReplicaState] = []
+        self._rr = 0                     # round-robin tie-breaker
+        self._closed = False
+        for ep in endpoints:
+            self.add_endpoint(ep)
+        if probe_interval_s is None:
+            probe_interval_s = float(flag("serving_probe_interval_s"))
+        self._probe_interval = float(probe_interval_s)
+        self._probe_stop = threading.Event()
+        self._prober: threading.Thread | None = None
+        if self._probe_interval > 0:
+            self._prober = threading.Thread(target=self._probe_loop,
+                                            daemon=True)
+            self._prober.start()
+
+    # -- membership --------------------------------------------------------
+    def add_endpoint(self, endpoint: str) -> None:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("RoutedClient is closed")
+            if any(r.endpoint == endpoint for r in self._replicas):
+                return
+            self._replicas.append(ReplicaState(endpoint))
+
+    def remove_endpoint(self, endpoint: str) -> None:
+        with self._lock:
+            keep, drop = [], []
+            for r in self._replicas:
+                (drop if r.endpoint == endpoint else keep).append(r)
+            self._replicas = keep
+        for r in drop:
+            self._close_clients(r)
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return [r.endpoint for r in self._replicas]
+
+    def members(self) -> list[dict]:
+        """Routing snapshot: one dict per replica (endpoint, healthy,
+        inflight, failures, last_error)."""
+        with self._lock:
+            return [{"endpoint": r.endpoint, "healthy": r.healthy,
+                     "inflight": r.inflight, "failures": r.failures,
+                     "last_error": r.last_error}
+                    for r in self._replicas]
+
+    # -- health probing ----------------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self._probe_interval):
+            try:
+                self.probe()
+            except Exception:      # pragma: no cover - prober never dies
+                pass
+
+    def probe(self) -> list[dict]:
+        """One probe round over current members (also runs on the
+        background thread): each replica's ``health`` op decides its
+        membership. Returns :meth:`members` afterwards."""
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            ok, err = self._probe_one(r.endpoint)
+            with self._lock:
+                if r not in self._replicas:    # removed mid-probe
+                    continue
+                r.probes += 1
+                was = r.healthy
+                r.healthy = ok
+                r.last_error = err
+                if ok and not was:
+                    stat_add("serving/router/recovered")
+        return self.members()
+
+    def _probe_one(self, endpoint: str) -> tuple[bool, str | None]:
+        """Probe via a short-lived dedicated connection: the data
+        client's lock may be held by a long infer, and a probe must
+        never queue behind the traffic it is assessing."""
+        timeout = self._timeout if self._timeout is not None else 5.0
+        try:
+            with FrameClient(endpoint, {}, service="probe",
+                             timeout=timeout, retries=0) as c:
+                h = c.health(stats_prefix="\x00none")
+            if h.get("status") != "ok":
+                return False, f"status={h.get('status')}"
+            return True, None
+        except (ConnectionError, RuntimeError, OSError) as e:
+            return False, f"{type(e).__name__}: {e}"
+
+    # -- routing core ------------------------------------------------------
+    def _pick(self, exclude: set[str], any_health: bool = False
+              ) -> ReplicaState | None:
+        """Healthy replica with the fewest in-flight requests (ties:
+        round-robin). ``any_health`` is the last resort — membership may
+        be stale and a 'down' replica may be back."""
+        with self._lock:
+            pool = [r for r in self._replicas
+                    if r.endpoint not in exclude
+                    and (any_health or r.healthy)]
+            if not pool:
+                return None
+            self._rr += 1
+            lo = min(r.inflight for r in pool)
+            ties = [r for r in pool if r.inflight == lo]
+            return ties[self._rr % len(ties)]
+
+    def _client(self, r: ReplicaState) -> InferenceClient:
+        """An idle pooled connection if one exists; grow the pool while
+        every connection is busy (up to ``pool_size``), then share the
+        least-loaded one."""
+        with self._lock:
+            idle = [c for c in r.clients if c.inflight == 0]
+            if idle:
+                return idle[0]
+            grow = len(r.clients) < self._pool_size
+            if not grow and r.clients:
+                return min(r.clients, key=lambda c: c.inflight)
+        client = self._factory(r.endpoint)   # connects; may raise
+        with self._lock:
+            if len(r.clients) < self._pool_size:
+                r.clients.append(client)
+                return client
+        client.close()                       # lost the race; pool full
+        with self._lock:
+            return min(r.clients, key=lambda c: c.inflight)
+
+    def _mark_down(self, r: ReplicaState, err: BaseException) -> None:
+        stat_add("serving/router/marked_down")
+        with self._lock:
+            r.healthy = False
+            r.failures += 1
+            r.last_error = f"{type(err).__name__}: {err}"
+        self._close_clients(r)
+
+    def _close_clients(self, r: ReplicaState) -> None:
+        with self._lock:
+            clients, r.clients = list(r.clients), []
+        for client in clients:
+            client.close()
+
+    def _routed(self, fn: Callable[[InferenceClient], object]):
+        """Run ``fn(client)`` on the best replica, failing over across
+        members: connect errors mark the replica down, sheds just
+        reroute. Only pass idempotent operations."""
+        if self._closed:
+            raise ConnectionError("RoutedClient is closed")
+        tried: set[str] = set()
+        last: BaseException | None = None
+        for any_health in (False, True):
+            while True:
+                r = self._pick(tried, any_health)
+                if r is None:
+                    break
+                tried.add(r.endpoint)
+                try:
+                    out = fn(self._client(r))
+                    with self._lock:      # request-level health signal
+                        if not r.healthy:
+                            r.healthy = True
+                            stat_add("serving/router/recovered")
+                    return out
+                except WireShedError as e:
+                    # rejected BEFORE execution: replica is overloaded
+                    # or draining, not dead — reroute, don't mark down
+                    stat_add("serving/router/shed_rerouted")
+                    last = e
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    stat_add("serving/router/failovers")
+                    self._mark_down(r, e)
+                    last = e
+        if last is not None:
+            raise last
+        raise ConnectionError("no replicas available "
+                              f"(members: {self.endpoints()})")
+
+    # -- the routed serving surface ---------------------------------------
+    def infer(self, model: str, *inputs) -> list[np.ndarray]:
+        return self._routed(lambda c: c.infer(model, *inputs))
+
+    def list_models(self) -> dict:
+        return self._routed(lambda c: c.list_models())
+
+    def load_model(self, name: str, path: str,
+                   broadcast: bool = True) -> None:
+        """Hot-load on every healthy replica (``broadcast=True``,
+        default — replicas should serve the same model set) or on one."""
+        if not broadcast:
+            self._routed(lambda c: c.load_model(name, path))
+            return
+        errors = []
+        for r in list(self._replicas):
+            if not r.healthy:
+                continue
+            try:
+                self._client(r).load_model(name, path)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                errors.append(f"{r.endpoint}: {type(e).__name__}: {e}")
+        if errors:
+            raise RuntimeError("load_model failed on: " +
+                               "; ".join(errors))
+
+    def health(self) -> dict[str, dict]:
+        """endpoint -> server health snapshot (unreachable replicas map
+        to ``{"status": "unreachable", ...}``)."""
+        out = {}
+        for r in list(self._replicas):
+            ok, err = self._probe_one(r.endpoint)
+            if ok:
+                try:
+                    out[r.endpoint] = self._client(r).health()
+                    continue
+                except (ConnectionError, RuntimeError, OSError) as e:
+                    err = f"{type(e).__name__}: {e}"
+            out[r.endpoint] = {"status": "unreachable", "error": err}
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._probe_stop.set()
+        with self._lock:
+            self._closed = True
+            replicas, self._replicas = list(self._replicas), []
+        for r in replicas:
+            for client in r.clients:
+                client.close()
+        if self._prober is not None:
+            self._prober.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

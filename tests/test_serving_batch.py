@@ -1,0 +1,468 @@
+"""Serving at scale: cross-request dynamic batching + replica routing.
+
+Server half (``paddle_tpu/serving/batcher.py`` behind
+``io.InferenceServer``): coalescing, timeout flush, bucket-padding
+correctness vs unbatched outputs, defaults-off identity. Client half
+(``paddle_tpu/serving/router.py``): least-inflight pick, failover on a
+replica kill, shed-driven rebalance, live endpoint add/remove.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core import monitor
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.io import (
+    InferenceClient, InferenceServer, Predictor, save_inference_model,
+)
+from paddle_tpu.serving import DynamicBatcher, RoutedClient
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def dyn_mlp(tmp_path_factory):
+    """A dynamic-batch MLP artifact (symbolic leading dim)."""
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path_factory.mktemp("srvb") / "mlp")
+    save_inference_model(path, net, [np.zeros((2, 4), np.float32)],
+                         dynamic_batch=True)
+    return path
+
+
+@pytest.fixture
+def batching_flags():
+    """Enable batching for a test; always restore the hard-off default."""
+    def enable(batch_max=16, timeout_s=0.05):
+        set_flags({"serving_batch_max": batch_max,
+                   "serving_batch_timeout_s": timeout_s})
+    yield enable
+    set_flags({"serving_batch_max": 0, "serving_batch_timeout_s": 0.005})
+
+
+def _concurrent(n, fn):
+    gate = threading.Barrier(n)
+    errs = []
+
+    def run(i):
+        try:
+            gate.wait()
+            fn(i)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs, errs
+    return errs
+
+
+class _CountingPredictor:
+    """Delegates to a real dynamic Predictor but counts run() calls."""
+
+    supports_batching = True
+
+    def __init__(self, path):
+        self._pred = Predictor(path)
+        self.calls = 0
+        self.batch_sizes = []
+
+    @property
+    def input_specs(self):
+        return self._pred.input_specs
+
+    @property
+    def output_specs(self):
+        return self._pred.output_specs
+
+    def run(self, *inputs):
+        self.calls += 1
+        self.batch_sizes.append(int(inputs[0].shape[0]))
+        return self._pred.run(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# dynamic-batch export
+# ---------------------------------------------------------------------------
+
+def test_dynamic_batch_export_any_batch_size(dyn_mlp):
+    pred = Predictor(dyn_mlp)
+    assert pred.supports_batching
+    assert pred.input_specs[0]["shape"] == [None, 4]
+    assert pred.output_specs[0]["shape"] == [None, 3]
+    rs = np.random.RandomState(0)
+    x7 = rs.randn(7, 4).astype(np.float32)
+    y7 = np.asarray(pred.run(x7))
+    assert y7.shape == (7, 3)
+    # row-independent: per-row results match a per-row run
+    for i in (0, 3, 6):
+        np.testing.assert_allclose(
+            np.asarray(pred.run(x7[i:i + 1]))[0], y7[i], rtol=1e-5,
+            atol=1e-6)
+    # trailing dims still validated
+    with pytest.raises(ValueError, match="shape"):
+        pred.run(np.zeros((2, 5), np.float32))
+
+
+def test_static_export_unchanged(dyn_mlp, tmp_path):
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path / "static")
+    save_inference_model(path, net, [np.zeros((2, 4), np.float32)])
+    pred = Predictor(path)
+    assert not pred.supports_batching
+    assert pred.input_specs[0]["shape"] == [2, 4]
+    with pytest.raises(ValueError, match="shape"):
+        pred.run(np.zeros((3, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# batcher
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_concurrent_requests(dyn_mlp, batching_flags):
+    batching_flags(batch_max=16, timeout_s=0.05)
+    counting = _CountingPredictor(dyn_mlp)
+    srv = InferenceServer()
+    srv.add_model("m", counting)
+    srv.start()
+    ref = Predictor(dyn_mlp)
+    results = {}
+    try:
+        def worker(i):
+            with InferenceClient(srv.endpoint) as c:
+                x = np.full((1, 4), float(i), np.float32)
+                results[i] = c.infer("m", x)[0]
+
+        _concurrent(8, worker)
+    finally:
+        srv.stop()
+    # 8 concurrent single-row requests ran as FEWER predictor calls...
+    assert counting.calls < 8, counting.batch_sizes
+    assert sum(counting.batch_sizes) >= 8   # padding only adds rows
+    # ...and every caller got ITS rows back
+    for i, y in results.items():
+        np.testing.assert_allclose(
+            y, np.asarray(ref.run(np.full((1, 4), float(i), np.float32))),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_batch_timeout_flushes_partial_batch(dyn_mlp, batching_flags):
+    batching_flags(batch_max=64, timeout_s=0.02)
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    try:
+        with InferenceClient(srv.endpoint) as c:
+            t0 = time.perf_counter()
+            (y,) = c.infer("m", np.ones((2, 4), np.float32))
+            dt = time.perf_counter() - t0
+        assert y.shape == (2, 3)
+        # flushed by the window, not stuck waiting for 64 rows
+        assert dt < 5.0
+    finally:
+        srv.stop()
+
+
+def test_bucket_padding_correctness_vs_unbatched(dyn_mlp, batching_flags):
+    """Mixed-size concurrent requests (1+2+3+5 = 11 rows -> padded
+    bucket) return exactly what per-request unbatched runs return."""
+    batching_flags(batch_max=16, timeout_s=0.05)
+    monitor.reset_stats("serving/")
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    ref = Predictor(dyn_mlp)
+    rs = np.random.RandomState(1)
+    rows = [1, 2, 3, 5]
+    xs = {i: rs.randn(r, 4).astype(np.float32)
+          for i, r in enumerate(rows)}
+    results = {}
+    try:
+        def worker(i):
+            with InferenceClient(srv.endpoint) as c:
+                results[i] = c.infer("m", xs[i])[0]
+
+        _concurrent(len(rows), worker)
+    finally:
+        srv.stop()
+    for i, x in xs.items():
+        assert results[i].shape == (rows[i], 3)
+        np.testing.assert_allclose(results[i], np.asarray(ref.run(x)),
+                                   rtol=1e-5, atol=1e-6)
+    assert monitor.get_stat("serving/batches") >= 1
+    assert monitor.get_stat("serving/batched_requests") == len(rows)
+
+
+def test_batcher_bad_request_fails_alone(dyn_mlp, batching_flags):
+    """A malformed request is rejected before enqueueing; a co-batched
+    good request still succeeds."""
+    batching_flags(batch_max=16, timeout_s=0.05)
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    good = {}
+    try:
+        gate = threading.Barrier(2)
+        bad_err = []
+
+        def good_worker():
+            with InferenceClient(srv.endpoint) as c:
+                gate.wait()
+                good["y"] = c.infer("m", np.ones((1, 4), np.float32))[0]
+
+        def bad_worker():
+            with InferenceClient(srv.endpoint) as c:
+                gate.wait()
+                try:
+                    c.infer("m", np.ones((1, 5), np.float32))  # bad dim
+                except RuntimeError as e:
+                    bad_err.append(e)
+
+        ts = [threading.Thread(target=good_worker),
+              threading.Thread(target=bad_worker)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+    finally:
+        srv.stop()
+    assert good["y"].shape == (1, 3)
+    assert bad_err and "shape" in str(bad_err[0])
+
+
+def test_batching_defaults_off_is_inert(dyn_mlp):
+    """With FLAGS_serving_batch_max unset the batcher never engages,
+    even for a dynamic-batch model under concurrency."""
+    monitor.reset_stats("serving/")
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    try:
+        def worker(i):
+            with InferenceClient(srv.endpoint) as c:
+                c.infer("m", np.full((1, 4), float(i), np.float32))
+
+        _concurrent(6, worker)
+    finally:
+        srv.stop()
+    assert monitor.get_stat("serving/batches") == 0
+    assert monitor.get_stat("serving/batched_requests") == 0
+
+
+def test_fixed_shape_model_passes_through(dyn_mlp, batching_flags,
+                                          tmp_path):
+    """Batching on, but a fixed-shape artifact: requests take the
+    ordinary path (no coalescing, correct results)."""
+    batching_flags(batch_max=16, timeout_s=0.01)
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path / "static")
+    save_inference_model(path, net, [np.zeros((2, 4), np.float32)])
+    monitor.reset_stats("serving/")
+    srv = InferenceServer({"m": path}).start()
+    try:
+        def worker(i):
+            with InferenceClient(srv.endpoint) as c:
+                (y,) = c.infer("m", np.ones((2, 4), np.float32))
+                assert y.shape == (2, 3)
+
+        _concurrent(4, worker)
+    finally:
+        srv.stop()
+    assert monitor.get_stat("serving/batches") == 0
+
+
+def test_batcher_direct_api(dyn_mlp, batching_flags):
+    """DynamicBatcher used directly (no wire): validation + solo run."""
+    batching_flags(batch_max=8, timeout_s=0.001)
+    b = DynamicBatcher()
+    pred = Predictor(dyn_mlp)
+    assert DynamicBatcher.can_batch(pred)
+    outs = b.submit("m", pred, [np.ones((3, 4), np.float32)])
+    assert outs[0].shape == (3, 3)
+    with pytest.raises(ValueError, match="dtype"):
+        b.submit("m", pred, [np.ones((3, 4), np.float64)])
+    with pytest.raises(ValueError, match="shape"):
+        b.submit("m", pred, [np.ones((3, 7), np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# load_model validation (registration-time, not first-infer)
+# ---------------------------------------------------------------------------
+
+def test_load_model_validates_at_registration(dyn_mlp, tmp_path):
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    try:
+        with InferenceClient(srv.endpoint) as c:
+            with pytest.raises(RuntimeError,
+                               match="not an inference-model"):
+                c.load_model("ghost", str(tmp_path / "nope"))
+            # a directory that exists but holds garbage fails too
+            bad = tmp_path / "garbage"
+            bad.mkdir()
+            (bad / "model.stablehlo").write_bytes(b"not a model")
+            (bad / "meta.json").write_text("{}")
+            with pytest.raises(RuntimeError, match="failed to load"):
+                c.load_model("ghost", str(bad))
+            assert "ghost" not in c.list_models()
+            # server kept serving and valid loads still work
+            c.load_model("m2", dyn_mlp)
+            (y,) = c.infer("m2", np.ones((2, 4), np.float32))
+            assert y.shape == (2, 3)
+    finally:
+        srv.stop()
+
+
+def test_server_ctor_validates_path(tmp_path):
+    with pytest.raises(ValueError, match="not an inference-model"):
+        InferenceServer({"m": str(tmp_path / "missing")})
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+def test_router_least_inflight_and_kill_failover(dyn_mlp):
+    """A replica killed mid-traffic: every request still completes (the
+    failover path re-issues idempotent infers on the survivors) and the
+    dead replica is marked down by the error, not just by probing."""
+    servers = [InferenceServer({"m": dyn_mlp}).start() for _ in range(3)]
+    monitor.reset_stats("serving/router/")
+    # probing effectively off: the kill must be discovered by traffic
+    rc = RoutedClient([s.endpoint for s in servers],
+                      probe_interval_s=30.0, timeout=10.0)
+    results = {}
+    try:
+        # stop() blocks ~0.5s in the accept-loop shutdown before it
+        # severs live connections, so kill in the background and keep
+        # traffic flowing past the sever
+        stop_at = time.perf_counter() + 1.6
+        killer = threading.Timer(0.1, servers[1].stop)
+        killer.start()
+
+        def worker(i):
+            j = 0
+            while time.perf_counter() < stop_at:
+                x = np.full((1, 4), float(i * 100 + j), np.float32)
+                results[(i, j)] = rc.infer("m", x)[0]
+                j += 1
+                time.sleep(0.005)
+
+        _concurrent(4, worker)
+        killer.join()
+        assert len(results) >= 8            # traffic actually flowed
+        ref = Predictor(dyn_mlp)
+        for (i, j), y in results.items():   # zero lost/garbled requests
+            x = np.full((1, 4), float(i * 100 + j), np.float32)
+            np.testing.assert_allclose(y, np.asarray(ref.run(x)),
+                                       rtol=1e-5, atol=1e-6)
+        assert monitor.get_stat("serving/router/failovers") >= 1
+        m = {r["endpoint"]: r["healthy"] for r in rc.members()}
+        assert not m[servers[1].endpoint], m
+        assert m[servers[0].endpoint] and m[servers[2].endpoint], m
+    finally:
+        rc.close()
+        for s in servers:
+            s.stop()
+
+
+def test_router_shed_reroutes_without_marking_down(dyn_mlp):
+    """A replica whose admission control sheds (inflight cap busy with a
+    direct long request) reroutes to the other replica; the shed replica
+    stays a member."""
+
+    class _SlowPredictor:
+        input_specs = output_specs = []
+        supports_batching = False
+
+        def run(self, x):
+            time.sleep(0.4)
+            return np.asarray(x)
+
+    slow = InferenceServer({"m": dyn_mlp})
+    slow.add_model("slow", _SlowPredictor())
+    slow.start()
+    fast = InferenceServer({"m": dyn_mlp}).start()
+    monitor.reset_stats("serving/router/")
+    set_flags({"wire_max_inflight": 1})
+    # probing disabled: membership must not flap from the cap itself
+    rc = RoutedClient([slow.endpoint, fast.endpoint],
+                      probe_interval_s=0, timeout=10.0)
+    try:
+        # occupy the slow replica's single slot out-of-band
+        occupier = InferenceClient(slow.endpoint, timeout=10.0, retries=0)
+        t = threading.Thread(
+            target=lambda: occupier.infer("slow",
+                                          np.ones((4,), np.float32)))
+        t.start()
+        time.sleep(0.1)                     # slot taken
+        # router's first pick is the slow replica (round-robin over an
+        # all-zero-inflight tie includes it within two requests)
+        for _ in range(2):
+            (y,) = rc.infer("m", np.ones((1, 4), np.float32))
+            assert y.shape == (1, 3)
+        t.join()
+        occupier.close()
+        assert monitor.get_stat("serving/router/shed_rerouted") >= 1
+        assert monitor.get_stat("serving/router/marked_down") == 0
+        assert all(r["healthy"] for r in rc.members())
+    finally:
+        set_flags({"wire_max_inflight": 0})
+        rc.close()
+        slow.stop()
+        fast.stop()
+
+
+def test_router_endpoint_add_remove(dyn_mlp):
+    s1 = InferenceServer({"m": dyn_mlp}).start()
+    s2 = InferenceServer({"m": dyn_mlp}).start()
+    rc = RoutedClient([s1.endpoint], probe_interval_s=0, timeout=10.0)
+    x = np.ones((1, 4), np.float32)
+    try:
+        assert rc.infer("m", x)[0].shape == (1, 3)
+        rc.add_endpoint(s2.endpoint)
+        assert len(rc.endpoints()) == 2
+        rc.remove_endpoint(s1.endpoint)
+        assert rc.endpoints() == [s2.endpoint]
+        s1.stop()                            # only s2 remains
+        for _ in range(3):
+            assert rc.infer("m", x)[0].shape == (1, 3)
+    finally:
+        rc.close()
+        s2.stop()
+
+
+def test_router_probe_recovers_replica(dyn_mlp):
+    s1 = InferenceServer({"m": dyn_mlp}).start()
+    port = s1.port
+    rc = RoutedClient([s1.endpoint], probe_interval_s=0, timeout=5.0)
+    x = np.ones((1, 4), np.float32)
+    try:
+        assert rc.infer("m", x)[0].shape == (1, 3)
+        s1.stop()
+        with pytest.raises((ConnectionError, OSError)):
+            rc.infer("m", x)
+        assert not rc.members()[0]["healthy"]
+        # restart on the same port; an explicit probe round resurrects
+        s1b = InferenceServer({"m": dyn_mlp}, port=port).start()
+        rc.probe()
+        assert rc.members()[0]["healthy"]
+        assert rc.infer("m", x)[0].shape == (1, 3)
+        s1b.stop()
+    finally:
+        rc.close()
+
+
+def test_router_health_and_client_inflight(dyn_mlp):
+    s1 = InferenceServer({"m": dyn_mlp}).start()
+    rc = RoutedClient([s1.endpoint], probe_interval_s=0, timeout=5.0)
+    try:
+        h = rc.health()
+        assert h[s1.endpoint]["status"] == "ok"
+        # FrameClient-level inflight counters (the routing signal)
+        c = InferenceClient(s1.endpoint)
+        assert c.inflight == 0
+        c.infer("m", np.ones((1, 4), np.float32))
+        assert c.inflight == 0 and c.inflight_by_op() == {}
+        c.close()
+    finally:
+        rc.close()
+        s1.stop()

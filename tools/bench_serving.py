@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Serving throughput benchmark: cross-request dynamic batching on vs
+off, over the real wire (``InferenceServer`` + ``InferenceClient``),
+at client concurrency 1 / 8 / 32 on CPU.
+
+What it measures: end-to-end infer requests/sec against one server
+hosting a dynamic-batch MLP artifact (deep + narrow enough that per-call
+dispatch overhead — the thing batching amortizes — is a realistic
+fraction of request cost; the compute itself scales with rows either
+way). Unbatched mode is the hard-off default (``FLAGS_serving_batch_max``
+unset); batched mode sets the row cap + a sub-millisecond coalescing
+window. Each (concurrency, mode) cell is the median of ``--reps`` timed
+runs after warmup, with every power-of-two padding bucket primed first
+so XLA compilation never lands inside a timed region.
+
+Writes ``BENCH_serving.json`` (repo root by default): per-concurrency
+req/s for both modes, speedups, and batch-shape stats
+(``serving/batch_size`` / ``batch_requests`` / ``batch_wait_s``
+histograms from the server's registry). The headline metric is the
+concurrency-8 speedup — the acceptance floor is 2x.
+
+Usage: ``JAX_PLATFORMS=cpu python tools/bench_serving.py [-o OUT.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu                                      # noqa: E402
+from paddle_tpu import io, nn                          # noqa: E402
+from paddle_tpu.core import monitor                    # noqa: E402
+from paddle_tpu.core.flags import set_flags            # noqa: E402
+
+# Deep + narrow: per-call overhead (jax dispatch + per-op launch) is
+# what cross-request batching amortizes; 24 fused layers of 256 keep it
+# a realistic share of request cost without making compute trivial.
+LAYERS, WIDTH = 24, 256
+BATCH_MAX = 32
+BATCH_TIMEOUT_S = 0.0005
+
+
+def _export_model(tmp: str) -> str:
+    paddle_tpu.seed(0)
+    layers: list = []
+    for _ in range(LAYERS):
+        layers += [nn.Linear(WIDTH, WIDTH), nn.ReLU()]
+    path = os.path.join(tmp, "bench_mlp")
+    io.save_inference_model(path, nn.Sequential(*layers),
+                            [np.zeros((1, WIDTH), np.float32)],
+                            dynamic_batch=True)
+    return path
+
+
+def _concurrent(n: int, fn) -> None:
+    gate = threading.Barrier(n)
+
+    def run(i):
+        gate.wait()
+        fn(i)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def prime_buckets(endpoint: str) -> None:
+    """Compile every power-of-two padding bucket before timing: send k
+    simultaneous requests inside a wide batching window for each k."""
+    set_flags({"serving_batch_max": BATCH_MAX,
+               "serving_batch_timeout_s": 0.02})
+    x = np.ones((1, WIDTH), np.float32)
+    k = 1
+    while k <= BATCH_MAX:
+        clients = [io.InferenceClient(endpoint) for _ in range(k)]
+        _concurrent(k, lambda i: clients[i].infer("m", x))
+        for c in clients:
+            c.close()
+        k <<= 1
+
+
+def run_cell(endpoint: str, conc: int, n_per: int, batched: bool) -> float:
+    """One timed (concurrency, mode) measurement -> requests/sec."""
+    if batched:
+        set_flags({"serving_batch_max": BATCH_MAX,
+                   "serving_batch_timeout_s": BATCH_TIMEOUT_S})
+    else:
+        set_flags({"serving_batch_max": 0})
+    clients = [io.InferenceClient(endpoint) for _ in range(conc)]
+    x = np.ones((1, WIDTH), np.float32)
+
+    def warm(i):
+        for _ in range(3):
+            clients[i].infer("m", x)
+
+    _concurrent(conc, warm)
+
+    t0 = [0.0]
+    gate = threading.Barrier(conc + 1)
+
+    def worker(i):
+        for _ in range(n_per):
+            clients[i].infer("m", x)
+
+    def timed(i):
+        gate.wait()
+        worker(i)
+
+    threads = [threading.Thread(target=timed, args=(i,))
+               for i in range(conc)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    t0[0] = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0[0]
+    for c in clients:
+        c.close()
+    return conc * n_per / dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_serving.json"))
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per cell (median reported)")
+    args = ap.parse_args()
+
+    results: dict = {
+        "model": f"MLP {LAYERS}x{WIDTH} (dynamic_batch export, CPU)",
+        "serving_batch_max": BATCH_MAX,
+        "serving_batch_timeout_s": BATCH_TIMEOUT_S,
+        "reps": args.reps,
+        "concurrency": {},
+    }
+    with tempfile.TemporaryDirectory(prefix="ptpu_bench_srv_") as tmp:
+        path = _export_model(tmp)
+        srv = io.InferenceServer({"m": path}).start()
+        try:
+            prime_buckets(srv.endpoint)
+            monitor.reset_stats("serving/")
+            for conc, n_per in ((1, 120), (8, 60), (32, 20)):
+                ub = [run_cell(srv.endpoint, conc, n_per, False)
+                      for _ in range(args.reps)]
+                b = [run_cell(srv.endpoint, conc, n_per, True)
+                     for _ in range(args.reps)]
+                cell = {
+                    "requests": conc * n_per,
+                    "unbatched_rps": round(statistics.median(ub), 1),
+                    "batched_rps": round(statistics.median(b), 1),
+                    "unbatched_rps_all": [round(v, 1) for v in ub],
+                    "batched_rps_all": [round(v, 1) for v in b],
+                }
+                cell["speedup"] = round(
+                    cell["batched_rps"] / cell["unbatched_rps"], 2)
+                results["concurrency"][str(conc)] = cell
+                print(f"conc={conc:3d}  "
+                      f"unbatched={cell['unbatched_rps']:8.1f} req/s  "
+                      f"batched={cell['batched_rps']:8.1f} req/s  "
+                      f"speedup={cell['speedup']:.2f}x")
+        finally:
+            set_flags({"serving_batch_max": 0,
+                       "serving_batch_timeout_s": 0.005})
+            srv.stop()
+
+    for name in ("serving/batch_size", "serving/batch_requests",
+                 "serving/batch_wait_s"):
+        h = monitor.get_histogram(name)
+        if h:
+            results[name] = {k: round(v, 6) for k, v in h.items()}
+
+    speedup8 = results["concurrency"]["8"]["speedup"]
+    results["parsed"] = {
+        "metric": "serving infer throughput, batched vs unbatched "
+                  "(concurrency 8, CPU wire round-trips)",
+        "value": speedup8,
+        "unit": "x",
+    }
+    results["ok"] = speedup8 >= 2.0
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results["parsed"], indent=2))
+    print(f"wrote {args.out}; ok={results['ok']}")
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,11 @@ Scenarios (all CPU-only, single process):
    injection + an admission-cap shed records spans for the round-trip,
    the retries, and the shed waits — one trace id joins client and
    server — and the Chrome export parses as valid JSON.
+6. **serving-routed**: one of three replicas is killed under routed,
+   dynamically-batched load — zero idempotent requests are lost (the
+   router fails them over to the survivors), router membership converges
+   to mark the dead replica unhealthy, and cross-request batching
+   demonstrably coalesced (fewer batches than batched requests).
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -68,6 +73,9 @@ def check_defaults_off() -> None:
           str(o))
     check("defaults/barrier_timeout_finite",
           o["ps_barrier_timeout_s"] > 0, str(o))
+    s = get_flags(["serving_batch_max", "serving_batch_timeout_s"])
+    check("defaults/serving_batching_off", s["serving_batch_max"] == 0,
+          str(s))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -284,13 +292,98 @@ def scenario_obs(tmp: str) -> None:
         srv.stop()
 
 
+def scenario_serving_routed(tmp: str) -> None:
+    """Replica kill under routed + dynamically-batched load: all
+    idempotent requests complete via failover, membership converges."""
+    import threading
+    import time
+
+    from paddle_tpu.serving import RoutedClient
+
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = os.path.join(tmp, "dyn_mlp")
+    io.save_inference_model(path, net, [np.zeros((2, 4), np.float32)],
+                            dynamic_batch=True)
+    servers = [io.InferenceServer({"m": path}).start() for _ in range(3)]
+    monitor.reset_stats("serving/")
+    set_flags({"serving_batch_max": 8, "serving_batch_timeout_s": 0.002})
+    rc = RoutedClient([s.endpoint for s in servers],
+                      probe_interval_s=0.25, timeout=10.0)
+    results: dict = {}
+    errors: list = []
+    try:
+        # stop() spends ~0.5s shutting the accept loop down before it
+        # severs live conns — keep traffic flowing well past the sever
+        stop_at = time.perf_counter() + 1.8
+        killer = threading.Timer(0.1, servers[1].stop)
+        killer.start()
+        gate = threading.Barrier(6)
+
+        def worker(i):
+            try:
+                gate.wait()
+                j = 0
+                while time.perf_counter() < stop_at:
+                    x = np.full((1, 4), float(i * 1000 + j), np.float32)
+                    results[(i, j)] = (float(x[0, 0]), rc.infer("m", x)[0])
+                    j += 1
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        killer.join()
+        ref = io.Predictor(path)
+        bad = sum(
+            not np.allclose(
+                y, np.asarray(ref.run(np.full((1, 4), v, np.float32))),
+                rtol=1e-5, atol=1e-6)
+            for v, y in results.values())
+        check("routed/zero_lost_requests",
+              not errors and len(results) > 10 and bad == 0,
+              f"errors={errors[:2]} n={len(results)} bad={bad}")
+        check("routed/failover_fired",
+              monitor.get_stat("serving/router/failovers") >= 1)
+        check("routed/batching_coalesced",
+              0 < monitor.get_stat("serving/batches")
+              < monitor.get_stat("serving/batched_requests"),
+              str(monitor.export_stats("serving/")))
+        # membership convergence (probe- or traffic-driven)
+        deadline = time.time() + 5.0
+        members = rc.members()
+        while time.time() < deadline:
+            members = rc.members()
+            health = {m["endpoint"]: m["healthy"] for m in members}
+            if (not health[servers[1].endpoint]
+                    and health[servers[0].endpoint]
+                    and health[servers[2].endpoint]):
+                break
+            time.sleep(0.05)
+        health = {m["endpoint"]: m["healthy"] for m in members}
+        check("routed/membership_converged",
+              not health[servers[1].endpoint]
+              and health[servers[0].endpoint]
+              and health[servers[2].endpoint], str(members))
+    finally:
+        set_flags({"serving_batch_max": 0,
+                   "serving_batch_timeout_s": 0.005})
+        rc.close()
+        for s in servers:
+            s.stop()
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
         os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
         for scenario in (scenario_serving_wire, scenario_checkpoint,
                          scenario_elastic_resume, scenario_overload,
-                         scenario_obs):
+                         scenario_obs, scenario_serving_routed):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
@@ -303,7 +396,8 @@ def main() -> int:
         "failures": [{"check": n, "detail": d}
                      for n, p, d in CHECKS if not p],
         "stats": {k: v for k, v in monitor.export_stats().items()
-                  if k.split("/")[0] in ("wire", "ckpt", "fault", "train")},
+                  if k.split("/")[0] in ("wire", "ckpt", "fault", "train",
+                                         "serving")},
     }, indent=2))
     return 0 if ok else 1
 

@@ -43,13 +43,30 @@ def scrape(endpoint: str, *, clear: bool, stats_prefix: str | None,
     # empty op table: health/trace_dump are universal FrameService ops
     with FrameClient(endpoint, {}, service="obs", timeout=timeout,
                      retries=0) as client:
-        health = client.health(stats_prefix)
+        # histograms ride along raw (bucket counts), so the fleet view
+        # can MERGE distributions instead of averaging quantiles
+        health = client.health(stats_prefix, histograms=True)
         dump = client.trace_dump(clear)
     return {"endpoint": endpoint,
             "service": dump.get("service", "?"),
             "tracing": dump.get("enabled", False),
             "health": health,
+            "histograms": health.pop("histograms", {}),
             "spans": dump.get("spans", [])}
+
+
+def merge_fleet_histograms(scrapes: list[dict]) -> dict[str, dict]:
+    """name → fleet-merged histogram summary across every endpoint that
+    reported it (exact combined quantiles via the shared fixed bucket
+    bounds — ``monitor.merge_histograms``)."""
+    from paddle_tpu.core.monitor import merge_histograms
+
+    by_name: dict[str, list[dict]] = {}
+    for s in scrapes:
+        for name, doc in (s.get("histograms") or {}).items():
+            by_name.setdefault(name, []).append(doc)
+    return {name: merge_histograms(docs)
+            for name, docs in sorted(by_name.items())}
 
 
 def merge_chrome(scrapes: list[dict]) -> dict:
@@ -104,6 +121,7 @@ def main(argv: list[str] | None = None) -> int:
         mine = {sp["trace_id"] for sp in s["spans"]}
         joined |= traces & mine
         traces |= mine
+    merged_hists = merge_fleet_histograms(scrapes)
     report = {
         "ok": True,
         "out": args.out,
@@ -117,7 +135,17 @@ def main(argv: list[str] | None = None) -> int:
         "trace_ids": len(traces),
         "cross_endpoint_trace_ids": len(joined),
         "events": len(doc["traceEvents"]),
+        "histograms": {
+            name: {k: round(float(h[k]), 6)
+                   for k in ("count", "p50", "p95", "p99")}
+            for name, h in merged_hists.items()},
     }
+    # serving-batch amortization in one line: mean rows per predictor
+    # run across the fleet (1.0 == batching never coalesced anything)
+    bs = merged_hists.get("serving/batch_size")
+    if bs and bs["count"]:
+        report["mean_serving_batch_rows"] = round(
+            bs["sum"] / bs["count"], 2)
     print(json.dumps(report, indent=2))
     if args.prom:
         from paddle_tpu.core.monitor import export_prometheus
