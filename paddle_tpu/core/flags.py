@@ -150,6 +150,28 @@ define_flag("serving_probe_interval_s", 1.0,
             "universal health op is polled this often to drive routed "
             "membership (unreachable/draining replicas stop receiving "
             "new requests; recovered ones rejoin)")
+# --- continuous-batching generation engine (serving/engine.py) ---
+define_flag("gen_slots", 0,
+            "Slot count of the continuous-batching GenerationEngine: one "
+            "fixed-shape batched KV cache holds this many concurrent "
+            "generations, admitted/retired at decode-step granularity "
+            "(iteration-level scheduling). 0 — the default — disables "
+            "generation serving entirely; InferenceServer.add_generator "
+            "then requires an explicit slots=, and the plain serving "
+            "path is byte-identical to the engine-less build")
+define_flag("gen_max_len", 512,
+            "Per-slot KV-cache capacity of the GenerationEngine "
+            "(prompt + generated tokens); the engine allocates "
+            "slots x this once, so shapes stay static across requests "
+            "(no XLA recompiles)")
+define_flag("gen_queue_max", 8,
+            "How many prompts may queue for a free engine slot before "
+            "generate_start is shed with the retryable CODE_SHED status "
+            "(header carries retry_after_s). 0 = unbounded queue")
+define_flag("gen_poll_ttl_s", 30.0,
+            "Reap a generation whose client has not polled for this "
+            "long (disconnected/crashed callers must not pin a slot "
+            "forever; gen/evictions counts the reclaims). <= 0 disables")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

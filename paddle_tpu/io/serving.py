@@ -16,6 +16,14 @@ Wire format for ``infer``: header ``{"model": name, "inputs":
 [{"shape": [...], "dtype": "float32"}, ...], "nbytes": N}`` with the raw
 input buffers concatenated in order; response mirrors it with output
 specs + buffers.
+
+Generation serving (``FLAGS_gen_slots``): ``add_generator`` registers a
+continuous-batching :class:`~paddle_tpu.serving.engine.GenerationEngine`
+over a live model, served through ``generate_start`` /
+``generate_poll`` / ``generate_cancel`` (prompts/tokens ride the JSON
+header — they are small) with :meth:`InferenceClient.generate` as the
+streaming client iterator. A full engine sheds starts with the
+retryable ``CODE_SHED`` status.
 """
 
 from __future__ import annotations
@@ -28,11 +36,16 @@ import numpy as np
 
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
-from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
+from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.core.wire import (
+    CODE_SHED, FrameClient, FrameService, send_frame,
+)
 
 __all__ = ["InferenceServer", "InferenceClient"]
 
-SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4}
+SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4,
+               "generate_start": 5, "generate_poll": 6,
+               "generate_cancel": 7}
 _OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
 
 
@@ -85,6 +98,7 @@ class InferenceServer(FrameService):
 
         self._predictor_cls = Predictor
         self._models: dict[str, Any] = {}
+        self._generators: dict[str, Any] = {}
         self._lock = threading.Lock()
         # per-server coalescer; consulted only when FLAGS_serving_batch_max
         # enables batching (one flag read per infer otherwise)
@@ -121,6 +135,52 @@ class InferenceServer(FrameService):
         with self._lock:
             self._models[name] = pred
 
+    def add_generator(self, name: str, model, **engine_kwargs) -> None:
+        """Register a continuous-batching :class:`~paddle_tpu.serving.
+        engine.GenerationEngine` for the ``generate_start`` /
+        ``generate_poll`` / ``generate_cancel`` ops. ``model`` is a live
+        model exposing ``init_cache``/``forward_with_cache`` (engines
+        step the decode loop slot-by-slot — a baked StableHLO artifact
+        cannot), or an already-constructed engine. Slot count comes from
+        ``FLAGS_gen_slots`` unless ``slots=`` is passed; the flag's
+        default of 0 keeps generation serving off entirely."""
+        from paddle_tpu.serving.engine import GenerationEngine
+
+        engine = (model if isinstance(model, GenerationEngine)
+                  else GenerationEngine(model, **engine_kwargs))
+        with self._lock:
+            old = self._generators.get(name)
+            self._generators[name] = engine
+        if old is not None and old is not engine:
+            old.close()
+
+    def _generator(self, name: str):
+        with self._lock:
+            eng = self._generators.get(name)
+        if eng is None:
+            raise KeyError(f"no generator {name!r}; registered: "
+                           f"{sorted(self._generators)} (use "
+                           "add_generator; FLAGS_gen_slots enables)")
+        return eng
+
+    def health(self, stats_prefix: str | None = None,
+               histograms: bool = False) -> dict:
+        """FrameService health + per-generator slot occupancy, so
+        routers/probes see generation capacity without a dedicated op."""
+        doc = super().health(stats_prefix, histograms)
+        with self._lock:
+            gens = {n: e.stats() for n, e in self._generators.items()}
+        if gens:
+            doc["generators"] = gens
+        return doc
+
+    def stop(self, drain_s: float | None = None) -> None:
+        super().stop(drain_s)
+        with self._lock:
+            engines = list(self._generators.values())
+        for engine in engines:
+            engine.close()
+
     def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
         name = _OP_NAMES.get(op)
         try:
@@ -147,6 +207,43 @@ class InferenceServer(FrameService):
             if name == "load_model":
                 self.add_model(header["name"], header["path"])
                 send_frame(sock, 0, {})
+                return True
+            if name == "generate_start":
+                from paddle_tpu.serving.engine import EngineOverloaded
+
+                engine = self._generator(header["model"])
+                eos = header.get("eos_token_id")
+                try:
+                    gen_id = engine.start(
+                        np.asarray(header["prompt"], np.int32),
+                        int(header["max_new_tokens"]),
+                        temperature=float(header.get("temperature", 0.0)),
+                        top_k=int(header.get("top_k", 0)),
+                        top_p=float(header.get("top_p", 1.0)),
+                        eos_token_id=None if eos is None else int(eos),
+                        seed=int(header.get("seed", 0)))
+                except EngineOverloaded as e:
+                    # full engine: shed, not error — the status is
+                    # retryable for every client (the start never ran)
+                    stat_add("gen/shed_wire")
+                    send_frame(sock, CODE_SHED,
+                               {"error": str(e),
+                                "retry_after_s": e.retry_after_s})
+                    return True
+                send_frame(sock, 0, {"gen_id": gen_id})
+                return True
+            if name == "generate_poll":
+                engine = self._generator(header["model"])
+                doc = engine.poll(
+                    header["gen_id"], start=int(header.get("start", 0)),
+                    # bound the long-poll: a poll pins a handler thread
+                    wait_s=min(float(header.get("wait_s", 0.0)), 2.0))
+                send_frame(sock, 0, doc)
+                return True
+            if name == "generate_cancel":
+                engine = self._generator(header["model"])
+                send_frame(sock, 0,
+                           {"cancelled": engine.cancel(header["gen_id"])})
                 return True
             if name != "infer":
                 send_frame(sock, 1, {"error": f"bad op {op}"})
@@ -190,9 +287,14 @@ class InferenceClient(FrameClient):
 
     def __init__(self, endpoint: str, *, timeout: float | None = None,
                  retries: int | None = None):
+        # generate_poll (positional re-read) and generate_cancel are
+        # idempotent; generate_start is NOT — a conn-level retry could
+        # start the generation twice (CODE_SHED retries stay safe for
+        # it: a shed start never executed)
         super().__init__(endpoint, SERVING_OPS, service="serving",
                          timeout=timeout, retries=retries,
-                         idempotent=("infer", "list_models", "load_model"))
+                         idempotent=("infer", "list_models", "load_model",
+                                     "generate_poll", "generate_cancel"))
 
     def infer(self, model: str, *inputs) -> list[np.ndarray]:
         specs, payload = _pack_arrays(inputs)
@@ -207,6 +309,77 @@ class InferenceClient(FrameClient):
 
     def list_models(self) -> dict:
         return self._request("list_models", {})[0]["models"]
+
+    # -- streaming generation (continuous-batching engine) -----------------
+    def generate_start(self, model: str, prompt, max_new_tokens: int, *,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, eos_token_id: int | None = None,
+                       seed: int = 0) -> str:
+        """Admit a generation into ``model``'s engine; returns its id.
+        A full engine surfaces as the retryable shed status (the client
+        backs off per ``retry_after_s`` and retries within its budget,
+        then raises :class:`~paddle_tpu.core.wire.WireShedError`)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        header = {"model": model, "prompt": prompt.tolist(),
+                  "max_new_tokens": int(max_new_tokens),
+                  "temperature": float(temperature), "top_k": int(top_k),
+                  "top_p": float(top_p), "seed": int(seed)}
+        if eos_token_id is not None:
+            header["eos_token_id"] = int(eos_token_id)
+        return self._request("generate_start", header)[0]["gen_id"]
+
+    def generate_poll(self, model: str, gen_id: str, start: int = 0,
+                      wait_s: float = 0.0) -> dict:
+        """Tokens past ``start`` (long-polls up to ``wait_s`` server-side)
+        → ``{"tokens", "done", "error", "queued"}``."""
+        return self._request(
+            "generate_poll", {"model": model, "gen_id": gen_id,
+                              "start": int(start),
+                              "wait_s": float(wait_s)})[0]
+
+    def generate_cancel(self, model: str, gen_id: str) -> bool:
+        return self._request(
+            "generate_cancel",
+            {"model": model, "gen_id": gen_id})[0]["cancelled"]
+
+    def generate(self, model: str, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id: int | None = None,
+                 seed: int = 0, poll_wait_s: float = 0.25):
+        """Streaming generation: admits the prompt (raises immediately on
+        a full engine) and returns an iterator yielding token ids as the
+        engine emits them. Closing the iterator early (``break`` /
+        ``.close()``) cancels the generation server-side so its slot
+        frees now instead of at the poll TTL."""
+        gen_id = self.generate_start(
+            model, prompt, max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+            seed=seed)
+
+        def stream():
+            n, finished = 0, False
+            try:
+                while True:
+                    doc = self.generate_poll(model, gen_id, start=n,
+                                             wait_s=poll_wait_s)
+                    for tok in doc["tokens"]:
+                        yield int(tok)
+                    n += len(doc["tokens"])
+                    if doc["done"]:
+                        finished = True
+                        if doc.get("error"):
+                            raise RuntimeError(
+                                f"generation {gen_id} failed: "
+                                f"{doc['error']}")
+                        return
+            finally:
+                if not finished:
+                    try:
+                        self.generate_cancel(model, gen_id)
+                    except (RuntimeError, ConnectionError, OSError):
+                        pass
+
+        return stream()
 
     def load_model(self, name: str, path: str) -> None:
         self._request("load_model", {"name": name, "path": path})

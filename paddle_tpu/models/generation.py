@@ -3,9 +3,11 @@
 The reference's decode loop lives in graph ops (``paddle/fluid/operators/
 beam_search_op.cc``, sampling ops) driven per-step from Python. The TPU
 design instead compiles the WHOLE loop: prefill is one jitted forward
-over the prompt, then ``lax.fori_loop`` runs single-token steps against
+over the prompt, then ``lax.while_loop`` runs single-token steps against
 a fixed-shape KV cache (``LlamaForCausalLM.init_cache``) — one compiled
-step serves every position, no per-length recompilation.
+step serves every position, no per-length recompilation — and exits as
+soon as every row has emitted EOS, so short completions stop paying for
+``max_new_tokens`` steps.
 
 Works with any model exposing ``init_cache(B, S)`` and
 ``forward_with_cache(ids, cache, index)``.
@@ -53,7 +55,11 @@ def generate(model, input_ids, max_new_tokens: int, *,
     Returns [B, T0 + max_new_tokens] int32; positions after an emitted
     EOS are filled with ``pad_token_id``. Jit-compatible (wrap the call
     in ``jax.jit`` with ``static_argnums`` for the ints, or close over
-    them) — the loop itself is a ``lax.fori_loop``.
+    them) — the loop itself is a ``lax.while_loop`` that exits as soon
+    as EVERY row has finished, so short completions don't pay for
+    ``max_new_tokens`` steps (unwritten positions hold ``pad_token_id``
+    from the initial fill — bit-identical to running the loop out, which
+    only wrote pads past EOS).
     """
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if max_new_tokens <= 0:
@@ -82,8 +88,8 @@ def generate(model, input_ids, max_new_tokens: int, *,
         finished = next_tok == eos_token_id
     seq = jax.lax.dynamic_update_slice(seq, next_tok[:, None], (0, T0))
 
-    def body(i, state):
-        seq, cache, prev_tok, finished, key = state
+    def body(state):
+        i, seq, cache, prev_tok, finished, key = state
         logits, cache = model.forward_with_cache(
             prev_tok[:, None], cache, index=T0 + i - 1)
         key, sub = jax.random.split(key)
@@ -93,12 +99,20 @@ def generate(model, input_ids, max_new_tokens: int, *,
             finished = finished | (tok == eos_token_id)
         seq = jax.lax.dynamic_update_slice(
             seq, tok[:, None], (0, T0 + i))
-        return seq, cache, tok, finished, key
+        return i + 1, seq, cache, tok, finished, key
+
+    def cond(state):
+        i, _, _, _, finished, _ = state
+        # early exit once every row is done: the fori body only wrote
+        # pad_token_id past EOS, and seq was initialized pad-filled, so
+        # skipping those steps changes nothing but the step count
+        return (i < max_new_tokens) & ~jnp.all(finished)
 
     if max_new_tokens > 1:
-        seq, cache, next_tok, finished, key = jax.lax.fori_loop(
-            1, max_new_tokens, body,
-            (seq, cache, next_tok, finished, key))
+        _, seq, cache, next_tok, finished, key = jax.lax.while_loop(
+            cond, body,
+            (jnp.asarray(1, jnp.int32), seq, cache, next_tok, finished,
+             key))
     return seq
 
 

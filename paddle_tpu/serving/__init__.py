@@ -16,6 +16,13 @@ survivors instead of failing callers.
 """
 
 from paddle_tpu.serving.batcher import DynamicBatcher
-from paddle_tpu.serving.router import ReplicaState, RoutedClient
+from paddle_tpu.serving.engine import (
+    EngineOverloaded, Generation, GenerationEngine,
+)
+from paddle_tpu.serving.router import (
+    GenerationFailed, ReplicaState, RoutedClient, StickySession,
+)
 
-__all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState"]
+__all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
+           "GenerationEngine", "Generation", "EngineOverloaded",
+           "StickySession", "GenerationFailed"]

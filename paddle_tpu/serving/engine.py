@@ -1,0 +1,553 @@
+"""Continuous-batching generation engine: slot-based KV-cache scheduling
+with streaming token delivery.
+
+Reference role: the serving story the reference never had for its decode
+loops (``operators/beam_search_op.cc`` + the dygraph sampling loops run
+one request to completion, so a long generation starves every other
+caller). This module applies iteration-level scheduling (Orca, OSDI '22)
+and slot-based KV-cache management (the fixed-slot precursor of vLLM's
+paged cache, SOSP '23) to the framework's autoregressive path:
+
+- **One fixed-shape batched cache.** The engine owns ``slots`` KV caches
+  of ``max_len`` positions each, allocated once (leaves
+  ``[slots, L, 1, Hkv, S, D]``). Shapes never depend on the request mix,
+  so XLA compiles exactly one decode step and one prefill per prompt
+  bucket — no recompiles as traffic changes.
+- **Iteration-level scheduling.** A background loop admits queued
+  prompts into free slots (bucketed prefill), steps *all* active slots
+  through ONE fused decode (``jax.vmap`` over
+  ``model.forward_with_cache`` with per-slot positions — the einsum
+  decode path batches exactly), and retires slots on EOS,
+  ``max_new_tokens``, cancel, or poll-TTL expiry (client disconnect).
+  A request admitted mid-flight shares the very next decode step with
+  the requests already running.
+- **Host-side request state, device-side cache.** Per-slot prompt
+  length, position, RNG key, and sampling params ride the jitted state;
+  emitted tokens stream into host buffers that :meth:`~GenerationEngine.
+  poll` drains incrementally (the wire ops ``generate_start`` /
+  ``generate_poll`` / ``generate_cancel`` in ``io/serving.py``).
+
+Determinism: a greedy (``temperature=0``) generation through the engine
+is byte-identical to a solo :func:`paddle_tpu.models.generation.generate`
+call — right-padded bucketed prefill and co-tenant slots cannot change a
+row's logits (causal masking; row-independent compute). Sampled requests
+are deterministic per ``(prompt, seed)`` — each slot splits its own key
+once per emitted token — but follow a different key schedule than solo
+``generate``.
+
+Observability: ``gen/slots_active`` / ``gen/queue_depth`` gauges,
+``gen/prefill_s`` / ``gen/decode_step_s`` histograms, ``gen/tokens`` /
+``gen/evictions`` counters, ``gen/prefill`` + ``gen/decode_step`` spans,
+and slot occupancy in the serving ``health`` op.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core import trace as _trace
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import observe, stat_add, stat_set
+
+__all__ = ["GenerationEngine", "Generation", "EngineOverloaded"]
+
+_UNSET = object()
+
+
+class EngineOverloaded(RuntimeError):
+    """Every slot is busy and the admit queue is full; the request was
+    NOT enqueued. Safe to retry after ``retry_after_s`` — the serving
+    layer maps this to the wire's retryable ``CODE_SHED`` status."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.25):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class Generation:
+    """Host-side record of one generation request (the engine's unit of
+    scheduling). ``tokens`` grows as decode steps emit; ``slot`` is None
+    while queued and again after retirement."""
+
+    __slots__ = ("gen_id", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "top_p", "eos_token_id", "seed", "tokens",
+                 "done", "error", "slot", "created", "last_poll",
+                 "cancelled")
+
+    def __init__(self, gen_id: str, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float, top_k: int,
+                 top_p: float, eos_token_id: int | None, seed: int):
+        self.gen_id = gen_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_token_id = eos_token_id
+        self.seed = seed
+        self.tokens: list[int] = []
+        self.done = False
+        self.error: str | None = None
+        self.slot: int | None = None
+        self.created = time.monotonic()
+        self.last_poll = self.created
+        self.cancelled = False
+
+
+def _sample_slot(logits, key, temperature, top_k, top_p):
+    """Per-slot next-token pick with fully-traced sampling params (one
+    compiled step serves every request mix): greedy argmax where
+    ``temperature <= 0`` — bit-equal to ``sample_logits``'s greedy path —
+    else temperature / top-k / nucleus sampling with traced ``top_k``
+    (``<= 0`` keeps all) and ``top_p`` (``1.0`` keeps all)."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    V = logits.shape[-1]
+    lt = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    # top-k via the kth-largest threshold, k traced (take clamps indices)
+    asc = jnp.sort(lt, axis=-1)
+    k_eff = jnp.clip(jnp.where(top_k > 0, top_k, V), 1, V)
+    kth = jnp.take(asc, V - k_eff)
+    lt = jnp.where(lt < kth, -jnp.inf, lt)
+    # nucleus over what survived top-k (the sample_logits ordering)
+    desc = jnp.sort(lt, axis=-1)[::-1]
+    probs = jax.nn.softmax(desc)
+    cum = jnp.cumsum(probs)
+    keep = cum - probs < top_p              # always keeps the top-1
+    thr = jnp.min(jnp.where(keep, desc, jnp.inf))
+    lt = jnp.where(lt < thr, -jnp.inf, lt)
+    sampled = jax.random.categorical(key, lt).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+class GenerationEngine:
+    """Slot-scheduled continuous-batching decode over one model.
+
+    ``model`` is any object with ``init_cache(B, S, dtype=...)`` and
+    ``forward_with_cache(ids, cache, index)`` (the ``models/generation``
+    contract — Llama/GPT/MoE). ``slots`` defaults to ``FLAGS_gen_slots``
+    (0 = generation serving disabled: constructing without an explicit
+    ``slots`` raises); ``max_len``/``queue_max``/``ttl_s`` default to
+    ``FLAGS_gen_max_len``/``FLAGS_gen_queue_max``/``FLAGS_gen_poll_ttl_s``.
+
+    The background loop starts on construction; :meth:`close` retires it.
+    All device state is touched only by the loop thread — the public
+    surface (:meth:`start`/:meth:`poll`/:meth:`cancel`) is host-side and
+    lock-guarded.
+    """
+
+    def __init__(self, model, *, slots: int | None = None,
+                 max_len: int | None = None, queue_max: int | None = None,
+                 ttl_s: float | None = None, eos_token_id: int | None = None,
+                 pad_token_id: int = 0, cache_dtype=None,
+                 min_bucket: int = 8, step_wait_s: float = 0.0):
+        import jax.numpy as jnp
+
+        if slots is None:
+            slots = int(flag("gen_slots"))
+        if slots <= 0:
+            raise ValueError(
+                "generation serving is disabled (FLAGS_gen_slots=0); set "
+                "the flag or pass slots= explicitly")
+        self.slots = int(slots)
+        self.max_len = int(flag("gen_max_len") if max_len is None
+                           else max_len)
+        cfg_max = getattr(getattr(model, "config", None), "max_seq_len",
+                          None)
+        if cfg_max is not None:
+            self.max_len = min(self.max_len, int(cfg_max))
+        self._queue_max = int(flag("gen_queue_max") if queue_max is None
+                              else queue_max)
+        self._ttl_s = float(flag("gen_poll_ttl_s") if ttl_s is None
+                            else ttl_s)
+        self._eos_default = eos_token_id
+        self._pad = int(pad_token_id)
+        self._min_bucket = max(int(min_bucket), 1)
+        # pacing knob: minimum gap between fused decode steps (throttle
+        # a host-loop-bound engine, or make scheduling windows
+        # deterministic in tests/chaos checks); 0 = run flat out
+        self.step_wait_s = float(step_wait_s)
+        self._model = model
+        self._cache_dtype = cache_dtype
+
+        proto = model.init_cache(1, self.max_len, dtype=cache_dtype)
+        import jax
+
+        self._state: dict[str, Any] = {
+            "cache": jax.tree_util.tree_map(
+                lambda x: jnp.zeros((self.slots,) + x.shape, x.dtype),
+                proto),
+            "tok": jnp.zeros((self.slots,), jnp.int32),
+            "pos": jnp.zeros((self.slots,), jnp.int32),
+            "keys": jnp.zeros((self.slots, 2), jnp.uint32),
+            "temp": jnp.zeros((self.slots,), jnp.float32),
+            "top_k": jnp.zeros((self.slots,), jnp.int32),
+            "top_p": jnp.ones((self.slots,), jnp.float32),
+        }
+        self._step = self._build_step()
+        self._prefill_fn = self._build_prefill()
+
+        self._cond = threading.Condition()
+        self._queue: deque[Generation] = deque()
+        self._slot_gen: list[Generation | None] = [None] * self.slots
+        self._gens: dict[str, Generation] = {}
+        self._stopping = False
+        self._broken: str | None = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gen-engine")
+        self._thread.start()
+
+    # -- compiled pieces ---------------------------------------------------
+    def _build_step(self):
+        """ONE fused decode for all slots: vmap the model's single-token
+        cached forward over the slot axis with per-slot positions/keys/
+        sampling params. Inactive slots compute too (fixed cost, fixed
+        shapes) but their token/position state is frozen by the mask and
+        their cache garbage is overwritten at the next admit."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self._model
+
+        def one(cache, tok, idx, key, temp, top_k, top_p):
+            logits, cache = model.forward_with_cache(
+                tok[None, None], cache, index=idx)
+            key, sub = jax.random.split(key)
+            nxt = _sample_slot(logits[0, -1], sub, temp, top_k, top_p)
+            return cache, nxt, key
+
+        def step(state, active):
+            cache, nxt, keys = jax.vmap(one)(
+                state["cache"], state["tok"], state["pos"], state["keys"],
+                state["temp"], state["top_k"], state["top_p"])
+            tok = jnp.where(active, nxt, state["tok"])
+            pos = state["pos"] + active.astype(jnp.int32)
+            return dict(state, cache=cache, tok=tok, pos=pos,
+                        keys=keys), tok
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _build_prefill(self):
+        """Prefill one slot from a right-padded prompt bucket (compiled
+        once per bucket length; ``slot``/``true_len`` are traced). The
+        whole slot cache is overwritten, so stale state from the previous
+        occupant never leaks into the new generation."""
+        import jax
+        import jax.numpy as jnp
+
+        model, S, cache_dtype = self._model, self.max_len, self._cache_dtype
+
+        def prefill(state, slot, padded, true_len, key, temp, top_k, top_p):
+            b1 = model.init_cache(1, S, dtype=cache_dtype)
+            logits, b1 = model.forward_with_cache(padded[None], b1,
+                                                  index=0)
+            key, sub = jax.random.split(key)
+            tok0 = _sample_slot(logits[0, true_len - 1], sub, temp, top_k,
+                                top_p)
+            cache = jax.tree_util.tree_map(
+                lambda big, sm: big.at[slot].set(sm), state["cache"], b1)
+            return dict(
+                cache=cache,
+                tok=state["tok"].at[slot].set(tok0),
+                pos=state["pos"].at[slot].set(true_len),
+                keys=state["keys"].at[slot].set(key),
+                temp=state["temp"].at[slot].set(temp),
+                top_k=state["top_k"].at[slot].set(jnp.asarray(top_k,
+                                                              jnp.int32)),
+                top_p=state["top_p"].at[slot].set(top_p),
+            ), tok0
+
+        return jax.jit(prefill, donate_argnums=(0,))
+
+    def _bucket(self, n: int) -> int:
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    # -- public surface ----------------------------------------------------
+    def start(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
+              top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
+              seed: int = 0) -> str:
+        """Enqueue a generation; returns its id immediately. Raises
+        :class:`EngineOverloaded` (retryable) when every slot is busy and
+        the admit queue is at ``queue_max``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine's per-slot "
+                f"capacity ({self.max_len}); raise FLAGS_gen_max_len")
+        eos = self._eos_default if eos_token_id is _UNSET else eos_token_id
+        gen = Generation(uuid.uuid4().hex[:16], prompt, max_new_tokens,
+                         float(temperature), int(top_k), float(top_p),
+                         None if eos is None else int(eos), int(seed))
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("GenerationEngine is stopped")
+            if self._broken is not None:
+                raise RuntimeError(
+                    f"GenerationEngine is broken: {self._broken}")
+            free = sum(g is None for g in self._slot_gen)
+            if (self._queue_max > 0
+                    and len(self._queue) - free >= self._queue_max):
+                stat_add("gen/shed")
+                raise EngineOverloaded(
+                    f"engine full: {self.slots} slots busy, "
+                    f"{len(self._queue)} queued (queue_max="
+                    f"{self._queue_max})")
+            self._queue.append(gen)
+            self._gens[gen.gen_id] = gen
+            stat_set("gen/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return gen.gen_id
+
+    def poll(self, gen_id: str, start: int = 0,
+             wait_s: float = 0.0) -> dict:
+        """Drain tokens past ``start``; blocks up to ``wait_s`` for new
+        ones (long-poll). Returns ``{"tokens", "done", "error",
+        "queued"}``. Polling refreshes the generation's TTL — a client
+        that stops polling for ``ttl_s`` is treated as disconnected and
+        its slot reclaimed."""
+        start = max(int(start), 0)
+        deadline = time.monotonic() + max(float(wait_s), 0.0)
+        with self._cond:
+            gen = self._gens.get(gen_id)
+            if gen is None:
+                raise KeyError(f"unknown generation {gen_id!r} "
+                               "(finished long ago, evicted, or never "
+                               "started here)")
+            gen.last_poll = time.monotonic()
+            while (not gen.done and len(gen.tokens) <= start
+                   and not self._stopping):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+                gen.last_poll = time.monotonic()
+            return {"tokens": list(gen.tokens[start:]), "done": gen.done,
+                    "error": gen.error,
+                    "queued": gen.slot is None and not gen.done}
+
+    def cancel(self, gen_id: str) -> bool:
+        """Cancel a generation and free its slot (idempotent; unknown
+        ids return False). A freed slot is eligible for the very next
+        admit."""
+        with self._cond:
+            gen = self._gens.pop(gen_id, None)
+            if gen is None:
+                return False
+            gen.cancelled = True
+            if not gen.done:
+                gen.done = True
+                gen.error = gen.error or "cancelled"
+                self._release_slot_locked(gen, evicted=True)
+                try:
+                    self._queue.remove(gen)
+                except ValueError:
+                    pass
+                stat_set("gen/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return True
+
+    def stats(self) -> dict:
+        """Slot occupancy snapshot (shipped in the serving ``health``
+        op)."""
+        with self._cond:
+            active = sum(g is not None for g in self._slot_gen)
+            return {"slots": self.slots, "active": active,
+                    "free": self.slots - active,
+                    "queued": len(self._queue),
+                    "generations": len(self._gens),
+                    "max_len": self.max_len,
+                    "broken": self._broken}
+
+    def close(self) -> None:
+        """Stop the loop; error out queued/active generations."""
+        with self._cond:
+            if self._stopping:
+                return
+            self._stopping = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+        with self._cond:
+            for gen in list(self._gens.values()):
+                if not gen.done:
+                    gen.done = True
+                    gen.error = gen.error or "engine stopped"
+                    gen.slot = None
+            self._slot_gen = [None] * self.slots
+            self._queue.clear()
+            self._cond.notify_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+
+        while True:
+            with self._cond:
+                if self._stopping:
+                    return
+                if (not self._queue
+                        and not any(g is not None for g in self._slot_gen)):
+                    # idle: wake on new work, and periodically anyway so
+                    # TTL reaping runs while nothing is streaming
+                    self._cond.wait(timeout=0.25)
+                    if self._stopping:
+                        return
+            try:
+                self._reap_expired()
+                self._admit()
+                self._decode_step(jnp)
+            except Exception as e:   # device-side failure: fail loudly,
+                self._break(e)       # refuse new work, keep pollers sane
+                return
+
+    def _break(self, e: Exception) -> None:
+        msg = f"{type(e).__name__}: {e}"
+        with self._cond:
+            self._broken = msg
+            for gen in list(self._gens.values()):
+                if not gen.done:
+                    gen.done = True
+                    gen.error = msg
+                    gen.slot = None
+            self._slot_gen = [None] * self.slots
+            self._queue.clear()
+            self._cond.notify_all()
+
+    def _release_slot_locked(self, gen: Generation,
+                             evicted: bool = False) -> None:
+        if gen.slot is not None and self._slot_gen[gen.slot] is gen:
+            self._slot_gen[gen.slot] = None
+            if evicted:
+                stat_add("gen/evictions")
+        gen.slot = None
+        stat_set("gen/slots_active",
+                 sum(g is not None for g in self._slot_gen))
+
+    def _reap_expired(self) -> None:
+        if self._ttl_s <= 0:
+            return
+        now = time.monotonic()
+        with self._cond:
+            expired = [g for g in self._gens.values()
+                       if now - max(g.created, g.last_poll) > self._ttl_s]
+        for gen in expired:
+            with self._cond:
+                g = self._gens.pop(gen.gen_id, None)
+                if g is None:
+                    continue
+                if not g.done:
+                    g.done = True
+                    g.error = "evicted: poll TTL exceeded (client gone?)"
+                    self._release_slot_locked(g, evicted=True)
+                    try:
+                        self._queue.remove(g)
+                    except ValueError:
+                        pass
+                self._cond.notify_all()
+
+    def _admit(self) -> None:
+        while True:
+            with self._cond:
+                free = [s for s, g in enumerate(self._slot_gen)
+                        if g is None]
+                if not free or not self._queue:
+                    stat_set("gen/queue_depth", len(self._queue))
+                    return
+                gen = self._queue.popleft()
+                if gen.done:          # cancelled while queued
+                    continue
+                slot = free[0]
+                self._slot_gen[slot] = gen
+                gen.slot = slot
+                stat_set("gen/slots_active",
+                         sum(g is not None for g in self._slot_gen))
+            self._prefill(gen, slot)
+
+    def _prefill(self, gen: Generation, slot: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        T0 = gen.prompt.size
+        bucket = self._bucket(T0)
+        padded = np.full((bucket,), self._pad, np.int32)
+        padded[:T0] = gen.prompt
+        key = jax.random.PRNGKey(gen.seed)
+        t0 = time.perf_counter()
+        with _trace.span("gen/prefill", slot=slot, prompt_len=T0,
+                         bucket=bucket):
+            self._state, tok0 = self._prefill_fn(
+                self._state, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(T0, jnp.int32), key,
+                jnp.asarray(gen.temperature, jnp.float32),
+                jnp.asarray(gen.top_k, jnp.int32),
+                jnp.asarray(gen.top_p, jnp.float32))
+            tok0 = int(tok0)
+        observe("gen/prefill_s", time.perf_counter() - t0)
+        with self._cond:
+            if self._slot_gen[slot] is not gen:   # cancelled mid-prefill
+                return
+            gen.tokens.append(tok0)
+            stat_add("gen/tokens")
+            if ((gen.eos_token_id is not None
+                 and tok0 == gen.eos_token_id)
+                    or len(gen.tokens) >= gen.max_new_tokens):
+                gen.done = True
+                self._release_slot_locked(gen)
+            self._cond.notify_all()
+
+    def _decode_step(self, jnp) -> None:
+        with self._cond:
+            stepped = [(s, g) for s, g in enumerate(self._slot_gen)
+                       if g is not None]
+            if not stepped:
+                return
+            active = np.zeros((self.slots,), bool)
+            for s, _ in stepped:
+                active[s] = True
+        t0 = time.perf_counter()
+        with _trace.span("gen/decode_step", active=len(stepped)):
+            self._state, toks = self._step(self._state,
+                                           jnp.asarray(active))
+            toks = np.asarray(toks)
+        observe("gen/decode_step_s", time.perf_counter() - t0)
+        with self._cond:
+            emitted = 0
+            for s, gen in stepped:
+                if self._slot_gen[s] is not gen:   # cancelled mid-step
+                    continue
+                tok = int(toks[s])
+                gen.tokens.append(tok)
+                emitted += 1
+                if ((gen.eos_token_id is not None
+                     and tok == gen.eos_token_id)
+                        or len(gen.tokens) >= gen.max_new_tokens):
+                    gen.done = True
+                    self._release_slot_locked(gen)
+            if emitted:
+                stat_add("gen/tokens", emitted)
+            self._cond.notify_all()
+        if self.step_wait_s > 0:
+            time.sleep(self.step_wait_s)

@@ -33,6 +33,8 @@ Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
 from __future__ import annotations
 
 import threading
+import uuid
+import zlib
 from typing import Callable
 
 import numpy as np
@@ -42,7 +44,21 @@ from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.core.wire import FrameClient, WireShedError
 from paddle_tpu.io.serving import InferenceClient
 
-__all__ = ["RoutedClient", "ReplicaState"]
+__all__ = ["RoutedClient", "ReplicaState", "StickySession",
+           "GenerationFailed"]
+
+
+class GenerationFailed(ConnectionError):
+    """A non-idempotent generation op failed on its pinned replica.
+    NEVER silently failed over — the generation's slot (KV cache + token
+    stream) lives on exactly one replica, so rerouting a poll would
+    return "unknown generation" and rerouting a start would leak a slot.
+    ``endpoint`` names the replica so the caller can restart the
+    generation elsewhere."""
+
+    def __init__(self, msg: str, endpoint: str):
+        super().__init__(msg)
+        self.endpoint = endpoint
 
 
 class ReplicaState:
@@ -273,6 +289,32 @@ class RoutedClient:
         raise ConnectionError("no replicas available "
                               f"(members: {self.endpoints()})")
 
+    # -- session-sticky routing (generation affinity) ----------------------
+    def session(self, session_id: str | None = None) -> "StickySession":
+        """A sticky handle: hash ``session_id`` onto one healthy member
+        and keep every op there (a generation's slot state is
+        replica-local, so its start/poll/cancel MUST hit one replica).
+        Re-picks only on member loss, and never while a generation is in
+        flight — that surfaces as :class:`GenerationFailed` instead."""
+        return StickySession(self, session_id or uuid.uuid4().hex)
+
+    def generate(self, model: str, prompt, max_new_tokens: int, **kw):
+        """Streaming generation through a fresh sticky session (see
+        :meth:`session` for multi-op affinity)."""
+        return self.session().generate(model, prompt, max_new_tokens,
+                                       **kw)
+
+    def _replica_for(self, endpoint: str) -> ReplicaState | None:
+        with self._lock:
+            for r in self._replicas:
+                if r.endpoint == endpoint:
+                    return r
+        return None
+
+    def _healthy_endpoints(self) -> list[str]:
+        with self._lock:
+            return sorted(r.endpoint for r in self._replicas if r.healthy)
+
     # -- the routed serving surface ---------------------------------------
     def infer(self, model: str, *inputs) -> list[np.ndarray]:
         return self._routed(lambda c: c.infer(model, *inputs))
@@ -332,3 +374,144 @@ class RoutedClient:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class StickySession:
+    """Session-sticky view of a :class:`RoutedClient`: every op runs on
+    ONE pinned replica (``crc32(session_id)`` over the sorted healthy
+    membership, so the same session id re-pins to the same replica from
+    any client while membership holds).
+
+    Failure semantics differ from the routed path on purpose:
+
+    - the pin is re-evaluated only between generations — member loss
+      with no generation in flight re-picks quietly
+      (``serving/router/session_repick``);
+    - a connect error/timeout during an in-flight generation raises
+      :class:`GenerationFailed` carrying the replica endpoint (and marks
+      the replica down for the routed traffic) — NEVER a silent retry
+      elsewhere: the slot state is gone, the caller must restart;
+    - a shed ``generate_start`` (:class:`~paddle_tpu.core.wire.
+      WireShedError`) propagates as-is: it never executed, so the caller
+      may back off and retry — on this session or a fresh one.
+    """
+
+    def __init__(self, router: RoutedClient, session_id: str):
+        self._router = router
+        self.session_id = session_id
+        self._endpoint: str | None = None
+        self._active = 0               # generations currently streaming
+        self._lock = threading.Lock()
+
+    @property
+    def endpoint(self) -> str | None:
+        """The pinned replica (None until the first op pins one)."""
+        return self._endpoint
+
+    def _pin(self) -> ReplicaState:
+        healthy = self._router._healthy_endpoints()
+        with self._lock:
+            if self._endpoint is not None and self._endpoint not in healthy:
+                if self._active:
+                    raise GenerationFailed(
+                        f"replica {self._endpoint} lost with "
+                        f"{self._active} generation(s) in flight on "
+                        f"session {self.session_id}; restart them",
+                        self._endpoint)
+                stat_add("serving/router/session_repick")
+                self._endpoint = None
+            if self._endpoint is None:
+                if not healthy:
+                    raise ConnectionError(
+                        "no healthy replicas to pin session "
+                        f"{self.session_id} (members: "
+                        f"{self._router.endpoints()})")
+                idx = zlib.crc32(self.session_id.encode()) % len(healthy)
+                self._endpoint = healthy[idx]
+        r = self._router._replica_for(self._endpoint)
+        if r is None:
+            raise GenerationFailed(
+                f"replica {self._endpoint} removed from membership",
+                self._endpoint)
+        return r
+
+    def _client(self) -> InferenceClient:
+        return self._router._client(self._pin())
+
+    def _wrap(self, fn, *, during_generation: bool):
+        ep = self._endpoint
+        try:
+            return fn()
+        except WireShedError:
+            raise                     # never executed: safe anywhere
+        except (ConnectionError, TimeoutError, OSError) as e:
+            if isinstance(e, GenerationFailed):
+                raise
+            r = self._router._replica_for(ep) if ep else None
+            if r is not None:
+                self._router._mark_down(r, e)
+            if during_generation:
+                raise GenerationFailed(
+                    f"generation op failed on replica {ep}: "
+                    f"{type(e).__name__}: {e} — slot state lost, "
+                    "restart the generation", ep or "?") from e
+            raise
+
+    def infer(self, model: str, *inputs) -> list[np.ndarray]:
+        """Sticky infer (cache/session affinity). Errors surface; the
+        next call re-pins if the member was lost."""
+        client = self._client()
+        return self._wrap(lambda: client.infer(model, *inputs),
+                          during_generation=False)
+
+    def health(self) -> dict:
+        client = self._client()
+        return self._wrap(lambda: client.health(),
+                          during_generation=False)
+
+    def generate(self, model: str, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id: int | None = None,
+                 seed: int = 0, poll_wait_s: float = 0.25):
+        """Streaming generation pinned to the session's replica: start,
+        every poll, and the close-time cancel all hit the replica
+        holding the slot. Returns an iterator of token ids."""
+        client = self._client()
+        ep = self._endpoint
+        gen_id = self._wrap(
+            lambda: client.generate_start(
+                model, prompt, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                seed=seed),
+            during_generation=True)
+        with self._lock:
+            self._active += 1
+
+        def stream():
+            n, finished = 0, False
+            try:
+                while True:
+                    doc = self._wrap(
+                        lambda: client.generate_poll(
+                            model, gen_id, start=n, wait_s=poll_wait_s),
+                        during_generation=True)
+                    for tok in doc["tokens"]:
+                        yield int(tok)
+                    n += len(doc["tokens"])
+                    if doc["done"]:
+                        finished = True
+                        if doc.get("error"):
+                            raise RuntimeError(
+                                f"generation {gen_id} on {ep} failed: "
+                                f"{doc['error']}")
+                        return
+            finally:
+                with self._lock:
+                    self._active -= 1
+                if not finished:
+                    try:
+                        client.generate_cancel(model, gen_id)
+                    except (RuntimeError, ConnectionError, OSError):
+                        pass
+
+        return stream()

@@ -1,0 +1,486 @@
+"""Continuous-batching generation engine: slot scheduling, streaming
+wire ops, session-sticky routing, and the early-exit decode loop.
+
+The load-bearing property is determinism: a greedy generation through
+the slot engine — admitted into a shared batched KV cache, stepped
+alongside arbitrary co-tenants, prefetched through a right-padded
+bucket — must be byte-identical to a solo
+``models.generation.generate`` call.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core.flags import flag, set_flags
+from paddle_tpu.core.monitor import get_stat
+from paddle_tpu.core.wire import WireShedError
+from paddle_tpu.io.serving import InferenceClient, InferenceServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.serving import (
+    EngineOverloaded, GenerationEngine, GenerationFailed, RoutedClient,
+)
+
+pytestmark = pytest.mark.gen
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    with GenerationEngine(model, slots=3, max_len=32, queue_max=4,
+                          ttl_s=10.0) as eng:
+        yield eng
+
+
+@pytest.fixture(scope="module")
+def server(model, engine):
+    srv = InferenceServer().start()
+    srv.add_generator("llm", engine)   # pre-built engine: no recompile
+    client = InferenceClient(srv.endpoint)
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            return toks, doc["error"]
+
+
+def _wait_active(engine, pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred(engine.stats()):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_interleaved_matches_solo_generate(model, engine):
+    """8 concurrent greedy generations through 3 slots (queueing forces
+    admits/retires mid-flight) are byte-identical to solo generate()."""
+    rs = np.random.RandomState(1)
+    prompts = rs.randint(0, VOCAB, (8, 6)).astype(np.int32)
+    ref = np.asarray(generate(model, prompts, 5))[:, 6:]
+
+    out = {}
+
+    def worker(i):
+        gid = None
+        while gid is None:
+            try:
+                gid = engine.start(prompts[i], 5)
+            except EngineOverloaded as e:
+                time.sleep(e.retry_after_s)
+        out[i] = _drain(engine, gid)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    for i in range(8):
+        toks, err = out[i]
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(toks, np.int32), ref[i],
+                                      err_msg=f"request {i}")
+    st = engine.stats()
+    assert st["active"] == 0 and st["queued"] == 0
+
+
+def test_variable_lengths_and_late_admit(model, engine):
+    """Different prompt lengths (different prefill buckets) and a late
+    admit into a freed slot still match solo generate exactly."""
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, VOCAB, (n,)).astype(np.int32)
+               for n in (3, 9, 5)]
+    gids = [engine.start(p, 4) for p in prompts]
+    outs = [_drain(engine, g) for g in gids]
+    for p, (toks, err) in zip(prompts, outs):
+        assert err is None
+        ref = np.asarray(generate(model, p[None], 4))[0, len(p):]
+        np.testing.assert_array_equal(np.asarray(toks, np.int32), ref)
+
+
+def test_eos_retires_slot_early(model, engine):
+    """A request whose eos fires mid-stream stops there (stream ends
+    with eos) and frees its slot without running to max_new_tokens."""
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, VOCAB, (6,)).astype(np.int32)
+    ref = np.asarray(generate(model, prompt[None], 6))[0, 6:]
+    eos = int(ref[2])                        # finish after 3 tokens
+    gid = engine.start(prompt, 6, eos_token_id=eos)
+    toks, err = _drain(engine, gid)
+    assert err is None
+    np.testing.assert_array_equal(np.asarray(toks, np.int32), ref[:3])
+    assert engine.stats()["active"] == 0
+
+
+def test_cancel_frees_slot_others_uninterrupted(model, engine):
+    rs = np.random.RandomState(4)
+    p_a = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+    p_b = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+    ref_b = np.asarray(generate(model, p_b[None], 10))[0, 5:]
+    ev0 = get_stat("gen/evictions")
+    engine.step_wait_s = 0.02     # pace the loop so "mid-flight" exists
+    try:
+        gid_a = engine.start(p_a, 20)
+        gid_b = engine.start(p_b, 10)
+        # let both stream a little, then cancel A mid-flight
+        while len(engine.poll(gid_a, wait_s=0.5)["tokens"]) < 2:
+            pass
+        assert engine.cancel(gid_a)
+        toks_b, err_b = _drain(engine, gid_b)
+    finally:
+        engine.step_wait_s = 0.0
+    assert err_b is None
+    np.testing.assert_array_equal(np.asarray(toks_b, np.int32), ref_b)
+    doc = engine.poll(gid_a) if gid_a in engine._gens else None
+    assert doc is None                      # cancelled gens are dropped
+    assert get_stat("gen/evictions") == ev0 + 1
+    assert _wait_active(engine, lambda s: s["active"] == 0)
+
+
+def test_full_engine_sheds_start(model, engine):
+    """slots busy + queue at queue_max -> EngineOverloaded (retryable),
+    and capacity returns once generations are cancelled."""
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, VOCAB, (4,)).astype(np.int32)
+               for _ in range(7)]
+    engine.step_wait_s = 0.03     # keep slots visibly busy
+    try:
+        gids = [engine.start(p, 25) for p in prompts]  # 3 run + 4 queue
+        assert _wait_active(engine, lambda s: s["active"] == 3
+                            and s["queued"] >= 4)
+        with pytest.raises(EngineOverloaded) as ei:
+            engine.start(prompts[0], 25)
+        assert ei.value.retry_after_s > 0
+        for g in gids:
+            engine.cancel(g)
+    finally:
+        engine.step_wait_s = 0.0
+    assert _wait_active(engine, lambda s: s["active"] == 0
+                        and s["queued"] == 0)
+    gid = engine.start(prompts[0], 2)               # works again
+    toks, err = _drain(engine, gid)
+    assert err is None and len(toks) == 2
+
+
+def test_poll_ttl_reaps_disconnected_client(model, engine):
+    """A generation whose client stops polling is evicted after the TTL
+    and its slot reclaimed — the disconnect story."""
+    old = engine._ttl_s
+    engine._ttl_s = 0.3
+    engine.step_wait_s = 0.05     # generation outlives the TTL window
+    try:
+        rs = np.random.RandomState(6)
+        gid = engine.start(rs.randint(0, VOCAB, (4,)).astype(np.int32),
+                           25)
+        assert _wait_active(engine, lambda s: s["active"] == 1)
+        ev0 = get_stat("gen/evictions")
+        # no polls -> TTL expires -> slot freed, gen forgotten
+        assert _wait_active(engine, lambda s: s["active"] == 0
+                            and s["generations"] == 0, timeout=3.0)
+        assert get_stat("gen/evictions") >= ev0 + 1
+        with pytest.raises(KeyError):
+            engine.poll(gid)
+    finally:
+        engine._ttl_s = old
+        engine.step_wait_s = 0.0
+
+
+def test_sampled_generation_is_per_request_deterministic(model, engine):
+    """Sampling params are per-slot traced state: the same (prompt,
+    seed) yields the same stream regardless of co-tenants."""
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+    runs = []
+    for _ in range(2):
+        gid = engine.start(prompt, 6, temperature=0.8, top_k=7,
+                           top_p=0.9, seed=42)
+        toks, err = _drain(engine, gid)
+        assert err is None
+        runs.append(toks)
+    assert runs[0] == runs[1]
+    assert all(0 <= t < VOCAB for t in runs[0])
+
+
+def test_engine_requires_slots_flag(model):
+    """FLAGS_gen_slots=0 (default) keeps generation serving off: no
+    engine, no background thread, the serving path untouched."""
+    assert int(flag("gen_slots")) == 0
+    with pytest.raises(ValueError, match="gen_slots"):
+        GenerationEngine(model)
+    with pytest.raises(ValueError, match="gen_slots"):
+        InferenceServer().add_generator("llm", model)
+    set_flags({"gen_slots": 2})
+    try:
+        eng = GenerationEngine(model, max_len=32)
+        assert eng.slots == 2
+        eng.close()
+    finally:
+        set_flags({"gen_slots": 0})
+
+
+def test_start_validates_capacity(model, engine):
+    with pytest.raises(ValueError, match="capacity"):
+        engine.start(np.arange(10, dtype=np.int32), 30)   # 40 > 32
+    with pytest.raises(ValueError, match="empty"):
+        engine.start(np.zeros((0,), np.int32), 4)
+
+
+def test_wire_stream_and_health(model, engine, server):
+    """Client streaming iterator over the wire matches solo generate;
+    health reports slot occupancy; breaking the stream cancels
+    server-side so the slot frees immediately."""
+    srv, client = server
+    rs = np.random.RandomState(8)
+    prompt = rs.randint(0, VOCAB, (6,)).astype(np.int32)
+    ref = np.asarray(generate(model, prompt[None], 5))[0, 6:]
+    toks = list(client.generate("llm", prompt, 5))
+    np.testing.assert_array_equal(np.asarray(toks, np.int32), ref)
+
+    h = client.health()
+    assert h["generators"]["llm"]["slots"] == 3
+
+    it = client.generate("llm", prompt, 25)
+    assert next(it) == int(ref[0])
+    it.close()                              # break mid-stream -> cancel
+    assert _wait_active(engine, lambda s: s["active"] == 0)
+
+
+def test_wire_full_engine_sheds_with_retry_hint(model, engine, server):
+    """A full engine sheds generate_start with CODE_SHED +
+    retry_after_s — the typed, retryable WireShedError a no-retry
+    client surfaces (never an opaque error; the start never ran) — and
+    capacity returns once generations are cancelled."""
+    srv, client = server
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, VOCAB, (4,)).astype(np.int32)
+               for _ in range(7)]
+    engine.step_wait_s = 0.03
+    try:
+        gids = [engine.start(p, 25) for p in prompts]
+        assert _wait_active(engine, lambda s: s["active"] == 3
+                            and s["queued"] >= 4)
+        c0 = InferenceClient(srv.endpoint, retries=0)
+        try:
+            with pytest.raises(WireShedError, match="engine full"):
+                c0.generate_start("llm", prompts[0], 25)
+        finally:
+            c0.close()
+        for g in gids:
+            engine.cancel(g)
+        assert _wait_active(engine, lambda s: s["active"] == 0)
+    finally:
+        engine.step_wait_s = 0.0
+    toks = list(client.generate("llm", prompts[0], 2))
+    assert len(toks) == 2                   # capacity returned
+
+
+def test_wire_unknown_generator_and_generation(server):
+    srv, client = server
+    with pytest.raises(RuntimeError, match="no generator"):
+        client.generate_start("nope", [1, 2, 3], 4)
+    with pytest.raises(RuntimeError, match="unknown generation"):
+        client.generate_poll("llm", "deadbeef")
+
+
+# -- session-sticky routing -------------------------------------------------
+
+def test_session_sticky_pick_and_repick_on_loss():
+    """Same session id -> same replica while membership holds; member
+    loss re-picks only when no generation is in flight."""
+    servers = [InferenceServer().start() for _ in range(3)]
+    router = RoutedClient([s.endpoint for s in servers],
+                          probe_interval_s=0)
+    try:
+        s1 = router.session("sess-abc")
+        s2 = router.session("sess-abc")
+        assert s1.health()["status"] == "ok"
+        assert s2.health()["status"] == "ok"
+        assert s1.endpoint == s2.endpoint      # deterministic pin
+        pinned = s1.endpoint
+        for _ in range(3):
+            s1.health()
+            assert s1.endpoint == pinned       # sticky across ops
+
+        router.remove_endpoint(pinned)
+        s1.health()                            # member loss -> re-pick
+        assert s1.endpoint is not None and s1.endpoint != pinned
+
+        # an in-flight generation must NOT re-pick silently
+        s3 = router.session("sess-xyz")
+        s3.health()
+        s3._active = 1
+        router.remove_endpoint(s3.endpoint)
+        with pytest.raises(GenerationFailed) as ei:
+            s3.health()
+        assert ei.value.endpoint not in router.endpoints()
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.slow
+def test_session_generate_no_silent_failover(model):
+    """Kill the replica holding a generation mid-stream: the session
+    surfaces GenerationFailed naming the replica (never silently
+    reroutes the poll), and a restart on the survivor succeeds."""
+    paddle_tpu.seed(7)
+    servers = []
+    for _ in range(2):
+        srv = InferenceServer().start()
+        srv.add_generator("llm", model, slots=2, max_len=32)
+        servers.append(srv)
+    router = RoutedClient([s.endpoint for s in servers],
+                          probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(10)
+        prompt = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 4))[0, 5:]
+        sess = router.session("victim")
+        it = sess.generate("llm", prompt, 25, poll_wait_s=0.05)
+        next(it)
+        pinned = sess.endpoint
+        victim = next(s for s in servers if s.endpoint == pinned)
+        victim.stop()
+        with pytest.raises(GenerationFailed) as ei:
+            list(it)
+        assert ei.value.endpoint == pinned
+
+        sess2 = router.session("survivor-run")
+        toks = list(sess2.generate("llm", prompt, 4))
+        np.testing.assert_array_equal(np.asarray(toks, np.int32), ref)
+        assert sess2.endpoint != pinned
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()
+
+
+# -- generate(): while_loop early exit --------------------------------------
+
+def _fori_reference(model, input_ids, max_new_tokens, *, temperature=0.0,
+                    eos_token_id=None, pad_token_id=0, key=None):
+    """The pre-while_loop decode loop (fixed trip count), kept here as
+    the regression reference for the early-exit rewrite."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.generation import sample_logits
+
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, T0 = input_ids.shape
+    S = T0 + int(max_new_tokens)
+    cache = model.init_cache(B, S, dtype=None)
+    logits, cache = model.forward_with_cache(input_ids, cache, index=0)
+    seq = jnp.concatenate(
+        [input_ids, jnp.full((B, max_new_tokens), pad_token_id,
+                             jnp.int32)], axis=1)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        return sample_logits(logits, None if temperature == 0.0 else key,
+                             temperature=temperature)
+
+    key, sub = jax.random.split(key)
+    next_tok = pick(logits[:, -1], sub)
+    finished = jnp.zeros((B,), bool)
+    if eos_token_id is not None:
+        finished = next_tok == eos_token_id
+    seq = jax.lax.dynamic_update_slice(seq, next_tok[:, None], (0, T0))
+
+    def body(i, state):
+        seq, cache, prev_tok, finished, key = state
+        logits, cache = model.forward_with_cache(
+            prev_tok[:, None], cache, index=T0 + i - 1)
+        key, sub = jax.random.split(key)
+        tok = pick(logits[:, -1], sub)
+        if eos_token_id is not None:
+            tok = jnp.where(finished, pad_token_id, tok)
+            finished = finished | (tok == eos_token_id)
+        seq = jax.lax.dynamic_update_slice(seq, tok[:, None], (0, T0 + i))
+        return seq, cache, tok, finished, key
+
+    if max_new_tokens > 1:
+        seq, *_ = jax.lax.fori_loop(1, max_new_tokens, body,
+                                    (seq, cache, next_tok, finished, key))
+    return seq
+
+
+def test_generate_while_matches_fori_reference(model):
+    """The while_loop rewrite is output-identical to the old fixed-trip
+    fori_loop — with an eos that fires early, and without one."""
+    import jax
+
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, VOCAB, (2, 5)).astype(np.int32)
+    # greedy, eos chosen so one row finishes early
+    base = np.asarray(generate(model, prompt, 8))
+    eos = int(base[0, 5 + 2])
+    got = np.asarray(generate(model, prompt, 8, eos_token_id=eos))
+    want = np.asarray(_fori_reference(model, prompt, 8,
+                                      eos_token_id=eos))
+    np.testing.assert_array_equal(got, want)
+    # sampled, no eos: full trip count, same key schedule
+    key = jax.random.PRNGKey(3)
+    got = np.asarray(generate(model, prompt, 6, temperature=0.7,
+                              key=key))
+    want = np.asarray(_fori_reference(model, prompt, 6, temperature=0.7,
+                                      key=key))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_while_exits_early():
+    """The loop really stops once every row finished: a callback-counting
+    fake model sees ~2 forward calls, not max_new_tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    EOS, V = 3, 8
+    calls = []
+
+    class FakeModel:
+        def init_cache(self, B, S, dtype=None):
+            return (jnp.zeros((1, B, 1, S, 1), jnp.float32),) * 2
+
+        def forward_with_cache(self, ids, cache, index):
+            B, T = ids.shape
+
+            def emit(ids_np):
+                calls.append(1)
+                logits = np.zeros((B, T, V), np.float32)
+                logits[:, :, EOS] = 1.0           # always pick EOS
+                return logits
+
+            logits = jax.pure_callback(
+                emit, jax.ShapeDtypeStruct((B, T, V), jnp.float32), ids)
+            return logits, cache
+
+    out = generate(FakeModel(), np.ones((2, 3), np.int32), 10,
+                   eos_token_id=EOS)
+    assert out.shape == (2, 13)
+    # prefill picks EOS for every row -> finished before the loop; the
+    # old fori_loop would have called forward 10 times regardless
+    assert sum(calls) <= 2, f"loop did not exit early: {sum(calls)} calls"
+    assert int(out[0, 3]) == EOS and int(out[0, 4]) == 0

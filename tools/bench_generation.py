@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Generation-serving benchmark: continuous-batching engine vs
+sequential ``generate()`` at request concurrency 1 / 4 / 8 on CPU.
+
+What it measures: N greedy generation requests arriving at once.
+
+- **sequential** is the status-quo path (PR 4 and earlier): one
+  compiled whole-loop ``generate`` (jitted once; compile excluded) runs
+  each request to completion before the next starts — a long generation
+  starves every caller behind it, and every decode step reads the full
+  weight set for ONE sequence.
+- **engine** is the continuous-batching ``GenerationEngine``: requests
+  are admitted into KV-cache slots and stepped together, so each fused
+  decode step reads the weights once for ALL active sequences
+  (decode on CPU/TPU is memory-bound — that weight-read amortization,
+  plus per-dispatch overhead amortization, is the whole win).
+
+Per cell: aggregate tokens/s (total emitted tokens / wall time from
+submission to last completion) and time-to-first-token p50/p99 across
+requests — TTFT is when the caller can SEE a token: the engine streams,
+so its TTFT is roughly one prefill + queue wait; the sequential path
+only surfaces tokens when a request's whole loop finishes, so its tail
+TTFT grows linearly with the queue. Each cell is the median of
+``--reps`` runs after warmup (all compiles primed).
+
+Writes ``BENCH_generation.json`` (repo root by default); the headline
+metric is the concurrency-8 tokens/s speedup — acceptance floor 1.5x.
+
+Usage: ``JAX_PLATFORMS=cpu python tools/bench_generation.py [-o OUT]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu                                      # noqa: E402
+from paddle_tpu.models import (                        # noqa: E402
+    LlamaConfig, LlamaForCausalLM,
+)
+from paddle_tpu.models.generation import generate      # noqa: E402
+from paddle_tpu.serving import GenerationEngine        # noqa: E402
+
+# Geometry: big enough that a decode step is weight-read-bound (the
+# regime batching amortizes), small enough for a CPU bench run.
+VOCAB, HIDDEN, LAYERS, HEADS = 512, 256, 4, 8
+PROMPT_LEN, MAX_NEW, MAX_LEN, SLOTS = 16, 32, 64, 8
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    ys = sorted(xs)
+    i = min(int(round(q * (len(ys) - 1))), len(ys) - 1)
+    return ys[i]
+
+
+def bench_sequential(solo, prompts) -> dict:
+    t0 = time.perf_counter()
+    ttft, tokens = [], 0
+    for p in prompts:
+        out = np.asarray(solo(p[None]))       # blocks to completion
+        ttft.append(time.perf_counter() - t0)  # first visible token
+        tokens += out.shape[1] - PROMPT_LEN
+    wall = time.perf_counter() - t0
+    return {"tokens": tokens, "wall_s": wall,
+            "tokens_per_s": tokens / wall, "ttft": ttft}
+
+
+def bench_engine(engine, prompts) -> dict:
+    n = len(prompts)
+    ttft = [0.0] * n
+    counts = [0] * n
+    done_at = [0.0] * n
+    gate = threading.Barrier(n + 1)
+
+    def worker(i):
+        gate.wait()
+        gid = engine.start(prompts[i], MAX_NEW)
+        first, nread = None, 0
+        while True:
+            doc = engine.poll(gid, start=nread, wait_s=1.0)
+            if doc["tokens"] and first is None:
+                first = time.perf_counter()
+            nread += len(doc["tokens"])
+            if doc["done"]:
+                if doc["error"]:
+                    raise RuntimeError(doc["error"])
+                break
+        ttft[i] = first - t0
+        counts[i] = nread
+        done_at[i] = time.perf_counter()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = max(done_at) - t0
+    tokens = sum(counts)
+    return {"tokens": tokens, "wall_s": wall,
+            "tokens_per_s": tokens / wall, "ttft": ttft}
+
+
+def summarize(runs: list[dict]) -> dict:
+    ttft = runs[0]["ttft"]    # per-request spread from the first run
+    return {
+        "tokens_per_s": statistics.median(r["tokens_per_s"]
+                                          for r in runs),
+        "wall_s": statistics.median(r["wall_s"] for r in runs),
+        "tokens": runs[0]["tokens"],
+        "ttft_p50_s": _percentile(ttft, 0.50),
+        "ttft_p99_s": _percentile(ttft, 0.99),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_generation.json"))
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--concurrency", type=int, nargs="*",
+                    default=[1, 4, 8])
+    args = ap.parse_args()
+
+    import jax
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=HIDDEN,
+                           num_layers=LAYERS, num_heads=HEADS,
+                           num_kv_heads=HEADS, max_seq_len=MAX_LEN)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    all_prompts = rs.randint(0, VOCAB, (max(args.concurrency),
+                                        PROMPT_LEN)).astype(np.int32)
+
+    solo = jax.jit(lambda ids: generate(model, ids, MAX_NEW))
+    engine = GenerationEngine(model, slots=SLOTS, max_len=MAX_LEN,
+                              queue_max=32)
+
+    # warmup: prime the solo compile, the engine prefill bucket + step,
+    # and sanity-check engine output == solo output on the way
+    ref = np.asarray(solo(all_prompts[:1]))[0, PROMPT_LEN:]
+    gid = engine.start(all_prompts[0], MAX_NEW)
+    toks, nread = [], 0
+    while True:
+        doc = engine.poll(gid, start=nread, wait_s=1.0)
+        toks += doc["tokens"]
+        nread = len(toks)
+        if doc["done"]:
+            break
+    if not np.array_equal(np.asarray(toks, np.int32), ref):
+        print("FATAL: engine output diverges from solo generate",
+              file=sys.stderr)
+        return 1
+
+    report: dict = {
+        "bench": "generation",
+        "model": {"vocab": VOCAB, "hidden": HIDDEN, "layers": LAYERS,
+                  "heads": HEADS},
+        "prompt_len": PROMPT_LEN, "max_new_tokens": MAX_NEW,
+        "slots": SLOTS, "reps": args.reps, "platform": "cpu",
+        "ttft_definition": ("submission -> first token VISIBLE to the "
+                            "caller (engine streams per step; "
+                            "sequential only surfaces tokens when a "
+                            "request's whole loop returns)"),
+        "concurrency": {},
+    }
+    for n in args.concurrency:
+        prompts = list(all_prompts[:n])
+        seq_runs = [bench_sequential(solo, prompts)
+                    for _ in range(args.reps)]
+        eng_runs = [bench_engine(engine, prompts)
+                    for _ in range(args.reps)]
+        seq, eng = summarize(seq_runs), summarize(eng_runs)
+        cell = {"sequential": seq, "engine": eng,
+                "speedup_tokens_per_s": (eng["tokens_per_s"]
+                                         / seq["tokens_per_s"])}
+        report["concurrency"][str(n)] = cell
+        print(f"concurrency {n}: sequential "
+              f"{seq['tokens_per_s']:.0f} tok/s "
+              f"(ttft p99 {seq['ttft_p99_s'] * 1e3:.0f} ms) | engine "
+              f"{eng['tokens_per_s']:.0f} tok/s "
+              f"(ttft p99 {eng['ttft_p99_s'] * 1e3:.0f} ms) | "
+              f"speedup {cell['speedup_tokens_per_s']:.2f}x")
+
+    top = str(max(args.concurrency))
+    headline = report["concurrency"][top]["speedup_tokens_per_s"]
+    report["headline"] = {f"conc{top}_speedup": headline, "floor": 1.5}
+    engine.close()
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out}; headline conc-{top} speedup "
+          f"{headline:.2f}x (floor 1.5x)")
+    return 0 if headline >= 1.5 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

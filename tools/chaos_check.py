@@ -24,6 +24,12 @@ Scenarios (all CPU-only, single process):
    router fails them over to the survivors), router membership converges
    to mark the dead replica unhealthy, and cross-request batching
    demonstrably coalesced (fewer batches than batched requests).
+7. **gen-engine**: three token streams share a continuous-batching
+   GenerationEngine; one client is killed mid-stream (socket dropped, no
+   cancel) — the poll TTL reclaims its slot, the surviving streams
+   finish byte-identical to solo ``generate()``, a new generation is
+   admitted into the reclaimed slot, and the ``gen/*`` counters stay
+   consistent.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -76,6 +82,9 @@ def check_defaults_off() -> None:
     s = get_flags(["serving_batch_max", "serving_batch_timeout_s"])
     check("defaults/serving_batching_off", s["serving_batch_max"] == 0,
           str(s))
+    g = get_flags(["gen_slots", "gen_poll_ttl_s"])
+    check("defaults/gen_engine_off", g["gen_slots"] == 0
+          and g["gen_poll_ttl_s"] > 0, str(g))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -377,13 +386,97 @@ def scenario_serving_routed(tmp: str) -> None:
             s.stop()
 
 
+def scenario_gen_engine(tmp: str) -> None:
+    """Client killed mid-stream under the continuous-batching engine:
+    its slot is TTL-reclaimed, surviving streams are byte-identical to
+    solo generate(), and a new generation lands in the freed slot."""
+    import threading
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    monitor.reset_stats("gen/")
+    # pace the loop so "mid-stream" is a real window, and shorten the
+    # poll TTL so the dropped client's slot reclaims within the check
+    engine = GenerationEngine(model, slots=3, max_len=32, queue_max=4,
+                              ttl_s=0.6, step_wait_s=0.02)
+    srv = io.InferenceServer().start()
+    srv.add_generator("llm", engine)
+    rs = np.random.RandomState(3)
+    prompts = rs.randint(0, 96, (3, 6)).astype(np.int32)
+    refs = np.asarray(generate(model, jnp.asarray(prompts), 12))[:, 6:]
+    survivors: dict = {}
+    errors: list = []
+    try:
+        victim = io.InferenceClient(srv.endpoint)
+        vic_id = victim.generate_start("llm", prompts[0], 12)
+        victim.generate_poll("llm", vic_id, wait_s=0.1)
+
+        def worker(i):
+            try:
+                c = io.InferenceClient(srv.endpoint)
+                survivors[i] = list(c.generate("llm", prompts[i], 12))
+                c.close()
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in (1, 2)]
+        for t in threads:
+            t.start()
+        # kill the victim's connection mid-stream: no cancel, no close
+        # handshake — only the poll TTL can reclaim its slot
+        victim.close()
+        for t in threads:
+            t.join(timeout=30)
+        check("gen/survivors_byte_identical",
+              not errors and len(survivors) == 2
+              and all(np.array_equal(np.asarray(survivors[i], np.int32),
+                                     refs[i]) for i in (1, 2)),
+              f"errors={errors[:2]}")
+
+        deadline = time.time() + 5.0
+        st = engine.stats()
+        while time.time() < deadline:
+            st = engine.stats()
+            if st["active"] == 0 and st["generations"] == 0:
+                break
+            time.sleep(0.05)
+        check("gen/victim_slot_reclaimed",
+              st["active"] == 0 and st["generations"] == 0
+              and monitor.get_stat("gen/evictions") >= 1, str(st))
+
+        # freed capacity admits new work; counters stay consistent
+        c = io.InferenceClient(srv.endpoint)
+        toks = list(c.generate("llm", prompts[0], 12))
+        check("gen/readmit_after_reclaim",
+              np.array_equal(np.asarray(toks, np.int32), refs[0]))
+        h = c.health()
+        c.close()
+        emitted = sum(len(v) for v in survivors.values()) + len(toks)
+        check("gen/counters_consistent",
+              monitor.get_stat("gen/tokens") >= emitted
+              and h["generators"]["llm"]["active"] == 0,
+              f"tokens={monitor.get_stat('gen/tokens')} "
+              f"emitted>={emitted} health={h.get('generators')}")
+    finally:
+        srv.stop()     # closes the engine too
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
         os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
         for scenario in (scenario_serving_wire, scenario_checkpoint,
                          scenario_elastic_resume, scenario_overload,
-                         scenario_obs, scenario_serving_routed):
+                         scenario_obs, scenario_serving_routed,
+                         scenario_gen_engine):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
@@ -397,7 +490,7 @@ def main() -> int:
                      for n, p, d in CHECKS if not p],
         "stats": {k: v for k, v in monitor.export_stats().items()
                   if k.split("/")[0] in ("wire", "ckpt", "fault", "train",
-                                         "serving")},
+                                         "serving", "gen")},
     }, indent=2))
     return 0 if ok else 1
 
