@@ -145,6 +145,14 @@ define_flag("serving_batch_timeout_s", 0.005,
             "How long an infer request may wait for co-batchable requests "
             "before the partial batch is flushed (the Orca/Clipper-style "
             "batching window). Only read when serving_batch_max > 1")
+define_flag("serving_batch_min_queue", 2,
+            "Load watermark for cross-request batching: a request that "
+            "finds fewer than this many concurrent submits for its "
+            "model (and no batch forming) bypasses leader/follower "
+            "coalescing and runs immediately, so idle traffic never "
+            "pays the serving_batch_timeout_s window tax (measured "
+            "0.57x at concurrency 1 before the watermark). 0 restores "
+            "unconditional coalescing")
 define_flag("serving_probe_interval_s", 1.0,
             "Health-probe cadence of serving.RoutedClient: each replica's "
             "universal health op is polled this often to drive routed "
@@ -172,6 +180,39 @@ define_flag("gen_poll_ttl_s", 30.0,
             "Reap a generation whose client has not polled for this "
             "long (disconnected/crashed callers must not pin a slot "
             "forever; gen/evictions counts the reclaims). <= 0 disables")
+# --- paged KV cache + prefix sharing + chunked prefill (serving/engine.py) ---
+define_flag("gen_paged", False,
+            "Paged KV-cache mode for the GenerationEngine: the cache "
+            "becomes a pool of fixed-size pages plus per-slot page "
+            "tables (vLLM PagedAttention, SOSP '23), so a short "
+            "completion pays HBM for the tokens it actually holds and "
+            "admission sheds on page-pool exhaustion, not slot count. "
+            "Hard-off default: the PR-5 contiguous per-slot layout "
+            "stays byte-identical")
+define_flag("gen_page_tokens", 16,
+            "Tokens per physical KV page in paged mode. Smaller pages "
+            "waste less tail capacity per generation and share prefixes "
+            "at finer grain; larger pages mean fewer gather indices per "
+            "decode step")
+define_flag("gen_pages", 0,
+            "Physical pages in the paged KV pool. 0 — the default — "
+            "sizes the pool to gen_slots x ceil(gen_max_len / "
+            "gen_page_tokens): exactly the HBM of the contiguous "
+            "layout, so capacity gains come purely from short "
+            "completions and shared prefixes")
+define_flag("gen_prefill_chunk", 0,
+            "Chunked prefill: admit a prompt in slices of this many "
+            "tokens, interleaved with decode steps, so a long prompt "
+            "no longer stalls every active stream for a full-prompt "
+            "prefill. 0 — the default — prefills the whole prompt "
+            "(tail past any shared prefix) in one forward")
+define_flag("gen_prefix_cache", True,
+            "Radix prefix cache over full prompt pages (paged mode "
+            "only): generations sharing a prompt prefix map their "
+            "early pages to the same refcounted physical pages and "
+            "prefill runs once per unique prefix "
+            "(gen/prefix_hits, gen/prefix_tokens_saved). Cached pages "
+            "are LRU-evicted under pool pressure")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

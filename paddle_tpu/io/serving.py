@@ -23,7 +23,12 @@ over a live model, served through ``generate_start`` /
 ``generate_poll`` / ``generate_cancel`` (prompts/tokens ride the JSON
 header — they are small) with :meth:`InferenceClient.generate` as the
 streaming client iterator. A full engine sheds starts with the
-retryable ``CODE_SHED`` status.
+retryable ``CODE_SHED`` status. With ``FLAGS_gen_paged`` the engine's
+KV cache is a paged pool with prefix sharing and chunked prefill; the
+``health`` op then ships page-pool occupancy (``pages_free``/``pages``)
+and prefix-cache size per generator alongside slot occupancy, so
+routers and autoscalers see real capacity (pages, not slots) without a
+dedicated op.
 """
 
 from __future__ import annotations
@@ -143,7 +148,11 @@ class InferenceServer(FrameService):
         step the decode loop slot-by-slot — a baked StableHLO artifact
         cannot), or an already-constructed engine. Slot count comes from
         ``FLAGS_gen_slots`` unless ``slots=`` is passed; the flag's
-        default of 0 keeps generation serving off entirely."""
+        default of 0 keeps generation serving off entirely. Paged-cache
+        mode (``FLAGS_gen_paged`` or ``paged=True`` in
+        ``engine_kwargs``, plus ``page_tokens``/``pages``/
+        ``prefill_chunk``/``prefix_cache``) changes only the engine's
+        memory management — the wire surface is identical."""
         from paddle_tpu.serving.engine import GenerationEngine
 
         engine = (model if isinstance(model, GenerationEngine)
@@ -165,8 +174,10 @@ class InferenceServer(FrameService):
 
     def health(self, stats_prefix: str | None = None,
                histograms: bool = False) -> dict:
-        """FrameService health + per-generator slot occupancy, so
-        routers/probes see generation capacity without a dedicated op."""
+        """FrameService health + per-generator slot AND page-pool
+        occupancy (paged engines report ``pages_free``/``pages`` +
+        ``prefix_entries``), so routers/probes see generation capacity
+        without a dedicated op."""
         doc = super().health(stats_prefix, histograms)
         with self._lock:
             gens = {n: e.stats() for n, e in self._generators.items()}

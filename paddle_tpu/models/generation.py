@@ -18,7 +18,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "sample_logits", "beam_search"]
+__all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
+           "paged_gather", "paged_scatter"]
 
 
 def sample_logits(logits, key=None, *, temperature: float = 1.0,
@@ -44,6 +45,77 @@ def sample_logits(logits, key=None, *, temperature: float = 1.0,
             keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (vLLM PagedAttention, SOSP '23): the pool/page-table
+# layer of the cache contract. A model's ``init_cache`` proto defines the
+# per-sequence leaf layout ([L, 1, Hkv, S, D] buffers — scales
+# [L, 1, Hkv, S] in the int8 layout); these helpers re-express it as a
+# pool of fixed-size pages plus a per-sequence page table, and translate
+# between the two so ``forward_with_cache`` keeps its contiguous view:
+# gather pages -> contiguous cache -> forward -> scatter the written
+# chunk back. Physical page 0 is reserved as the null page: unmapped
+# table entries and masked (padding) writes land there, never on a live
+# page. Exactness contract: a gather of pages holding positions
+# [0, index) reproduces the contiguous buffer bit-for-bit over those
+# positions, so paged decode logits equal contiguous decode logits.
+# ---------------------------------------------------------------------------
+
+def init_paged_cache(proto_cache, num_pages: int, page_tokens: int):
+    """Allocate the page pool for a cache proto (``model.init_cache(1,
+    S)`` leaves). Returns leaves ``[num_pages + 1, L, Hkv, page_tokens,
+    *rest]`` — index 0 is the reserved null page, usable page ids are
+    ``1 .. num_pages``."""
+    pool = []
+    for leaf in proto_cache:
+        if leaf.ndim < 4 or leaf.shape[1] != 1:
+            raise ValueError(
+                f"cache leaf {leaf.shape} is not the [L, 1, Hkv, S, ...] "
+                "layout init_kv_cache produces")
+        L, _, Hkv = leaf.shape[:3]
+        rest = leaf.shape[4:]
+        pool.append(jnp.zeros((num_pages + 1, L, Hkv, page_tokens) + rest,
+                              leaf.dtype))
+    return tuple(pool)
+
+
+def paged_gather(pool, table):
+    """Materialize a sequence's contiguous cache view from its page
+    table (``table`` [M] int32 physical page ids; entry 0 = null page).
+    Returns leaves ``[L, 1, Hkv, M * page_tokens, *rest]`` — position
+    ``p`` reads ``pool[table[p // page_tokens]][..., p % page_tokens]``.
+    Unmapped (null) regions hold garbage; attention masks them (the
+    fill position bounds every read)."""
+    out = []
+    for leaf in pool:
+        g = leaf[table]                       # [M, L, Hkv, P, *rest]
+        g = jnp.moveaxis(g, 0, 2)             # [L, Hkv, M, P, *rest]
+        s = g.shape
+        out.append(g.reshape(s[0], s[1], s[2] * s[3], *s[4:])[:, None])
+    return tuple(out)
+
+
+def paged_scatter(pool, table, chunk, index, page_tokens: int,
+                  length=None):
+    """Write a contiguous chunk (leaves ``[L, 1, Hkv, T, *rest]``,
+    covering positions ``[index, index + T)``) into the pool through
+    ``table``. Positions at or past ``length`` (the chunk's true token
+    count — padding) are redirected to the null page so a right-padded
+    chunk can never clobber a live page."""
+    T = chunk[0].shape[3]
+    j = jnp.arange(T)
+    pos = jnp.asarray(index, jnp.int32) + j
+    pidx = jnp.clip(pos // page_tokens, 0, table.shape[0] - 1)
+    pages = table[pidx]
+    if length is not None:
+        pages = jnp.where(j < length, pages, 0)
+    offs = pos % page_tokens
+    out = []
+    for leaf, ch in zip(pool, chunk):
+        data = jnp.moveaxis(ch[:, 0], 2, 0)   # [T, L, Hkv, *rest]
+        out.append(leaf.at[pages, :, :, offs].set(data.astype(leaf.dtype)))
+    return tuple(out)
 
 
 def generate(model, input_ids, max_new_tokens: int, *,

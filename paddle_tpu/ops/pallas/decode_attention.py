@@ -29,6 +29,14 @@ q [B, 1, Hq, D].
 Reference role: the decode half of the reference's fused attention
 serving path (``paddle/fluid/operators/fused/multihead_matmul_op.cu``
 feeding ``inference/api/analysis_predictor.h``); inference-only, no VJP.
+
+Batching: the GenerationEngine's fused decode step invokes this kernel
+under ``jax.vmap`` (one mapped axis per engine slot, per-slot caches
+and fill positions). jax's pallas batching rule lowers that by growing
+the grid, and ``tests/test_decode_attention.py`` pins the behavior
+(vmapped output bit-equal to per-slot calls, interpret mode) along with
+the off-TPU einsum fallback arm — the engine's dispatch is explicit,
+not incidental.
 """
 
 from __future__ import annotations

@@ -17,6 +17,15 @@ Mechanics:
   waits out the batching window (or until the row cap is hit), takes the
   FIFO prefix that fits, executes it, and distributes results. Followers
   just wait; leftover requests elect the next leader immediately.
+- **Load watermark.** Coalescing taxes idle traffic: a lone request
+  paid the full ``serving_batch_timeout_s`` window for a batch that was
+  never coming (measured 0.57x vs unbatched at concurrency 1,
+  BENCH_serving.json r5). A request that finds fewer than
+  ``FLAGS_serving_batch_min_queue`` concurrent submits for its model —
+  and no batch already forming — bypasses the queue and runs
+  immediately (``serving/batch_bypass``); under real concurrency the
+  watermark is crossed and coalescing engages as before. 0 restores
+  unconditional coalescing.
 - **Bucketed padding.** The concatenated batch is padded with zero rows
   up to the next power-of-two bucket (capped at ``serving_batch_max``),
   so the number of distinct shapes XLA compiles stays logarithmic in the
@@ -76,12 +85,13 @@ class _Pending:
 
 
 class _ModelQueue:
-    __slots__ = ("cv", "items", "leading")
+    __slots__ = ("cv", "items", "leading", "inflight")
 
     def __init__(self):
         self.cv = threading.Condition()
         self.items: list[_Pending] = []
         self.leading = False
+        self.inflight = 0     # concurrent submit() calls (load signal)
 
 
 class DynamicBatcher:
@@ -114,12 +124,27 @@ class DynamicBatcher:
             return self._run(pred, model, inputs, batched=False)
         rows = int(inputs[0].shape[0])
         q = self._queue(model)
-        p = _Pending(inputs, rows)
-        if _trace._ACTIVE is not None:
-            with _trace.span("serving/batch_wait", model=model, rows=rows):
+        min_q = int(flag("serving_batch_min_queue"))
+        with q.cv:
+            q.inflight += 1
+            # below the load watermark with no batch forming: skip the
+            # coalescing window entirely — idle traffic must not pay the
+            # timeout tax for a batch that is never coming
+            solo = min_q > 0 and q.inflight < min_q and not q.items
+        try:
+            if solo:
+                stat_add("serving/batch_bypass")
+                return self._run(pred, model, inputs, batched=False)
+            p = _Pending(inputs, rows)
+            if _trace._ACTIVE is not None:
+                with _trace.span("serving/batch_wait", model=model,
+                                 rows=rows):
+                    self._submit(q, pred, model, p)
+            else:
                 self._submit(q, pred, model, p)
-        else:
-            self._submit(q, pred, model, p)
+        finally:
+            with q.cv:
+                q.inflight -= 1
         if p.error is not None:
             raise p.error
         assert p.outputs is not None

@@ -26,6 +26,23 @@ paged cache, SOSP '23) to the framework's autoregressive path:
   emitted tokens stream into host buffers that :meth:`~GenerationEngine.
   poll` drains incrementally (the wire ops ``generate_start`` /
   ``generate_poll`` / ``generate_cancel`` in ``io/serving.py``).
+- **Paged mode** (``FLAGS_gen_paged``, off by default). The contiguous
+  per-slot regions above make a 16-token completion pay HBM for
+  ``max_len`` positions; paged mode (vLLM PagedAttention, SOSP '23)
+  replaces them with a pool of ``FLAGS_gen_pages`` physical pages of
+  ``FLAGS_gen_page_tokens`` tokens plus per-slot page tables
+  (``models.generation.init_paged_cache`` / ``paged_gather`` /
+  ``paged_scatter``). A generation reserves pages for its *declared*
+  worst case (prompt + ``max_new_tokens``) at admission — capacity
+  becomes ``pool / actual-need`` instead of ``slots`` — and admission
+  stalls on page-pool exhaustion, not slot count. A radix prefix cache
+  over full prompt pages maps generations sharing a prompt prefix onto
+  the same refcounted physical pages, so the shared prefix prefills
+  once (``gen/prefix_hits`` / ``gen/prefix_tokens_saved``; cached pages
+  are LRU-evicted under pool pressure). Chunked prefill
+  (``FLAGS_gen_prefill_chunk``) admits long prompts in token slices
+  interleaved with decode steps, so active streams keep emitting
+  during a long prefill instead of stalling behind it.
 
 Determinism: a greedy (``temperature=0``) generation through the engine
 is byte-identical to a solo :func:`paddle_tpu.models.generation.generate`
@@ -35,10 +52,13 @@ are deterministic per ``(prompt, seed)`` — each slot splits its own key
 once per emitted token — but follow a different key schedule than solo
 ``generate``.
 
-Observability: ``gen/slots_active`` / ``gen/queue_depth`` gauges,
-``gen/prefill_s`` / ``gen/decode_step_s`` histograms, ``gen/tokens`` /
-``gen/evictions`` counters, ``gen/prefill`` + ``gen/decode_step`` spans,
-and slot occupancy in the serving ``health`` op.
+Observability: ``gen/slots_active`` / ``gen/queue_depth`` /
+``gen/pages_free`` gauges, ``gen/prefill_s`` / ``gen/prefill_chunk_s`` /
+``gen/decode_step_s`` histograms, ``gen/tokens`` / ``gen/evictions`` /
+``gen/prefix_hits`` / ``gen/prefix_tokens_saved`` /
+``gen/prefix_evictions`` counters, ``gen/prefill`` +
+``gen/prefill_chunk`` + ``gen/decode_step`` spans, and slot + page-pool
+occupancy in the serving ``health`` op.
 """
 
 from __future__ import annotations
@@ -78,7 +98,8 @@ class Generation:
     __slots__ = ("gen_id", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "eos_token_id", "seed", "tokens",
                  "done", "error", "slot", "created", "last_poll",
-                 "cancelled")
+                 "cancelled", "pages", "shared", "prefilling",
+                 "prefill_pos", "prefill_t0")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -98,6 +119,149 @@ class Generation:
         self.created = time.monotonic()
         self.last_poll = self.created
         self.cancelled = False
+        # paged mode: mapped physical pages (shared prefix first), how
+        # many of them are prefix-cache hits, and chunked-prefill cursor
+        self.pages: list[int] = []
+        self.shared = 0
+        self.prefilling = False
+        self.prefill_pos = 0
+        self.prefill_t0 = 0.0
+
+
+class _PagePool:
+    """Host-side refcounted allocator over the physical page pool.
+    Usable page ids are ``1 .. num_pages``; id 0 is the reserved null
+    page (unmapped table entries, masked padding writes). All methods
+    run under the engine's condition lock."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages, 0, -1))   # pop() -> 1 first
+        self._ref = [0] * (self.num_pages + 1)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n}, free {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        for pid in out:
+            self._ref[pid] = 1
+        return out
+
+    def retain(self, pid: int) -> None:
+        self._ref[pid] += 1
+
+    def release(self, pid: int) -> None:
+        self._ref[pid] -= 1
+        if self._ref[pid] == 0:
+            self._free.append(pid)
+        elif self._ref[pid] < 0:        # double free = allocator bug
+            raise AssertionError(f"page {pid} refcount underflow")
+
+    def refcount(self, pid: int) -> int:
+        return self._ref[pid]
+
+
+class _PrefixEntry:
+    __slots__ = ("key", "page", "parent_page", "children", "last_used")
+
+    def __init__(self, key, page: int, parent_page: int):
+        self.key = key
+        self.page = page
+        self.parent_page = parent_page
+        self.children = 0
+        self.last_used = 0
+
+
+class _PrefixCache:
+    """Radix cache over FULL prompt pages: entry key = (parent page id,
+    the page's token bytes), so two prompts share exactly their common
+    whole-page prefix. Only pages fully covered by a prompt are ever
+    registered (decode writes start at the prompt length — registered
+    pages are immutable), and a match is capped so at least one prompt
+    token remains to prefill (the sampled first token needs its logits).
+    The cache holds its own +1 refcount per registered page, so shared
+    pages outlive their last generation until LRU-evicted under pool
+    pressure (leaf entries first — a parent is only evictable once its
+    children are gone)."""
+
+    def __init__(self, page_tokens: int):
+        self._P = int(page_tokens)
+        self._entries: dict[tuple, _PrefixEntry] = {}
+        self._by_page: dict[int, _PrefixEntry] = {}
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _touch(self, e: _PrefixEntry) -> None:
+        self._clock += 1
+        e.last_used = self._clock
+
+    def match(self, prompt: np.ndarray, pool: _PagePool) -> list[int]:
+        """Longest cached whole-page prefix of ``prompt``; each matched
+        page is retained for the caller (release on failure/retire)."""
+        P = self._P
+        cap = (int(prompt.size) - 1) // P
+        pages: list[int] = []
+        parent = 0
+        for i in range(cap):
+            e = self._entries.get((parent, prompt[i * P:(i + 1) * P]
+                                   .tobytes()))
+            if e is None:
+                break
+            self._touch(e)
+            pool.retain(e.page)
+            pages.append(e.page)
+            parent = e.page
+        return pages
+
+    def insert(self, prompt: np.ndarray, gen_pages: list[int],
+               pool: _PagePool) -> None:
+        """Register a finished prefill's full prompt pages. Pages whose
+        chain key is already cached (matched, or raced by a concurrent
+        identical prompt) are touched, not replaced — the generation
+        keeps its private copy in that case."""
+        P = self._P
+        parent = 0
+        for i in range(int(prompt.size) // P):
+            key = (parent, prompt[i * P:(i + 1) * P].tobytes())
+            e = self._entries.get(key)
+            if e is None:
+                e = _PrefixEntry(key, gen_pages[i], parent_page=parent)
+                self._entries[key] = e
+                self._by_page[e.page] = e
+                pool.retain(e.page)
+                pe = self._by_page.get(parent)
+                if pe is not None:
+                    pe.children += 1
+            self._touch(e)
+            parent = e.page
+
+    def evict(self, n: int, pool: _PagePool) -> int:
+        """Free up to ``n`` pages by dropping LRU leaf entries no live
+        generation references (page refcount 1 = cache-only)."""
+        freed = 0
+        while freed < n:
+            cands = [e for e in self._entries.values()
+                     if e.children == 0 and pool.refcount(e.page) == 1]
+            if not cands:
+                break
+            e = min(cands, key=lambda c: c.last_used)
+            del self._entries[e.key]
+            self._by_page.pop(e.page, None)
+            pe = self._by_page.get(e.parent_page)
+            if pe is not None:
+                pe.children -= 1
+            pool.release(e.page)
+            freed += 1
+        if freed:
+            stat_add("gen/prefix_evictions", freed)
+        return freed
 
 
 def _sample_slot(logits, key, temperature, top_k, top_p):
@@ -138,6 +302,14 @@ class GenerationEngine:
     ``slots`` raises); ``max_len``/``queue_max``/``ttl_s`` default to
     ``FLAGS_gen_max_len``/``FLAGS_gen_queue_max``/``FLAGS_gen_poll_ttl_s``.
 
+    ``paged``/``page_tokens``/``pages``/``prefill_chunk``/``prefix_cache``
+    default to the ``FLAGS_gen_paged``/``gen_page_tokens``/``gen_pages``/
+    ``gen_prefill_chunk``/``gen_prefix_cache`` flags; with paging off
+    (the default) the engine keeps the PR-5 contiguous per-slot cache
+    byte-identically. Greedy output is byte-identical to solo
+    ``generate()`` in both modes, under any co-tenant mix, page reuse,
+    and chunked prefill.
+
     The background loop starts on construction; :meth:`close` retires it.
     All device state is touched only by the loop thread — the public
     surface (:meth:`start`/:meth:`poll`/:meth:`cancel`) is host-side and
@@ -148,7 +320,10 @@ class GenerationEngine:
                  max_len: int | None = None, queue_max: int | None = None,
                  ttl_s: float | None = None, eos_token_id: int | None = None,
                  pad_token_id: int = 0, cache_dtype=None,
-                 min_bucket: int = 8, step_wait_s: float = 0.0):
+                 min_bucket: int = 8, step_wait_s: float = 0.0,
+                 paged: bool | None = None, page_tokens: int | None = None,
+                 pages: int | None = None, prefill_chunk: int | None = None,
+                 prefix_cache: bool | None = None):
         import jax.numpy as jnp
 
         if slots is None:
@@ -177,14 +352,45 @@ class GenerationEngine:
         self.step_wait_s = float(step_wait_s)
         self._model = model
         self._cache_dtype = cache_dtype
+        self._paged = bool(flag("gen_paged") if paged is None else paged)
+        self._prefill_chunk = int(flag("gen_prefill_chunk")
+                                  if prefill_chunk is None
+                                  else prefill_chunk)
 
         proto = model.init_cache(1, self.max_len, dtype=cache_dtype)
         import jax
 
-        self._state: dict[str, Any] = {
-            "cache": jax.tree_util.tree_map(
+        if self._paged:
+            from paddle_tpu.models.generation import init_paged_cache
+            P = int(flag("gen_page_tokens") if page_tokens is None
+                    else page_tokens)
+            if P < 1:
+                raise ValueError(f"page_tokens must be >= 1, got {P}")
+            self._page_tokens = P
+            self._maxp = -(-self.max_len // P)       # pages per table
+            npages = int(flag("gen_pages") if pages is None else pages)
+            if npages <= 0:
+                # equal HBM to the contiguous layout by default
+                npages = self.slots * self._maxp
+            self._pool = _PagePool(npages)
+            self._prefix = (_PrefixCache(P)
+                            if (flag("gen_prefix_cache")
+                                if prefix_cache is None else prefix_cache)
+                            else None)
+            # host-side page tables, uploaded per compiled call (0 =
+            # null page); rows zero whenever the slot is free
+            self._pt = np.zeros((self.slots, self._maxp), np.int32)
+            cache = init_paged_cache(proto, npages, P)
+            stat_set("gen/pages_free", self._pool.free_count)
+        else:
+            self._pool = None
+            self._prefix = None
+            self._pt = None
+            cache = jax.tree_util.tree_map(
                 lambda x: jnp.zeros((self.slots,) + x.shape, x.dtype),
-                proto),
+                proto)
+        self._state: dict[str, Any] = {
+            "cache": cache,
             "tok": jnp.zeros((self.slots,), jnp.int32),
             "pos": jnp.zeros((self.slots,), jnp.int32),
             "keys": jnp.zeros((self.slots, 2), jnp.uint32),
@@ -192,8 +398,12 @@ class GenerationEngine:
             "top_k": jnp.zeros((self.slots,), jnp.int32),
             "top_p": jnp.ones((self.slots,), jnp.float32),
         }
-        self._step = self._build_step()
-        self._prefill_fn = self._build_prefill()
+        if self._paged:
+            self._step = self._build_paged_step()
+            self._prefill_fn = self._build_paged_prefill()
+        else:
+            self._step = self._build_step()
+            self._prefill_fn = self._build_prefill()
 
         self._cond = threading.Condition()
         self._queue: deque[Generation] = deque()
@@ -267,6 +477,97 @@ class GenerationEngine:
 
         return jax.jit(prefill, donate_argnums=(0,))
 
+    def _build_paged_step(self):
+        """ONE fused decode for all slots in paged mode: each slot
+        gathers its page table into a contiguous cache view, runs the
+        same single-token cached forward as the contiguous step, and
+        the freshly written position is scattered back into its page
+        outside the vmap (inactive/masked slots scatter to the null
+        page). The gathered view is a step-local temporary — the
+        persistent HBM is the page pool."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import paged_gather
+
+        model, P, maxp = self._model, self._page_tokens, self._maxp
+        slots = self.slots
+
+        def one(pt_row, tok, idx, key, temp, top_k, top_p, pool):
+            cache = paged_gather(pool, pt_row)
+            logits, cache = model.forward_with_cache(
+                tok[None, None], cache, index=idx)
+            new = tuple(
+                jax.lax.dynamic_slice_in_dim(c, idx, 1, axis=3)[:, 0, :, 0]
+                for c in cache)                       # [L, Hkv, *rest]
+            key, sub = jax.random.split(key)
+            nxt = _sample_slot(logits[0, -1], sub, temp, top_k, top_p)
+            return nxt, key, new
+
+        def step(state, pt, active):
+            pool = state["cache"]
+            nxt, keys, new = jax.vmap(
+                one, in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
+                pt, state["tok"], state["pos"], state["keys"],
+                state["temp"], state["top_k"], state["top_p"], pool)
+            pidx = jnp.clip(state["pos"] // P, 0, maxp - 1)
+            pages = jnp.where(active, pt[jnp.arange(slots), pidx], 0)
+            offs = state["pos"] % P
+            pool = tuple(
+                buf.at[pages, :, :, offs].set(n.astype(buf.dtype))
+                for buf, n in zip(pool, new))
+            tok = jnp.where(active, nxt, state["tok"])
+            pos = state["pos"] + active.astype(jnp.int32)
+            return dict(state, cache=pool, tok=tok, pos=pos,
+                        keys=keys), tok
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _build_paged_prefill(self):
+        """Prefill ONE chunk of one slot's prompt (compiled per padded
+        chunk length): gather the slot's pages, forward the chunk at its
+        absolute index against the shared-prefix context already in
+        those pages, scatter the written positions back (padding
+        redirected to the null page), and record the slot state as if
+        this were the final chunk — a later chunk simply overwrites it,
+        so the last chunk's sample/key/position land without a traced
+        branch."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import paged_gather, paged_scatter
+
+        model, P = self._model, self._page_tokens
+
+        def prefill(state, pt, slot, padded, index, true_len, key, temp,
+                    top_k, top_p):
+            pool = state["cache"]
+            row = pt[slot]
+            cache = paged_gather(pool, row)
+            logits, cache = model.forward_with_cache(padded[None], cache,
+                                                     index=index)
+            chunk = tuple(
+                jax.lax.dynamic_slice_in_dim(c, index, padded.shape[0],
+                                             axis=3)
+                for c in cache)
+            pool = paged_scatter(pool, row, chunk, index, P,
+                                 length=true_len)
+            key, sub = jax.random.split(key)
+            tok0 = _sample_slot(logits[0, true_len - 1], sub, temp, top_k,
+                                top_p)
+            return dict(
+                cache=pool,
+                tok=state["tok"].at[slot].set(tok0),
+                pos=state["pos"].at[slot].set(index + true_len),
+                keys=state["keys"].at[slot].set(key),
+                temp=state["temp"].at[slot].set(temp),
+                top_k=state["top_k"].at[slot].set(jnp.asarray(top_k,
+                                                              jnp.int32)),
+                top_p=state["top_p"].at[slot].set(top_p),
+            ), tok0
+
+        return jax.jit(prefill, donate_argnums=(0,))
+
     def _bucket(self, n: int) -> int:
         b = self._min_bucket
         while b < n:
@@ -291,6 +592,12 @@ class GenerationEngine:
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the engine's per-slot "
                 f"capacity ({self.max_len}); raise FLAGS_gen_max_len")
+        if self._paged:
+            need = -(-(prompt.size + max_new_tokens) // self._page_tokens)
+            if need > self._pool.num_pages:
+                raise ValueError(
+                    f"request needs {need} pages but the pool only has "
+                    f"{self._pool.num_pages}; raise FLAGS_gen_pages")
         eos = self._eos_default if eos_token_id is _UNSET else eos_token_id
         gen = Generation(uuid.uuid4().hex[:16], prompt, max_new_tokens,
                          float(temperature), int(top_k), float(top_p),
@@ -305,10 +612,13 @@ class GenerationEngine:
             if (self._queue_max > 0
                     and len(self._queue) - free >= self._queue_max):
                 stat_add("gen/shed")
+                pool = ("" if not self._paged else
+                        f", {self._pool.free_count}/"
+                        f"{self._pool.num_pages} pages free")
                 raise EngineOverloaded(
                     f"engine full: {self.slots} slots busy, "
                     f"{len(self._queue)} queued (queue_max="
-                    f"{self._queue_max})")
+                    f"{self._queue_max}){pool}")
             self._queue.append(gen)
             self._gens[gen.gen_id] = gen
             stat_set("gen/queue_depth", len(self._queue))
@@ -364,16 +674,38 @@ class GenerationEngine:
         return True
 
     def stats(self) -> dict:
-        """Slot occupancy snapshot (shipped in the serving ``health``
-        op)."""
+        """Slot + page-pool occupancy snapshot (shipped in the serving
+        ``health`` op — routers/probes see generation capacity AND, in
+        paged mode, how much of the page pool and prefix cache is
+        live)."""
         with self._cond:
             active = sum(g is not None for g in self._slot_gen)
-            return {"slots": self.slots, "active": active,
-                    "free": self.slots - active,
-                    "queued": len(self._queue),
-                    "generations": len(self._gens),
-                    "max_len": self.max_len,
-                    "broken": self._broken}
+            doc = {"slots": self.slots, "active": active,
+                   "free": self.slots - active,
+                   "queued": len(self._queue),
+                   "generations": len(self._gens),
+                   "max_len": self.max_len,
+                   "broken": self._broken,
+                   "paged": self._paged}
+            if self._paged:
+                doc.update(
+                    page_tokens=self._page_tokens,
+                    pages=self._pool.num_pages,
+                    pages_free=self._pool.free_count,
+                    prefix_entries=(0 if self._prefix is None
+                                    else len(self._prefix)))
+            return doc
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every prefix-cache entry no live generation references
+        (an operational memory-pressure valve; also how the tests assert
+        the pool drains back to full). Returns pages freed."""
+        with self._cond:
+            if self._prefix is None:
+                return 0
+            freed = self._prefix.evict(self._pool.num_pages, self._pool)
+            stat_set("gen/pages_free", self._pool.free_count)
+            return freed
 
     def close(self) -> None:
         """Stop the loop; error out queued/active generations."""
@@ -389,8 +721,11 @@ class GenerationEngine:
                     gen.done = True
                     gen.error = gen.error or "engine stopped"
                     gen.slot = None
+                gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
+            if self._paged:
+                self._pt[:] = 0
             self._cond.notify_all()
 
     def __enter__(self):
@@ -417,8 +752,20 @@ class GenerationEngine:
                         return
             try:
                 self._reap_expired()
-                self._admit()
-                self._decode_step(jnp)
+                if self._paged:
+                    progressed = self._admit_paged()
+                    progressed |= self._prefill_tick()
+                    progressed |= self._decode_step(jnp)
+                    if not progressed:
+                        # queue blocked on pages and nothing to step:
+                        # wait for a cancel/TTL/poll to free capacity
+                        # instead of spinning
+                        with self._cond:
+                            if not self._stopping:
+                                self._cond.wait(timeout=0.05)
+                else:
+                    self._admit()
+                    self._decode_step(jnp)
             except Exception as e:   # device-side failure: fail loudly,
                 self._break(e)       # refuse new work, keep pollers sane
                 return
@@ -432,17 +779,33 @@ class GenerationEngine:
                     gen.done = True
                     gen.error = msg
                     gen.slot = None
+                gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
+            if self._paged:           # nothing runs on a broken engine;
+                self._pt[:] = 0       # reset the books for stats() sanity
+                self._pool = _PagePool(self._pool.num_pages)
+                if self._prefix is not None:
+                    self._prefix = _PrefixCache(self._page_tokens)
             self._cond.notify_all()
 
     def _release_slot_locked(self, gen: Generation,
                              evicted: bool = False) -> None:
         if gen.slot is not None and self._slot_gen[gen.slot] is gen:
             self._slot_gen[gen.slot] = None
+            if self._paged:
+                self._pt[gen.slot] = 0
             if evicted:
                 stat_add("gen/evictions")
+        if self._paged and gen.pages:
+            # drop this generation's references; pages the prefix cache
+            # also holds stay allocated (shareable) until evicted
+            for pid in gen.pages:
+                self._pool.release(pid)
+            gen.pages = []
+            stat_set("gen/pages_free", self._pool.free_count)
         gen.slot = None
+        gen.prefilling = False
         stat_set("gen/slots_active",
                  sum(g is not None for g in self._slot_gen))
 
@@ -486,6 +849,124 @@ class GenerationEngine:
                          sum(g is not None for g in self._slot_gen))
             self._prefill(gen, slot)
 
+    def _admit_paged(self) -> bool:
+        """Assign free slots + page reservations to queued prompts, in
+        FIFO order. A generation reserves pages for its declared worst
+        case (prompt + max_new_tokens) minus whatever whole-page prefix
+        the radix cache already holds; when the pool cannot cover the
+        queue head even after LRU-evicting unreferenced cached pages,
+        admission stalls (head-of-line — predictable under pressure;
+        pages return via retire/cancel/TTL). Prefill itself happens
+        chunk-by-chunk in :meth:`_prefill_tick`."""
+        progressed = False
+        while True:
+            with self._cond:
+                free = [s for s, g in enumerate(self._slot_gen)
+                        if g is None]
+                if not free or not self._queue:
+                    stat_set("gen/queue_depth", len(self._queue))
+                    return progressed
+                gen = self._queue[0]
+                if gen.done:                # cancelled while queued
+                    self._queue.popleft()
+                    continue
+                P = self._page_tokens
+                need = -(-(gen.prompt.size + gen.max_new_tokens) // P)
+                matched: list[int] = []
+                if self._prefix is not None:
+                    matched = self._prefix.match(gen.prompt, self._pool)
+                short = (need - len(matched)) - self._pool.free_count
+                if short > 0 and self._prefix is not None:
+                    self._prefix.evict(short, self._pool)
+                if need - len(matched) > self._pool.free_count:
+                    for pid in matched:     # give the hits back; retry
+                        self._pool.release(pid)   # when pages free up
+                    stat_set("gen/queue_depth", len(self._queue))
+                    stat_set("gen/pages_free", self._pool.free_count)
+                    return progressed
+                self._queue.popleft()
+                gen.pages = matched + self._pool.alloc(need - len(matched))
+                gen.shared = len(matched)
+                slot = free[0]
+                self._slot_gen[slot] = gen
+                gen.slot = slot
+                gen.prefilling = True
+                gen.prefill_pos = len(matched) * P
+                gen.prefill_t0 = time.perf_counter()
+                self._pt[slot] = 0
+                self._pt[slot, :len(gen.pages)] = gen.pages
+                if matched:
+                    stat_add("gen/prefix_hits")
+                    stat_add("gen/prefix_tokens_saved", len(matched) * P)
+                stat_set("gen/pages_free", self._pool.free_count)
+                stat_set("gen/slots_active",
+                         sum(g is not None for g in self._slot_gen))
+                stat_set("gen/queue_depth", len(self._queue))
+                progressed = True
+
+    def _prefill_tick(self) -> bool:
+        """Advance every prefilling slot by ONE chunk (then the loop
+        runs a decode step — chunked prefill interleaves with decode
+        instead of stalling every active stream for a full-prompt
+        prefill). The final chunk samples the first token and flips the
+        slot into decode."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._cond:
+            work = [(s, g) for s, g in enumerate(self._slot_gen)
+                    if g is not None and g.prefilling]
+            pt = None if not work else self._pt.copy()
+        ticked = False
+        for slot, gen in work:
+            T0 = gen.prompt.size
+            a = gen.prefill_pos
+            C = self._prefill_chunk if self._prefill_chunk > 0 else T0 - a
+            b = min(T0, a + C)
+            final = b >= T0
+            smax = self._maxp * self._page_tokens
+            # cap the padded length so the traced write window stays in
+            # bounds (dynamic_update_slice clamps its start — an
+            # overflowing pad window would silently shift real tokens)
+            bucket = min(self._bucket(b - a), smax - a)
+            padded = np.full((bucket,), self._pad, np.int32)
+            padded[:b - a] = gen.prompt[a:b]
+            t0 = time.perf_counter()
+            with _trace.span("gen/prefill_chunk", slot=slot, index=a,
+                             tokens=b - a, final=final):
+                self._state, tok0 = self._prefill_fn(
+                    self._state, jnp.asarray(pt),
+                    jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
+                    jnp.asarray(a, jnp.int32),
+                    jnp.asarray(b - a, jnp.int32),
+                    jax.random.PRNGKey(gen.seed),
+                    jnp.asarray(gen.temperature, jnp.float32),
+                    jnp.asarray(gen.top_k, jnp.int32),
+                    jnp.asarray(gen.top_p, jnp.float32))
+                tok0 = int(tok0) if final else None
+            observe("gen/prefill_chunk_s", time.perf_counter() - t0)
+            ticked = True
+            with self._cond:
+                if self._slot_gen[slot] is not gen:
+                    continue                # cancelled/reaped mid-chunk
+                gen.prefill_pos = b
+                if not final:
+                    continue
+                gen.prefilling = False
+                observe("gen/prefill_s",
+                        time.perf_counter() - gen.prefill_t0)
+                if self._prefix is not None:
+                    self._prefix.insert(gen.prompt, gen.pages, self._pool)
+                gen.tokens.append(tok0)
+                stat_add("gen/tokens")
+                if ((gen.eos_token_id is not None
+                     and tok0 == gen.eos_token_id)
+                        or len(gen.tokens) >= gen.max_new_tokens):
+                    gen.done = True
+                    self._release_slot_locked(gen)
+                self._cond.notify_all()
+        return ticked
+
     def _prefill(self, gen: Generation, slot: int) -> None:
         import jax
         import jax.numpy as jnp
@@ -518,19 +999,25 @@ class GenerationEngine:
                 self._release_slot_locked(gen)
             self._cond.notify_all()
 
-    def _decode_step(self, jnp) -> None:
+    def _decode_step(self, jnp) -> bool:
         with self._cond:
             stepped = [(s, g) for s, g in enumerate(self._slot_gen)
-                       if g is not None]
+                       if g is not None and not g.prefilling]
             if not stepped:
-                return
+                return False
             active = np.zeros((self.slots,), bool)
             for s, _ in stepped:
                 active[s] = True
+            pt = None if not self._paged else self._pt.copy()
         t0 = time.perf_counter()
         with _trace.span("gen/decode_step", active=len(stepped)):
-            self._state, toks = self._step(self._state,
-                                           jnp.asarray(active))
+            if self._paged:
+                self._state, toks = self._step(self._state,
+                                               jnp.asarray(pt),
+                                               jnp.asarray(active))
+            else:
+                self._state, toks = self._step(self._state,
+                                               jnp.asarray(active))
             toks = np.asarray(toks)
         observe("gen/decode_step_s", time.perf_counter() - t0)
         with self._cond:
@@ -551,3 +1038,4 @@ class GenerationEngine:
             self._cond.notify_all()
         if self.step_wait_s > 0:
             time.sleep(self.step_wait_s)
+        return True

@@ -38,12 +38,16 @@ def dyn_mlp(tmp_path_factory):
 
 @pytest.fixture
 def batching_flags():
-    """Enable batching for a test; always restore the hard-off default."""
-    def enable(batch_max=16, timeout_s=0.05):
+    """Enable batching for a test; always restore the hard-off default.
+    ``min_queue=0`` by default so the coalescing-semantics tests see
+    every request enter the queue; the watermark tests opt back in."""
+    def enable(batch_max=16, timeout_s=0.05, min_queue=0):
         set_flags({"serving_batch_max": batch_max,
-                   "serving_batch_timeout_s": timeout_s})
+                   "serving_batch_timeout_s": timeout_s,
+                   "serving_batch_min_queue": min_queue})
     yield enable
-    set_flags({"serving_batch_max": 0, "serving_batch_timeout_s": 0.005})
+    set_flags({"serving_batch_max": 0, "serving_batch_timeout_s": 0.005,
+               "serving_batch_min_queue": 2})
 
 
 def _concurrent(n, fn):
@@ -228,6 +232,75 @@ def test_batcher_bad_request_fails_alone(dyn_mlp, batching_flags):
         srv.stop()
     assert good["y"].shape == (1, 3)
     assert bad_err and "shape" in str(bad_err[0])
+
+
+def test_min_queue_bypasses_idle_traffic(dyn_mlp, batching_flags):
+    """Below the load watermark a request skips the coalescing window
+    entirely (the conc-1 regression fix): sequential requests with
+    batching ON never form a batch, never wait, and return the same
+    results."""
+    batching_flags(batch_max=16, timeout_s=0.05, min_queue=2)
+    monitor.reset_stats("serving/")
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    ref = Predictor(dyn_mlp)
+    rs = np.random.RandomState(3)
+    try:
+        with InferenceClient(srv.endpoint) as c:
+            c.infer("m", np.zeros((1, 4), np.float32))   # compile warmup
+            monitor.reset_stats("serving/")
+            t0 = time.perf_counter()
+            for _ in range(6):
+                x = rs.randn(1, 4).astype(np.float32)
+                np.testing.assert_allclose(
+                    c.infer("m", x)[0], np.asarray(ref.run(x)),
+                    rtol=1e-5, atol=1e-6)
+            dt = time.perf_counter() - t0
+    finally:
+        srv.stop()
+    assert monitor.get_stat("serving/batch_bypass") == 6
+    assert monitor.get_stat("serving/batches") == 0
+    # six requests, zero 50 ms windows paid (the coalescing path would
+    # have cost >= 6 x 50 ms deterministically)
+    assert dt < 6 * 0.05
+
+
+def test_min_queue_keeps_burst_coalescing(dyn_mlp, batching_flags):
+    """The watermark only exempts idle traffic: a concurrent burst still
+    coalesces (at most the first arrival bypasses)."""
+    batching_flags(batch_max=16, timeout_s=0.05, min_queue=2)
+    monitor.reset_stats("serving/")
+    counting = _CountingPredictor(dyn_mlp)
+    srv = InferenceServer()
+    srv.add_model("m", counting)
+    srv.start()
+    try:
+        def worker(i):
+            with InferenceClient(srv.endpoint) as c:
+                c.infer("m", np.full((1, 4), float(i), np.float32))
+
+        _concurrent(8, worker)
+    finally:
+        srv.stop()
+    bypassed = monitor.get_stat("serving/batch_bypass")
+    batched = monitor.get_stat("serving/batched_requests")
+    assert bypassed + batched == 8
+    assert monitor.get_stat("serving/batches") >= 1
+    assert batched >= 2, (bypassed, batched)
+    assert counting.calls < 8, counting.batch_sizes
+
+
+def test_min_queue_zero_restores_unconditional_coalescing(
+        dyn_mlp, batching_flags):
+    batching_flags(batch_max=16, timeout_s=0.01, min_queue=0)
+    monitor.reset_stats("serving/")
+    srv = InferenceServer({"m": dyn_mlp}).start()
+    try:
+        with InferenceClient(srv.endpoint) as c:
+            c.infer("m", np.ones((1, 4), np.float32))
+    finally:
+        srv.stop()
+    assert monitor.get_stat("serving/batch_bypass") == 0
+    assert monitor.get_stat("serving/batches") == 1   # solo flush
 
 
 def test_batching_defaults_off_is_inert(dyn_mlp):

@@ -17,7 +17,11 @@ Writes ``BENCH_serving.json`` (repo root by default): per-concurrency
 req/s for both modes, speedups, and batch-shape stats
 (``serving/batch_size`` / ``batch_requests`` / ``batch_wait_s``
 histograms from the server's registry). The headline metric is the
-concurrency-8 speedup — the acceptance floor is 2x.
+concurrency-8 speedup — the acceptance floor is 2x. Concurrency 1
+exercises the ``FLAGS_serving_batch_min_queue`` watermark (default 2):
+idle traffic bypasses the coalescing window, so the batched mode must
+be within noise of unbatched (>= 0.95x; it measured 0.57x before the
+watermark existed).
 
 Usage: ``JAX_PLATFORMS=cpu python tools/bench_serving.py [-o OUT.json]``
 """
@@ -142,10 +146,12 @@ def main() -> int:
                     help="timed repetitions per cell (median reported)")
     args = ap.parse_args()
 
+    from paddle_tpu.core.flags import flag
     results: dict = {
         "model": f"MLP {LAYERS}x{WIDTH} (dynamic_batch export, CPU)",
         "serving_batch_max": BATCH_MAX,
         "serving_batch_timeout_s": BATCH_TIMEOUT_S,
+        "serving_batch_min_queue": int(flag("serving_batch_min_queue")),
         "reps": args.reps,
         "concurrency": {},
     }

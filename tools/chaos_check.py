@@ -30,6 +30,13 @@ Scenarios (all CPU-only, single process):
    finish byte-identical to solo ``generate()``, a new generation is
    admitted into the reclaimed slot, and the ``gen/*`` counters stay
    consistent.
+8. **gen-paged**: the paged engine (``FLAGS_gen_paged`` geometry: small
+   pages, chunked prefill, prefix cache) under a client kill
+   mid-chunked-prefill — the TTL reaps the victim BEFORE its prefill
+   completes, every reserved page returns to the pool (no leaks: after
+   the survivors finish and the prefix cache drains, the pool is back
+   to full), survivors stay byte-identical to solo ``generate()``, and
+   a prefix-sharing readmit lands in the reclaimed pages.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -85,6 +92,14 @@ def check_defaults_off() -> None:
     g = get_flags(["gen_slots", "gen_poll_ttl_s"])
     check("defaults/gen_engine_off", g["gen_slots"] == 0
           and g["gen_poll_ttl_s"] > 0, str(g))
+    p = get_flags(["gen_paged", "gen_pages", "gen_prefill_chunk",
+                   "gen_page_tokens"])
+    check("defaults/gen_paged_off", not p["gen_paged"]
+          and p["gen_pages"] == 0 and p["gen_prefill_chunk"] == 0
+          and p["gen_page_tokens"] > 0, str(p))
+    mq = get_flags(["serving_batch_min_queue"])
+    check("defaults/batch_watermark_sane",
+          mq["serving_batch_min_queue"] >= 0, str(mq))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -469,6 +484,127 @@ def scenario_gen_engine(tmp: str) -> None:
         srv.stop()     # closes the engine too
 
 
+def scenario_gen_paged(tmp: str) -> None:
+    """Client killed mid-CHUNKED-PREFILL under the paged engine: the
+    poll TTL reaps it before its prefill completes, all its reserved
+    pages return to the pool, survivors are byte-identical to solo
+    generate(), and a shared-prefix readmit reuses the cached pages."""
+    import threading
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    monitor.reset_stats("gen/")
+    # 4-token pages + 1-token chunks + a paced loop: the victim's
+    # 56-token prompt spans dozens of loop iterations, so a 0.45s TTL
+    # fires while it is demonstrably mid-prefill
+    engine = GenerationEngine(model, slots=3, max_len=64, queue_max=8,
+                              ttl_s=0.45, step_wait_s=0.02, paged=True,
+                              page_tokens=4, prefill_chunk=1,
+                              prefix_cache=True)
+    srv = io.InferenceServer().start()
+    srv.add_generator("pllm", engine)
+    total = engine.stats()["pages"]
+    rs = np.random.RandomState(5)
+    # warm the prefill-chunk + decode compiles so the TTL races real
+    # scheduling, not XLA compilation, then drain the prefix cache
+    wid = engine.start(rs.randint(0, 96, (5,)).astype(np.int32), 2)
+    n = 0
+    while True:
+        doc = engine.poll(wid, start=n, wait_s=1.0)
+        n += len(doc["tokens"])
+        if doc["done"]:
+            break
+    engine.clear_prefix_cache()
+    shared_prefix = rs.randint(0, 96, (9,)).astype(np.int32)
+    tails = rs.randint(0, 96, (2, 3)).astype(np.int32)
+    prompts = [np.concatenate([shared_prefix, t]) for t in tails]
+    refs = [np.asarray(generate(model, p[None], 20))[0, len(p):]
+            for p in prompts]
+    victim_prompt = rs.randint(0, 96, (56,)).astype(np.int32)
+    survivors: dict = {}
+    errors: list = []
+    try:
+        # survivors first: their decode steps pace the loop
+        def worker(i):
+            try:
+                c = io.InferenceClient(srv.endpoint)
+                survivors[i] = list(c.generate("pllm", prompts[i], 20))
+                c.close()
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in (0, 1)]
+        for t in threads:
+            t.start()
+        victim = io.InferenceClient(srv.endpoint)
+        vic_id = victim.generate_start("pllm", victim_prompt, 6)
+        # drop the socket with no cancel: only the TTL can reap it
+        victim.close()
+        # watch the victim's chunked prefill advance until the reap
+        # pops it from the engine; the last observation tells whether
+        # the TTL really fired mid-prefill
+        deadline = time.time() + 10.0
+        last_pos, completed_prefill = 0, False
+        while time.time() < deadline:
+            with engine._cond:
+                g = engine._gens.get(vic_id)
+                if g is None:
+                    break                    # reaped (and purged)
+                if g.slot is not None and not g.prefilling:
+                    completed_prefill = True
+                    break                    # outlived the TTL: invalid
+                last_pos = max(last_pos, g.prefill_pos)
+            time.sleep(0.01)
+        check("gen_paged/reaped_mid_prefill",
+              not completed_prefill and g is None
+              and 0 < last_pos < victim_prompt.size,
+              f"last_pos={last_pos} completed={completed_prefill}")
+        for t in threads:
+            t.join(timeout=30)
+        check("gen_paged/survivors_byte_identical",
+              not errors and len(survivors) == 2
+              and all(np.array_equal(np.asarray(survivors[i], np.int32),
+                                     refs[i]) for i in (0, 1)),
+              f"errors={errors[:2]}")
+        check("gen_paged/eviction_counted",
+              monitor.get_stat("gen/evictions") >= 1)
+
+        # shared-prefix readmit into the reclaimed pages: prompts share
+        # a 9-token prefix -> 2 cached 4-token pages
+        c = io.InferenceClient(srv.endpoint)
+        toks = list(c.generate("pllm", prompts[0], 20))
+        c.close()
+        check("gen_paged/readmit_after_reclaim",
+              np.array_equal(np.asarray(toks, np.int32), refs[0]))
+        check("gen_paged/prefix_shared",
+              monitor.get_stat("gen/prefix_hits") >= 1
+              and monitor.get_stat("gen/prefix_tokens_saved") >= 8,
+              str(monitor.export_stats("gen/")))
+
+        # no leaks: once the prefix cache drains, the pool is FULL
+        deadline = time.time() + 5.0
+        st = engine.stats()
+        while time.time() < deadline:
+            engine.clear_prefix_cache()
+            st = engine.stats()
+            if st["pages_free"] == total and st["active"] == 0:
+                break
+            time.sleep(0.05)
+        check("gen_paged/pool_returns_to_full",
+              st["pages_free"] == total and st["active"] == 0
+              and st["prefix_entries"] == 0, f"{st} total={total}")
+    finally:
+        srv.stop()     # closes the engine too
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -476,7 +612,7 @@ def main() -> int:
         for scenario in (scenario_serving_wire, scenario_checkpoint,
                          scenario_elastic_resume, scenario_overload,
                          scenario_obs, scenario_serving_routed,
-                         scenario_gen_engine):
+                         scenario_gen_engine, scenario_gen_paged):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
