@@ -213,6 +213,66 @@ define_flag("gen_prefix_cache", True,
             "prefill runs once per unique prefix "
             "(gen/prefix_hits, gen/prefix_tokens_saved). Cached pages "
             "are LRU-evicted under pool pressure")
+# --- serving control plane (serving/control.py ServingController) ---
+define_flag("control_interval_s", 1.0,
+            "Cadence of the ServingController reconcile loop (signal "
+            "collection, eviction, scale decisions). <= 0 disables the "
+            "background thread — the controller then only acts on "
+            "explicit tick()/scale_to() calls (how the tests drive it "
+            "deterministically)")
+define_flag("control_warm_models", 0,
+            "Warm-tier capacity of the multi-model multiplexer: max "
+            "models kept resident per replica; beyond it the controller "
+            "unloads the least-recently-used cold-tier models (per-model "
+            "last-used/bytes stats ship in health). 0 — the default — "
+            "disables eviction entirely: every loaded model stays "
+            "resident, byte-identical to the pre-control-plane fleet")
+define_flag("control_min_replicas", 1,
+            "Floor of the managed replica set: scale-down never goes "
+            "below it, and start() spawns up to it")
+define_flag("control_max_replicas", 0,
+            "Ceiling of the managed replica set. 0 — the default — "
+            "disables autoscaling entirely: the controller never spawns "
+            "or retires replicas on its own (manual scale_to still "
+            "works), so constructing one changes nothing")
+define_flag("control_target_ttft_s", 0.0,
+            "Time-to-first-token SLO: when the fleet-merged p99 of the "
+            "gen/ttft_s histogram (enqueue -> first token, per control "
+            "interval window) exceeds it, that's scale-up pressure. "
+            "0 disables the TTFT signal")
+define_flag("control_queue_high", 1.0,
+            "Scale-up pressure when queued generations per replica "
+            "reach this (a queued prompt means demand already exceeds "
+            "slot/page capacity). <= 0 disables the queue signal")
+define_flag("control_occupancy_high", 0.9,
+            "Scale-up pressure when mean generation-slot occupancy "
+            "(active/slots across replicas) reaches this — a fleet this "
+            "full cannot absorb a burst. > 1 disables")
+define_flag("control_occupancy_low", 0.25,
+            "Scale-down eligibility: the fleet must idle below this "
+            "occupancy (and show zero pressure signals) for "
+            "control_idle_ticks consecutive ticks")
+define_flag("control_inflight_high", 0.0,
+            "Scale-up pressure when mean in-flight wire requests per "
+            "replica reach this — the load signal for engine-less "
+            "(plain infer) fleets. 0 disables")
+define_flag("control_breach_ticks", 2,
+            "Hysteresis: consecutive breaching ticks required before a "
+            "scale-up fires (one noisy sample never scales)")
+define_flag("control_idle_ticks", 5,
+            "Hysteresis: consecutive fully-idle ticks required before a "
+            "scale-down fires (longer than breach_ticks on purpose — "
+            "adding capacity is cheap, removing it churns)")
+define_flag("control_cooldown_s", 5.0,
+            "Minimum gap between automatic scale events; decisions made "
+            "inside the cooldown are recorded as held, not acted on — "
+            "with breach/idle ticks this is what makes the loop "
+            "flap-proof")
+define_flag("control_drain_s", 10.0,
+            "Sticky-drain deadline at scale-down: the cordoned victim "
+            "gets this long for in-flight generations and infers to "
+            "finish before it is stopped (a forced stop past the "
+            "deadline is counted and logged, never silent)")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

@@ -29,7 +29,9 @@ from paddle_tpu.io.guard import (
 from paddle_tpu.io.fs import (
     FS, FSService, LocalFS, WireFS, fs_for_path, register_fs,
 )
-from paddle_tpu.io.serving import InferenceClient, InferenceServer
+from paddle_tpu.io.serving import (
+    InferenceClient, InferenceServer, ModelBusyError,
+)
 from paddle_tpu.io.crypto import (
     load_state_dict_encrypted, save_state_dict_encrypted, generate_key,
 )
@@ -40,6 +42,7 @@ __all__ = ["save_checkpoint", "load_checkpoint", "save_state_dict",
            "Predictor", "TrainEpochRange", "train_epoch_range",
            "save_state_dict_encrypted", "load_state_dict_encrypted",
            "generate_key", "InferenceServer", "InferenceClient",
+           "ModelBusyError",
            "FS", "LocalFS", "WireFS", "FSService", "fs_for_path",
            "register_fs", "latest_step", "verify_step",
            "CheckpointIntegrityError", "TrainGuard", "PreemptionHandler",

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -46,12 +47,25 @@ from paddle_tpu.core.wire import (
     CODE_SHED, FrameClient, FrameService, send_frame,
 )
 
-__all__ = ["InferenceServer", "InferenceClient"]
+__all__ = ["InferenceServer", "InferenceClient", "ModelBusyError"]
 
 SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4,
                "generate_start": 5, "generate_poll": 6,
-               "generate_cancel": 7}
+               "generate_cancel": 7, "unload_model": 8}
 _OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
+
+# Marker prefix for the typed busy error as it crosses the wire (the
+# frame protocol only carries an error string; the client re-raises the
+# typed class when it sees the marker).
+_BUSY_MARKER = "model busy:"
+
+
+class ModelBusyError(RuntimeError):
+    """``unload_model`` refused: the model still has requests inside the
+    dynamic batcher (queued on the coalescing window or executing).
+    Typed so controllers can distinguish "try again in a moment" from a
+    real failure — the unload never ran and is safe to retry once the
+    in-flight work drains."""
 
 
 def _pack_arrays(arrays) -> tuple[list[dict], bytes]:
@@ -87,7 +101,8 @@ class InferenceServer(FrameService):
     ``io.save_inference_model``) or an already-constructed Predictor.
 
     ``admin_ops`` controls the mutating wire ops (``load_model`` — which
-    reads an arbitrary server-side path — and ``stop``). Default: enabled
+    reads an arbitrary server-side path — ``unload_model``, and
+    ``stop``). Default: enabled
     only when bound to loopback; when exposing the server beyond
     localhost, the data-plane ``infer``/``list_models`` stay available
     and admin must be opted into explicitly.
@@ -103,6 +118,10 @@ class InferenceServer(FrameService):
 
         self._predictor_cls = Predictor
         self._models: dict[str, Any] = {}
+        # per-model usage/footprint stats (shipped in ``health`` so a
+        # control plane can make LRU/eviction decisions from data):
+        # name -> {infers, last_used_ts, resident_bytes}
+        self._model_stats: dict[str, dict[str, float]] = {}
         self._generators: dict[str, Any] = {}
         self._lock = threading.Lock()
         # per-server coalescer; consulted only when FLAGS_serving_batch_max
@@ -120,6 +139,7 @@ class InferenceServer(FrameService):
         path). A path is validated HERE — artifact + meta must exist and
         deserialize — so a bad ``load_model`` fails at registration with
         a wire error, not at some later caller's first ``infer``."""
+        resident = 0
         if isinstance(model, str):
             from paddle_tpu.io.export import _ARTIFACT, _META
 
@@ -129,6 +149,10 @@ class InferenceServer(FrameService):
                         f"{model!r} is not an inference-model directory "
                         f"(missing {part}); expected the layout written "
                         "by save_inference_model")
+            # artifact size approximates resident bytes (weights are
+            # baked into the StableHLO blob) — the LRU signal a control
+            # plane weighs eviction candidates by
+            resident = os.path.getsize(os.path.join(model, _ARTIFACT))
             try:
                 pred = self._predictor_cls(model)
             except Exception as e:
@@ -137,8 +161,34 @@ class InferenceServer(FrameService):
                     f"{type(e).__name__}: {e}") from e
         else:
             pred = model
+            resident = int(getattr(model, "resident_bytes", 0) or 0)
         with self._lock:
             self._models[name] = pred
+            self._model_stats[name] = {
+                "infers": 0, "last_used_ts": time.time(),
+                "resident_bytes": resident}
+
+    def unload_model(self, name: str) -> bool:
+        """Drop a registered model (the warm→cold transition of the
+        serving control plane's multiplexing tier). Returns False for an
+        unknown name (idempotent — a broadcast unload tolerates replicas
+        that never loaded it). Raises :class:`ModelBusyError` while the
+        model has requests inside the dynamic batcher: the unload never
+        runs, the caller retries after the queue drains — never a hang,
+        never a predictor yanked out from under a forming batch.
+        Requests already past the registry lookup keep their predictor
+        reference and complete normally."""
+        n = self._batcher.pending(name)
+        if n > 0:
+            raise ModelBusyError(
+                f"{_BUSY_MARKER} {name!r} has {n} request(s) in the "
+                "batcher; retry after they drain")
+        with self._lock:
+            existed = self._models.pop(name, None) is not None
+            self._model_stats.pop(name, None)
+        if existed:
+            stat_add("serving/models_unloaded")
+        return existed
 
     def add_generator(self, name: str, model, **engine_kwargs) -> None:
         """Register a continuous-batching :class:`~paddle_tpu.serving.
@@ -176,13 +226,23 @@ class InferenceServer(FrameService):
                histograms: bool = False) -> dict:
         """FrameService health + per-generator slot AND page-pool
         occupancy (paged engines report ``pages_free``/``pages`` +
-        ``prefix_entries``), so routers/probes see generation capacity
-        without a dedicated op."""
+        ``prefix_entries``) + per-model usage stats (infer count,
+        last-used timestamp/idle seconds, approx resident bytes), so
+        routers, probes, and the serving control plane see generation
+        capacity and warm-tier residency without a dedicated op.
+        ``stats_prefix`` keeps filtering the monitor-stats snapshot
+        only — the ``models``/``generators`` sections always ship (they
+        are the decision inputs a control loop polls for)."""
         doc = super().health(stats_prefix, histograms)
+        now = time.time()
         with self._lock:
             gens = {n: e.stats() for n, e in self._generators.items()}
+            models = {n: dict(st, idle_s=max(now - st["last_used_ts"],
+                                             0.0))
+                      for n, st in self._model_stats.items()}
         if gens:
             doc["generators"] = gens
+        doc["models"] = models
         return doc
 
     def stop(self, drain_s: float | None = None) -> None:
@@ -195,7 +255,8 @@ class InferenceServer(FrameService):
     def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
         name = _OP_NAMES.get(op)
         try:
-            if name in ("stop", "load_model") and not self._admin_ops:
+            if (name in ("stop", "load_model", "unload_model")
+                    and not self._admin_ops):
                 send_frame(sock, 1, {"error": f"admin op {name!r} disabled "
                                      "on this server (admin_ops=False)"})
                 return True
@@ -218,6 +279,10 @@ class InferenceServer(FrameService):
             if name == "load_model":
                 self.add_model(header["name"], header["path"])
                 send_frame(sock, 0, {})
+                return True
+            if name == "unload_model":
+                send_frame(sock, 0,
+                           {"unloaded": self.unload_model(header["name"])})
                 return True
             if name == "generate_start":
                 from paddle_tpu.serving.engine import EngineOverloaded
@@ -261,6 +326,10 @@ class InferenceServer(FrameService):
                 return True
             with self._lock:
                 pred = self._models.get(header["model"])
+                st = self._model_stats.get(header["model"])
+                if st is not None:       # LRU signal for the control plane
+                    st["infers"] += 1
+                    st["last_used_ts"] = time.time()
             if pred is None:
                 raise KeyError(f"no model {header['model']!r}; loaded: "
                                f"{sorted(self._models)}")
@@ -305,7 +374,8 @@ class InferenceClient(FrameClient):
         super().__init__(endpoint, SERVING_OPS, service="serving",
                          timeout=timeout, retries=retries,
                          idempotent=("infer", "list_models", "load_model",
-                                     "generate_poll", "generate_cancel"))
+                                     "unload_model", "generate_poll",
+                                     "generate_cancel"))
 
     def infer(self, model: str, *inputs) -> list[np.ndarray]:
         specs, payload = _pack_arrays(inputs)
@@ -394,6 +464,20 @@ class InferenceClient(FrameClient):
 
     def load_model(self, name: str, path: str) -> None:
         self._request("load_model", {"name": name, "path": path})
+
+    def unload_model(self, name: str) -> bool:
+        """Drop ``name`` from the server's registry (admin-gated like
+        ``load_model``). False for a model that was never loaded
+        (idempotent). A model with requests still inside the server's
+        batcher surfaces as the typed :class:`ModelBusyError` — the
+        unload never ran and is retryable once the queue drains."""
+        try:
+            return self._request(
+                "unload_model", {"name": name})[0]["unloaded"]
+        except RuntimeError as e:
+            if _BUSY_MARKER in str(e):
+                raise ModelBusyError(str(e)) from e
+            raise
 
     def stop_server(self) -> None:
         try:

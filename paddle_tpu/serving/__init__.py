@@ -1,5 +1,6 @@
-"""paddle_tpu.serving — serving at scale: cross-request dynamic batching
-and health-aware replica routing.
+"""paddle_tpu.serving — serving at scale: cross-request dynamic batching,
+health-aware replica routing, continuous-batching generation, and the
+fleet control plane.
 
 Reference role: the Paddle Serving deployment tier around the inference
 engine — a fleet of ``AnalysisPredictor`` replicas behind a router
@@ -12,10 +13,19 @@ micro-batching a TPU wants; the **client half**
 (:class:`~paddle_tpu.serving.router.RoutedClient`) spreads idempotent
 requests across N replicas by least-inflight pick with health-probe
 membership and shed/connect failover, so a replica kill degrades to the
-survivors instead of failing callers.
+survivors instead of failing callers; and the **control plane**
+(:class:`~paddle_tpu.serving.control.ServingController`) is the
+fleet-manager role above both — multi-model multiplexing with warm/cold
+tiers and LRU eviction, SLO-driven autoscaling from the merged health
+signals, and sticky-drain scale-down that never loses an in-flight
+generation.
 """
 
 from paddle_tpu.serving.batcher import DynamicBatcher
+from paddle_tpu.serving.control import (
+    ControlDecision, InProcSpawner, ReplicaSpawner, ServingController,
+    SubprocessSpawner,
+)
 from paddle_tpu.serving.engine import (
     EngineOverloaded, Generation, GenerationEngine,
 )
@@ -25,4 +35,6 @@ from paddle_tpu.serving.router import (
 
 __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "GenerationEngine", "Generation", "EngineOverloaded",
-           "StickySession", "GenerationFailed"]
+           "StickySession", "GenerationFailed", "ServingController",
+           "ControlDecision", "ReplicaSpawner", "InProcSpawner",
+           "SubprocessSpawner"]

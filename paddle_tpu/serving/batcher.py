@@ -185,6 +185,18 @@ class DynamicBatcher:
                 q = self._queues[model] = _ModelQueue()
             return q
 
+    def pending(self, model: str) -> int:
+        """Requests currently inside :meth:`submit` for ``model`` —
+        queued on the batching window or executing. The ``unload_model``
+        admin op consults this so an unload can fail clean (typed error)
+        instead of yanking a predictor out from under a forming batch."""
+        with self._lock:
+            q = self._queues.get(model)
+        if q is None:
+            return 0
+        with q.cv:
+            return q.inflight
+
     def _submit(self, q: _ModelQueue, pred, model: str, p: _Pending
                 ) -> None:
         with q.cv:

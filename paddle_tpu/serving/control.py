@@ -1,0 +1,825 @@
+"""Serving control plane: multi-model multiplexing, SLO-driven
+autoscaling, and sticky-drain scale-down.
+
+Reference role: the fleet-management half of Paddle Serving — a config
+names N models and a replica count, a manager process keeps that many
+predictor replicas alive, loads/unloads models on them, and resizes the
+fleet against load. paddle_tpu shipped the *mechanisms* over PRs 2–6
+(universal ``health`` with slot/page occupancy + mergeable histograms,
+``RoutedClient`` live membership, broadcast ``load_model``, graceful
+``drain``) but nothing *decided* anything. This module is the decider —
+the layer Orca (OSDI '22) and vLLM (SOSP '23) both assume above the
+engine:
+
+- **Multi-model multiplexing** — :meth:`ServingController.register_model`
+  builds a registry larger than any one replica keeps resident. A
+  request for a cold model faults it in (broadcast ``load_model``);
+  the reconcile loop reads the per-model stats every replica now ships
+  in ``health`` (infer count, last-used, approx resident bytes) and
+  LRU-evicts past the ``control_warm_models`` warm-tier capacity with
+  the new ``unload_model`` wire op. ``register_model(..., warm=True)``
+  pins a model against eviction.
+- **SLO-driven autoscaling** — each ``control_interval_s`` the loop
+  merges the fleet's already-shipped signals: queued generations and
+  slot occupancy from ``health``'s ``generators`` section, mean wire
+  in-flight, and the p99 of the per-window ``gen/ttft_s`` histogram
+  delta (raw bucket counts are mergeable across endpoints —
+  ``monitor.merge_histograms``) against ``control_target_ttft_s``.
+  Sustained pressure (``control_breach_ticks`` consecutive breaching
+  ticks) scales up through a :class:`ReplicaSpawner`; sustained idleness
+  (``control_idle_ticks``) scales down; ``control_cooldown_s`` spaces
+  scale events. Hysteresis + cooldown make the loop flap-proof by
+  construction.
+- **Sticky-drain scale-down** — the victim is ``cordon``\\ ed (no new
+  routed or session picks; pooled connections stay open), the controller
+  watches its health until in-flight requests hit zero and every
+  generation is *delivered* (done AND its final poll answered — the
+  engine's ``undelivered`` stat), then stops it through the spawner and
+  removes the membership. In-flight session-pinned generations run to
+  completion on the replica holding their KV state: zero lost idempotent
+  requests, zero ``GenerationFailed`` on a clean scale event. A drain
+  that outlives ``control_drain_s`` is forced — counted and logged,
+  never silent.
+
+Every action is a typed :class:`ControlDecision` (action, reason, the
+signal snapshot it was computed from) kept in a ring buffer
+(:meth:`ServingController.decisions`) — every scale event is
+explainable after the fact.
+
+Defaults are hard-off (the ``FLAGS_trace`` pattern): with
+``control_max_replicas=0`` the loop never scales, with
+``control_warm_models=0`` it never evicts, and nothing in the serving
+data path reads any ``control_*`` flag — a fleet without a controller
+is byte-identical to the PR-6 state.
+
+Observability: ``control/replicas`` gauge; ``control/ticks`` /
+``control/scale_ups`` / ``control/scale_downs`` / ``control/replaced`` /
+``control/model_evictions`` / ``control/model_faults`` /
+``control/drain_forced`` / ``control/spawn_failures`` counters;
+``control/drain_s`` histogram; ``control/tick`` / ``control/scale_up`` /
+``control/drain`` spans.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from paddle_tpu.core import trace as _trace
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.logging import get_logger
+from paddle_tpu.core.monitor import (
+    merge_histograms, observe, stat_add, stat_set,
+)
+from paddle_tpu.io.serving import (
+    InferenceClient, InferenceServer, ModelBusyError,
+)
+from paddle_tpu.serving.router import RoutedClient
+
+__all__ = ["ServingController", "ControlDecision", "ReplicaSpawner",
+           "InProcSpawner", "SubprocessSpawner"]
+
+_log = get_logger()
+
+
+@dataclass
+class ControlDecision:
+    """One explainable control-plane action. ``signals`` is the fleet
+    snapshot the decision was computed from (queue depth, occupancy,
+    TTFT p99, replica count, ...) — JSON-safe, so decisions export
+    straight into logs/benches."""
+
+    action: str                  # scale_up | scale_down | hold | evict |
+    #                              fault_in | replace | spawn_failed
+    reason: str
+    endpoint: str | None = None
+    clean: bool = True           # drains: finished inside the deadline?
+    ts: float = 0.0
+    signals: dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {"action": self.action, "reason": self.reason,
+                "endpoint": self.endpoint, "clean": self.clean,
+                "ts": self.ts, "signals": dict(self.signals)}
+
+
+class ReplicaSpawner:
+    """Hook through which the controller creates/destroys replicas —
+    the only part of the control plane that knows HOW a replica runs
+    (in-process for tests/bench, subprocess for chaos/isolation, a k8s
+    client in a real deployment). ``spawn`` returns the new replica's
+    ``host:port`` once it is accepting; ``stop`` shuts one down with a
+    graceful-drain budget."""
+
+    def spawn(self) -> str:                      # pragma: no cover
+        raise NotImplementedError
+
+    def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
+        raise NotImplementedError                # pragma: no cover
+
+
+class InProcSpawner(ReplicaSpawner):
+    """Replicas are :class:`~paddle_tpu.io.serving.InferenceServer`
+    instances in this process, built by ``factory()`` (which registers
+    whatever models/generators a replica of this fleet serves, and may
+    pre-warm compiles). The factory may return a started or unstarted
+    server. ``kill`` severs one without drain — the chaos path."""
+
+    def __init__(self, factory: Callable[[], InferenceServer]):
+        self._factory = factory
+        self._lock = threading.Lock()
+        self.servers: dict[str, InferenceServer] = {}
+
+    def spawn(self) -> str:
+        srv = self._factory()
+        if srv._thread is None:          # factory may pre-start
+            srv.start()
+        with self._lock:
+            self.servers[srv.endpoint] = srv
+        return srv.endpoint
+
+    def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
+        with self._lock:
+            srv = self.servers.pop(endpoint, None)
+        if srv is not None:
+            srv.stop(drain_s=drain_s if drain_s > 0 else None)
+
+    def kill(self, endpoint: str) -> None:
+        """Hard stop — sockets severed, no drain (a crash, for chaos)."""
+        with self._lock:
+            srv = self.servers.pop(endpoint, None)
+        if srv is not None:
+            srv.stop()
+
+
+class SubprocessSpawner(ReplicaSpawner):
+    """Each replica is a separate OS process (its own GIL and XLA
+    runtime) running ``python -m paddle_tpu.serving.replica_main`` with
+    the given ``name=path`` model artifacts. ``spawn`` blocks until the
+    child prints its endpoint; ``stop`` asks it to drain over the wire
+    and escalates to terminate/kill; :meth:`kill` SIGKILLs — the
+    realistic chaos primitive for "a replica died mid-scale-event"."""
+
+    def __init__(self, models: dict[str, str] | None = None, *,
+                 startup_timeout_s: float = 60.0,
+                 extra_args: tuple[str, ...] = ()):
+        self._models = dict(models or {})
+        self._timeout = float(startup_timeout_s)
+        self._extra = tuple(extra_args)
+        self._lock = threading.Lock()
+        self.procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(self) -> str:
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.replica_main"]
+        cmd += [f"{n}={p}" for n, p in self._models.items()]
+        cmd += list(self._extra)
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        deadline = time.monotonic() + self._timeout
+        endpoint = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("ENDPOINT "):
+                endpoint = line.split(None, 1)[1].strip()
+                break
+        if endpoint is None:
+            proc.kill()
+            raise RuntimeError(
+                "replica subprocess failed to report an endpoint within "
+                f"{self._timeout}s (exit={proc.poll()})")
+        with self._lock:
+            self.procs[endpoint] = proc
+        return endpoint
+
+    def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
+        with self._lock:
+            proc = self.procs.pop(endpoint, None)
+        if proc is None:
+            return
+        try:                             # graceful: wire stop op drains
+            with InferenceClient(endpoint, timeout=5.0, retries=0) as c:
+                c.stop_server()
+        except (ConnectionError, RuntimeError, OSError):
+            pass
+        try:
+            proc.wait(timeout=max(drain_s, 0.0) + 10.0)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+    def kill(self, endpoint: str) -> None:
+        """SIGKILL the replica process — no drain, no goodbye."""
+        with self._lock:
+            proc = self.procs.pop(endpoint, None)
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+
+
+def _hist_delta(prev: dict | None, cur: dict | None) -> dict | None:
+    """Per-window histogram: raw bucket counts of ``cur`` minus
+    ``prev`` (both ``export_histograms(raw=True)`` entries). None until
+    a baseline exists or when nothing landed in the window — an SLO
+    judges *recent* latency, not the life of the process (and in-proc
+    test fleets share one registry, so absolute counts only grow)."""
+    if not cur or not cur.get("buckets"):
+        return None
+    if not prev or not prev.get("buckets"):
+        return None                         # first tick: baseline only
+    buckets = [max(int(c) - int(p), 0)
+               for c, p in zip(cur["buckets"], prev["buckets"])]
+    count = sum(buckets)
+    if count == 0:
+        return None
+    return {"buckets": buckets, "count": count,
+            "sum": max(float(cur.get("sum", 0.0))
+                       - float(prev.get("sum", 0.0)), 0.0),
+            # min/max only clamp quantile interpolation; the lifetime
+            # bounds are a safe (slightly loose) envelope for the window
+            "min": cur.get("min", 0.0), "max": cur.get("max", 0.0)}
+
+
+class ServingController:
+    """The fleet manager: owns a managed replica set (created through
+    ``spawner``), a model registry bigger than any replica's warm tier,
+    and the reconcile loop that turns health signals into scale/evict
+    decisions.
+
+    Every knob defaults to its ``control_*`` flag (the
+    ``GenerationEngine`` pattern); with the flag defaults the controller
+    is inert — ``max_replicas=0`` disables autoscaling,
+    ``warm_models=0`` disables eviction, and ``interval_s<=0`` disables
+    the background thread entirely (tests drive :meth:`tick` manually).
+    ``endpoints`` adopts existing replicas into routing as *unmanaged*
+    members: they receive traffic and count toward capacity but are
+    never scaled down or stopped.
+
+    Manual overrides — :meth:`scale_to` / :meth:`scale_down` — skip
+    hysteresis and cooldown but use the same sticky-drain machinery, so
+    an operator-initiated scale-down is exactly as lossless as an
+    automatic one.
+    """
+
+    def __init__(self, spawner: ReplicaSpawner, *,
+                 router: RoutedClient | None = None,
+                 endpoints: tuple[str, ...] | list[str] = (),
+                 interval_s: float | None = None,
+                 warm_models: int | None = None,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 target_ttft_s: float | None = None,
+                 queue_high: float | None = None,
+                 occupancy_high: float | None = None,
+                 occupancy_low: float | None = None,
+                 inflight_high: float | None = None,
+                 breach_ticks: int | None = None,
+                 idle_ticks: int | None = None,
+                 cooldown_s: float | None = None,
+                 drain_s: float | None = None,
+                 decisions_max: int = 256):
+        def _f(v, name):
+            return flag(name) if v is None else v
+
+        self._spawner = spawner
+        self._own_router = router is None
+        self._router = router if router is not None else RoutedClient()
+        self.interval_s = float(_f(interval_s, "control_interval_s"))
+        self.warm_models = int(_f(warm_models, "control_warm_models"))
+        self.min_replicas = int(_f(min_replicas, "control_min_replicas"))
+        self.max_replicas = int(_f(max_replicas, "control_max_replicas"))
+        self.target_ttft_s = float(_f(target_ttft_s,
+                                      "control_target_ttft_s"))
+        self.queue_high = float(_f(queue_high, "control_queue_high"))
+        self.occupancy_high = float(_f(occupancy_high,
+                                       "control_occupancy_high"))
+        self.occupancy_low = float(_f(occupancy_low,
+                                      "control_occupancy_low"))
+        self.inflight_high = float(_f(inflight_high,
+                                      "control_inflight_high"))
+        self.breach_ticks = int(_f(breach_ticks, "control_breach_ticks"))
+        self.idle_ticks = int(_f(idle_ticks, "control_idle_ticks"))
+        self.cooldown_s = float(_f(cooldown_s, "control_cooldown_s"))
+        self.drain_s = float(_f(drain_s, "control_drain_s"))
+
+        self._lock = threading.RLock()
+        self._registry: dict[str, dict[str, Any]] = {}   # name -> spec
+        self._managed: set[str] = set()
+        self._decisions: deque[ControlDecision] = deque(
+            maxlen=max(int(decisions_max), 1))
+        self._breach = 0
+        self._idle = 0
+        self._last_scale = 0.0           # monotonic; 0 = never
+        self._unreachable: dict[str, int] = {}   # ep -> consecutive ticks
+        self._ttft_prev: dict[str, dict] = {}    # ep -> raw hist snapshot
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        for ep in endpoints:
+            self._router.add_endpoint(ep)
+
+    # -- model registry / multiplexing ------------------------------------
+    def register_model(self, name: str, path: str,
+                       warm: bool = False) -> None:
+        """Add an artifact to the fleet's model registry. ``warm=True``
+        pins it: loaded on every replica (now and at every spawn) and
+        never LRU-evicted. Cold models load on first demand
+        (:meth:`infer` faults them in) and live under the
+        ``warm_models`` residency cap."""
+        with self._lock:
+            self._registry[name] = {"path": path, "warm": bool(warm)}
+        if warm:
+            try:
+                self._router.load_model(name, path)
+            except (ConnectionError, RuntimeError, OSError):
+                pass                     # no replicas yet: loads at spawn
+
+    def registered_models(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {n: dict(s) for n, s in self._registry.items()}
+
+    def load_model(self, name: str) -> None:
+        """Broadcast-load a registered model on every healthy
+        non-cordoned replica (the cold→warm fault-in)."""
+        with self._lock:
+            spec = self._registry.get(name)
+        if spec is None:
+            raise KeyError(f"model {name!r} not registered with the "
+                           f"controller; registered: "
+                           f"{sorted(self._registry)}")
+        self._router.load_model(name, spec["path"])
+        stat_add("control/model_faults")
+
+    def infer(self, model: str, *inputs):
+        """Routed infer with cold-model fault-in: an unknown-model
+        rejection loads the registered artifact fleet-wide, enforces the
+        warm-tier cap, and retries once. The steady-state hot path is
+        exactly ``RoutedClient.infer`` — one extra exception handler,
+        zero extra round-trips."""
+        try:
+            return self._router.infer(model, *inputs)
+        except RuntimeError as e:
+            with self._lock:
+                registered = model in self._registry
+            if "no model" not in str(e) or not registered:
+                raise
+        self._record(ControlDecision(
+            action="fault_in", ts=time.time(),
+            reason=f"cold model {model!r} demanded; loading fleet-wide"))
+        self.load_model(model)
+        if self.warm_models > 0:
+            # the demanded model is exempt from its own fault-in sweep —
+            # evicting it again before the retry would livelock
+            self._evict_over_capacity(self._router.health(),
+                                      protect=frozenset((model,)))
+        return self._router.infer(model, *inputs)
+
+    def _evict_over_capacity(self, healths: dict[str, dict],
+                             protect: frozenset[str] = frozenset()
+                             ) -> int:
+        """Per replica: unload least-recently-used unpinned models past
+        the warm-tier cap (data from the health ``models`` section). A
+        model busy in a replica's batcher is skipped this round — the
+        typed refusal is the point, eviction retries next tick."""
+        evicted = 0
+        with self._lock:
+            pinned = {n for n, s in self._registry.items() if s["warm"]}
+        pinned |= protect
+        cap = self.warm_models
+        for ep, doc in healths.items():
+            models = doc.get("models") if isinstance(doc, dict) else None
+            if not models or doc.get("status") != "ok":
+                continue
+            over = len(models) - cap
+            if over <= 0:
+                continue
+            lru = sorted((n for n in models if n not in pinned),
+                         key=lambda n: models[n].get("last_used_ts", 0.0))
+            for name in lru[:over]:
+                try:
+                    if self._client_for(ep).unload_model(name):
+                        evicted += 1
+                        stat_add("control/model_evictions")
+                        self._record(ControlDecision(
+                            action="evict", endpoint=ep, ts=time.time(),
+                            reason=f"warm tier over capacity ({len(models)}"
+                                   f" resident > {cap}); LRU {name!r} "
+                                   f"idle {models[name].get('idle_s', 0):.1f}s"))
+                except ModelBusyError:
+                    continue             # in-flight work wins; next tick
+                except (ConnectionError, RuntimeError, OSError):
+                    continue
+        return evicted
+
+    # -- fleet views -------------------------------------------------------
+    @property
+    def router(self) -> RoutedClient:
+        """The routed client fronting the managed fleet (share it with
+        application traffic — the controller reads the same membership
+        it steers)."""
+        return self._router
+
+    def replicas(self) -> list[dict]:
+        """Router membership annotated with who manages each replica."""
+        with self._lock:
+            managed = set(self._managed)
+        return [dict(m, managed=m["endpoint"] in managed)
+                for m in self._router.members()]
+
+    def decisions(self) -> list[dict]:
+        """The decision ring buffer, oldest first — every scale/evict/
+        replace event with the signals it was computed from."""
+        with self._lock:
+            return [d.as_dict() for d in self._decisions]
+
+    def _record(self, d: ControlDecision) -> None:
+        with self._lock:
+            self._decisions.append(d)
+        _log.info("control: %s %s (%s)", d.action,
+                  d.endpoint or "", d.reason)
+
+    def _client_for(self, ep: str) -> InferenceClient:
+        r = self._router._replica_for(ep)
+        if r is None:
+            raise ConnectionError(f"{ep} is not a member")
+        return self._router._client(r)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingController":
+        """Spawn up to ``min_replicas`` (counting adopted endpoints) and
+        start the reconcile loop (``interval_s > 0``)."""
+        while len(self._router.endpoints()) < self.min_replicas:
+            if self._scale_up("bootstrap to min_replicas",
+                              {}).action != "scale_up":
+                break
+        with self._lock:
+            self._last_scale = 0.0   # bootstrap is not a reactive scale
+            #                          event; it must not arm the cooldown
+        if self.interval_s > 0 and self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="serving-control")
+            self._thread.start()
+        return self
+
+    def close(self, stop_replicas: bool = True) -> None:
+        """Stop the loop; optionally drain-stop every managed replica
+        (adopted endpoints are never touched)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self.interval_s * 2, 2.0))
+        if stop_replicas:
+            with self._lock:
+                eps = list(self._managed)
+                self._managed.clear()
+            for ep in eps:
+                try:
+                    self._router.remove_endpoint(ep)
+                    self._spawner.stop(ep, drain_s=min(self.drain_s, 2.0))
+                except (ConnectionError, RuntimeError, OSError):
+                    pass
+        if self._own_router:
+            self._router.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:            # pragma: no cover - never dies
+                stat_add("control/tick_errors")
+
+    # -- the reconcile tick ------------------------------------------------
+    def tick(self) -> ControlDecision:
+        """One reconcile pass: collect fleet health, self-heal dead
+        managed replicas, enforce the warm tier, and make (at most) one
+        scale decision. Returns the decision (action ``"hold"`` when
+        nothing fired); everything except holds also lands in
+        :meth:`decisions`."""
+        with self._lock:
+            if self._closed:
+                return ControlDecision("hold", "controller closed",
+                                       ts=time.time())
+        with self._lock, _trace.span("control/tick"):
+            stat_add("control/ticks")
+            healths = self._router.health(stats_prefix="gen/ttft_s",
+                                          histograms=True)
+            self._heal(healths)
+            if self.warm_models > 0:
+                self._evict_over_capacity(healths)
+            signals = self._signals(healths)
+            stat_set("control/replicas", signals["replicas"])
+            return self._decide(signals)
+
+    def _heal(self, healths: dict[str, dict]) -> None:
+        """Replace managed replicas that stay unreachable: remove from
+        routing, best-effort stop, spawn a substitute. ``breach_ticks``
+        consecutive failed probes gate it — one dropped probe is not a
+        death certificate."""
+        with self._lock:
+            managed = set(self._managed)
+        for ep in managed:
+            doc = healths.get(ep)
+            dead = doc is None or doc.get("status") == "unreachable"
+            n = self._unreachable.get(ep, 0) + 1 if dead else 0
+            self._unreachable[ep] = n
+            if n < max(self.breach_ticks, 1):
+                continue
+            self._unreachable.pop(ep, None)
+            self._record(ControlDecision(
+                action="replace", endpoint=ep, ts=time.time(),
+                reason=f"unreachable for {n} consecutive ticks: "
+                       f"{(doc or {}).get('error', 'no probe')}"))
+            stat_add("control/replaced")
+            self._router.remove_endpoint(ep)
+            with self._lock:
+                self._managed.discard(ep)
+            try:
+                self._spawner.stop(ep, drain_s=0.0)
+            except (ConnectionError, RuntimeError, OSError):
+                pass
+            self._scale_up("replacing dead replica", {})
+
+    def _signals(self, healths: dict[str, dict]) -> dict[str, Any]:
+        """Fold per-replica health into the fleet signal snapshot the
+        scale decision reads (cordoned members are draining capacity —
+        excluded)."""
+        cordoned = {m["endpoint"] for m in self._router.members()
+                    if m["cordoned"]}
+        live = {ep: doc for ep, doc in healths.items()
+                if isinstance(doc, dict) and doc.get("status") == "ok"
+                and ep not in cordoned}
+        n = len(live)
+        inflight = sum(int(d.get("inflight", 0)) for d in live.values())
+        slots = active = queued = 0
+        for d in live.values():
+            for g in (d.get("generators") or {}).values():
+                slots += int(g.get("slots", 0))
+                active += int(g.get("active", 0))
+                queued += int(g.get("queued", 0))
+        deltas = []
+        for ep, d in live.items():
+            cur = (d.get("histograms") or {}).get("gen/ttft_s")
+            delta = _hist_delta(self._ttft_prev.get(ep), cur)
+            if cur:
+                self._ttft_prev[ep] = cur
+            if delta is not None:
+                deltas.append(delta)
+        ttft_p99 = (merge_histograms(deltas)["p99"] if deltas else None)
+        return {
+            "replicas": n,
+            "managed": len(self._managed),
+            "members": len(healths),
+            "inflight_mean": inflight / n if n else 0.0,
+            "slots": slots, "active": active, "queued": queued,
+            "occupancy": active / slots if slots else 0.0,
+            "queue_per_replica": queued / n if n else 0.0,
+            "ttft_p99_s": ttft_p99,
+        }
+
+    def _pressure(self, s: dict[str, Any]) -> list[str]:
+        """Scale-up pressure reasons (empty = none). Each enabled signal
+        contributes independently; the decision log keeps the winning
+        reasons verbatim."""
+        out = []
+        if (self.queue_high > 0
+                and s["queue_per_replica"] >= self.queue_high):
+            out.append(f"queued generations "
+                       f"{s['queue_per_replica']:.2f}/replica >= "
+                       f"{self.queue_high:g}")
+        if s["slots"] and s["occupancy"] >= self.occupancy_high:
+            out.append(f"slot occupancy {s['occupancy']:.2f} >= "
+                       f"{self.occupancy_high:g}")
+        if (self.target_ttft_s > 0 and s["ttft_p99_s"] is not None
+                and s["ttft_p99_s"] > self.target_ttft_s):
+            out.append(f"TTFT p99 {s['ttft_p99_s']:.3f}s > SLO "
+                       f"{self.target_ttft_s:g}s")
+        if (self.inflight_high > 0
+                and s["inflight_mean"] >= self.inflight_high):
+            out.append(f"inflight {s['inflight_mean']:.2f}/replica >= "
+                       f"{self.inflight_high:g}")
+        return out
+
+    def _is_idle(self, s: dict[str, Any]) -> bool:
+        if self._pressure(s):
+            return False
+        if s["queued"] > 0:
+            return False
+        if s["slots"] and s["occupancy"] > self.occupancy_low:
+            return False
+        if (self.inflight_high > 0 and s["inflight_mean"]
+                > self.inflight_high * self.occupancy_low):
+            return False
+        return True
+
+    def _decide(self, signals: dict[str, Any]) -> ControlDecision:
+        now = time.monotonic()
+        pressure = self._pressure(signals)
+        if pressure:
+            self._breach += 1
+            self._idle = 0
+        elif self._is_idle(signals):
+            self._idle += 1
+            self._breach = 0
+        else:
+            self._breach = 0
+            self._idle = 0
+        signals = dict(signals, breach_ticks=self._breach,
+                       idle_ticks=self._idle)
+        if self.max_replicas <= 0:       # autoscaling off (flag default)
+            return ControlDecision("hold", "autoscaling disabled "
+                                   "(control_max_replicas=0)",
+                                   ts=time.time(), signals=signals)
+        cooling = (self._last_scale
+                   and now - self._last_scale < self.cooldown_s)
+        if pressure and self._breach >= self.breach_ticks:
+            reason = "; ".join(pressure)
+            if cooling:
+                d = ControlDecision("hold", f"cooldown holds scale-up "
+                                    f"({reason})", ts=time.time(),
+                                    signals=signals)
+                self._record(d)
+                return d
+            if signals["replicas"] >= self.max_replicas:
+                return ControlDecision(
+                    "hold", f"at max_replicas={self.max_replicas} "
+                    f"({reason})", ts=time.time(), signals=signals)
+            self._breach = 0
+            return self._scale_up(reason, signals)
+        if self._idle >= self.idle_ticks and not cooling:
+            with self._lock:
+                candidates = list(self._managed)
+            if signals["replicas"] > self.min_replicas and candidates:
+                self._idle = 0
+                return self.scale_down(
+                    reason=f"idle {signals['idle_ticks']} ticks "
+                    f"(occupancy {signals['occupancy']:.2f} <= "
+                    f"{self.occupancy_low:g}, queue 0)",
+                    signals=signals)
+        return ControlDecision("hold", "no sustained pressure or idle",
+                               ts=time.time(), signals=signals)
+
+    # -- scale events ------------------------------------------------------
+    def _spawn_model_set(self) -> list[tuple[str, str]]:
+        """Models a fresh replica starts with: every warm-pinned one,
+        then registry order up to the warm-tier cap (all of them when
+        multiplexing is off)."""
+        with self._lock:
+            warm = [(n, s["path"]) for n, s in self._registry.items()
+                    if s["warm"]]
+            cold = [(n, s["path"]) for n, s in self._registry.items()
+                    if not s["warm"]]
+        if self.warm_models <= 0:
+            return warm + cold
+        return (warm + cold)[:max(self.warm_models, len(warm))]
+
+    def _scale_up(self, reason: str,
+                  signals: dict[str, Any]) -> ControlDecision:
+        with _trace.span("control/scale_up"):
+            try:
+                ep = self._spawner.spawn()
+            except Exception as e:
+                stat_add("control/spawn_failures")
+                d = ControlDecision(
+                    "spawn_failed", ts=time.time(), signals=signals,
+                    reason=f"{reason}; spawn raised "
+                           f"{type(e).__name__}: {e}")
+                self._record(d)
+                return d
+            try:                 # registry models before traffic arrives
+                with InferenceClient(ep, retries=1) as c:
+                    for name, path in self._spawn_model_set():
+                        c.load_model(name, path)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                _log.warning("control: model preload on %s failed: %s",
+                             ep, e)
+            self._router.add_endpoint(ep)
+            with self._lock:
+                self._managed.add(ep)
+                self._last_scale = time.monotonic()
+            stat_add("control/scale_ups")
+            d = ControlDecision("scale_up", endpoint=ep, reason=reason,
+                                ts=time.time(), signals=signals)
+            self._record(d)
+            return d
+
+    def _pick_victim(self) -> str | None:
+        """Least-loaded managed, non-cordoned replica (in-flight + active
+        generations from a fresh health probe; unreachable counts as
+        already-empty)."""
+        with self._lock:
+            managed = set(self._managed)
+        cordoned = {m["endpoint"] for m in self._router.members()
+                    if m["cordoned"]}
+        best, best_load = None, None
+        for ep in sorted(managed - cordoned):
+            try:
+                doc = self._client_for(ep).health(stats_prefix="\x00none")
+                load = int(doc.get("inflight", 0)) + sum(
+                    int(g.get("active", 0)) + int(g.get("queued", 0))
+                    for g in (doc.get("generators") or {}).values())
+            except (ConnectionError, RuntimeError, OSError):
+                load = 0
+            if best_load is None or load < best_load:
+                best, best_load = ep, load
+        return best
+
+    def scale_down(self, victim: str | None = None, *,
+                   reason: str = "manual",
+                   signals: dict[str, Any] | None = None,
+                   drain_s: float | None = None) -> ControlDecision:
+        """Sticky-drain one replica out of the fleet: cordon (new picks
+        stop; in-flight streams keep their replica), wait for its work —
+        including every undelivered generation — to finish, then stop
+        and remove it. Returns the decision; ``clean=False`` means the
+        drain deadline forced the stop (``control/drain_forced``)."""
+        victim = victim or self._pick_victim()
+        if victim is None:
+            d = ControlDecision("hold", f"{reason}; no managed replica "
+                                "to scale down", ts=time.time(),
+                                signals=signals or {})
+            self._record(d)
+            return d
+        deadline = self.drain_s if drain_s is None else float(drain_s)
+        with _trace.span("control/drain", endpoint=victim):
+            t0 = time.monotonic()
+            self._router.cordon(victim)
+            clean = self._await_drained(victim, deadline)
+            took = time.monotonic() - t0
+            observe("control/drain_s", took)
+            if not clean:
+                stat_add("control/drain_forced")
+            try:
+                self._spawner.stop(victim,
+                                   drain_s=max(deadline - took, 0.5))
+            except (ConnectionError, RuntimeError, OSError) as e:
+                _log.warning("control: stop of %s failed: %s", victim, e)
+            self._router.remove_endpoint(victim)
+            with self._lock:
+                self._managed.discard(victim)
+                self._last_scale = time.monotonic()
+            stat_add("control/scale_downs")
+            d = ControlDecision(
+                "scale_down", endpoint=victim, clean=clean,
+                ts=time.time(), signals=signals or {},
+                reason=f"{reason}; drained in {took:.2f}s"
+                       + ("" if clean else
+                          f" (FORCED at deadline {deadline:g}s)"))
+            self._record(d)
+            return d
+
+    def _await_drained(self, ep: str, deadline: float) -> bool:
+        """True once the cordoned replica is provably empty: zero
+        in-flight wire requests AND zero undelivered generations
+        (running, queued, or finished-but-final-poll-unanswered), seen
+        twice in a row — a streaming client between polls must not be
+        mistaken for done."""
+        end = time.monotonic() + max(deadline, 0.0)
+        consecutive = 0
+        while time.monotonic() < end:
+            try:
+                doc = self._client_for(ep).health(stats_prefix="\x00none")
+            except (ConnectionError, RuntimeError, OSError):
+                return True              # already gone
+            busy = int(doc.get("inflight", 0)) + sum(
+                int(g.get("undelivered", g.get("active", 0)))
+                for g in (doc.get("generators") or {}).values())
+            if busy == 0:
+                consecutive += 1
+                if consecutive >= 2:
+                    return True
+            else:
+                consecutive = 0
+            time.sleep(0.05)
+        return False
+
+    def scale_to(self, n: int, reason: str = "manual") -> None:
+        """Operator override to an absolute managed-fleet size — same
+        spawn/sticky-drain paths as the automatic decisions, no
+        hysteresis or cooldown."""
+        n = max(int(n), 0)
+        while len(self._router.endpoints()) < n:
+            if self._scale_up(reason, {}).action != "scale_up":
+                break
+        while len(self._router.endpoints()) > n:
+            if self.scale_down(reason=reason).action != "scale_down":
+                break

@@ -54,7 +54,8 @@ once per emitted token — but follow a different key schedule than solo
 
 Observability: ``gen/slots_active`` / ``gen/queue_depth`` /
 ``gen/pages_free`` gauges, ``gen/prefill_s`` / ``gen/prefill_chunk_s`` /
-``gen/decode_step_s`` histograms, ``gen/tokens`` / ``gen/evictions`` /
+``gen/decode_step_s`` / ``gen/ttft_s`` (enqueue → first token — the
+autoscaling SLO signal) histograms, ``gen/tokens`` / ``gen/evictions`` /
 ``gen/prefix_hits`` / ``gen/prefix_tokens_saved`` /
 ``gen/prefix_evictions`` counters, ``gen/prefill`` +
 ``gen/prefill_chunk`` + ``gen/decode_step`` spans, and slot + page-pool
@@ -99,7 +100,7 @@ class Generation:
                  "top_k", "top_p", "eos_token_id", "seed", "tokens",
                  "done", "error", "slot", "created", "last_poll",
                  "cancelled", "pages", "shared", "prefilling",
-                 "prefill_pos", "prefill_t0")
+                 "prefill_pos", "prefill_t0", "delivered")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -119,6 +120,9 @@ class Generation:
         self.created = time.monotonic()
         self.last_poll = self.created
         self.cancelled = False
+        # a poll response carried done=True with every token: the client
+        # has everything — the signal a sticky drain waits on
+        self.delivered = False
         # paged mode: mapped physical pages (shared prefix first), how
         # many of them are prefix-cache hits, and chunked-prefill cursor
         self.pages: list[int] = []
@@ -648,6 +652,12 @@ class GenerationEngine:
                     break
                 self._cond.wait(remaining)
                 gen.last_poll = time.monotonic()
+            if gen.done:
+                # this response tells the caller the generation finished
+                # and hands over every token past ``start`` — fully
+                # delivered (the condition a sticky drain waits on
+                # before a replica may stop)
+                gen.delivered = True
             return {"tokens": list(gen.tokens[start:]), "done": gen.done,
                     "error": gen.error,
                     "queued": gen.slot is None and not gen.done}
@@ -684,6 +694,13 @@ class GenerationEngine:
                    "free": self.slots - active,
                    "queued": len(self._queue),
                    "generations": len(self._gens),
+                   # running, queued, or finished-but-not-yet-polled-to-
+                   # done: the work a sticky drain must wait out (done
+                   # generations whose final poll already went out do
+                   # NOT count — the client has everything)
+                   "undelivered": sum(
+                       1 for g in self._gens.values()
+                       if not (g.done and g.delivered)),
                    "max_len": self.max_len,
                    "broken": self._broken,
                    "paged": self._paged}
@@ -958,6 +975,10 @@ class GenerationEngine:
                 if self._prefix is not None:
                     self._prefix.insert(gen.prompt, gen.pages, self._pool)
                 gen.tokens.append(tok0)
+                # TTFT = enqueue -> first token (queue wait included):
+                # the latency an interactive SLO is actually about, and
+                # the signal the serving control plane autoscales on
+                observe("gen/ttft_s", time.monotonic() - gen.created)
                 stat_add("gen/tokens")
                 if ((gen.eos_token_id is not None
                      and tok0 == gen.eos_token_id)
@@ -991,6 +1012,7 @@ class GenerationEngine:
             if self._slot_gen[slot] is not gen:   # cancelled mid-prefill
                 return
             gen.tokens.append(tok0)
+            observe("gen/ttft_s", time.monotonic() - gen.created)
             stat_add("gen/tokens")
             if ((gen.eos_token_id is not None
                  and tok0 == gen.eos_token_id)

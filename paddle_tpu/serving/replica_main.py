@@ -1,0 +1,66 @@
+"""Subprocess replica entry point for the serving control plane.
+
+``python -m paddle_tpu.serving.replica_main name=/path/to/artifact ...``
+starts an :class:`~paddle_tpu.io.serving.InferenceServer` on a free
+port with the given saved-model artifacts, prints ``ENDPOINT host:port``
+on stdout (the line :class:`~paddle_tpu.serving.control.
+SubprocessSpawner` blocks on), and serves until the wire ``stop`` op or
+SIGTERM — both drain gracefully (``FLAGS_wire_drain_s``). One replica =
+one OS process: its own GIL and XLA runtime, killable with SIGKILL,
+which is exactly what the chaos harness wants a dying replica to look
+like.
+
+``FLAGS_*`` environment variables apply as usual (the flag registry
+reads them at import), so a spawner can configure batching, caps, and
+timeouts per fleet through the child environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("models", nargs="*", metavar="name=path",
+                    help="model artifacts to serve (save_inference_model "
+                         "layout)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (the default — the spawner "
+                         "reads the ENDPOINT line)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.io.serving import InferenceServer
+
+    models: dict[str, str] = {}
+    for spec in args.models:
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            ap.error(f"bad model spec {spec!r}; expected name=path")
+        models[name] = path
+
+    srv = InferenceServer(models, host=args.host, port=args.port).start()
+    print(f"ENDPOINT {srv.endpoint}", flush=True)
+
+    def _term(signum, frame):        # scheduler preemption: drain, exit
+        srv.stop(drain_s=float(flag("wire_drain_s")))
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    # serve until stopped (wire stop op, or the signal handler above);
+    # _thread goes back to None once the accept loop is shut down
+    while srv._thread is not None:
+        time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,8 +26,14 @@ spreads idempotent requests across them:
   Each failing replica is tried at most once per request; when every
   member has failed, the last error surfaces.
 
+Sticky drain (the control plane's scale-down primitive): ``cordon``
+excludes a replica from every new pick — routed AND session — while its
+pooled connections stay open, so in-flight streams finish on the replica
+that holds their state; ``remove_endpoint`` then finalizes.
+
 Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
-``serving/router/marked_down``, ``serving/router/recovered``.
+``serving/router/marked_down``, ``serving/router/recovered``,
+``serving/router/cordoned``, ``serving/router/uncordoned``.
 """
 
 from __future__ import annotations
@@ -69,10 +75,16 @@ class ReplicaState:
     a connection lock, so a single shared connection could never present
     concurrent same-model requests to the replica — exactly what the
     server-side batcher coalesces. N pooled connections let one routed
-    client keep N requests in flight per replica."""
+    client keep N requests in flight per replica.
+
+    ``cordoned`` is the sticky-drain state: a cordoned replica receives
+    no NEW picks (routed or session) but keeps its pooled connections
+    open, so in-flight work — a streaming generation's polls especially
+    — runs to completion. Health probes keep running; ``cordon`` is
+    orthogonal to ``healthy`` and survives recovery."""
 
     __slots__ = ("endpoint", "clients", "healthy", "last_error", "probes",
-                 "failures")
+                 "failures", "cordoned")
 
     def __init__(self, endpoint: str):
         self.endpoint = endpoint
@@ -81,6 +93,7 @@ class ReplicaState:
         self.last_error: str | None = None
         self.probes = 0
         self.failures = 0
+        self.cordoned = False
 
     @property
     def inflight(self) -> int:
@@ -148,15 +161,37 @@ class RoutedClient:
         for r in drop:
             self._close_clients(r)
 
+    def cordon(self, endpoint: str) -> None:
+        """Stop routing NEW requests to ``endpoint`` while keeping its
+        pooled connections (and therefore all in-flight work, including
+        streaming generations' polls) alive — the first half of a
+        sticky-drain scale-down. Unknown endpoints are ignored. The
+        replica remains a member (probed, visible in :meth:`members`)
+        until :meth:`remove_endpoint` finalizes the removal."""
+        with self._lock:
+            for r in self._replicas:
+                if r.endpoint == endpoint and not r.cordoned:
+                    r.cordoned = True
+                    stat_add("serving/router/cordoned")
+
+    def uncordon(self, endpoint: str) -> None:
+        """Re-admit a cordoned replica to routing (a cancelled drain)."""
+        with self._lock:
+            for r in self._replicas:
+                if r.endpoint == endpoint and r.cordoned:
+                    r.cordoned = False
+                    stat_add("serving/router/uncordoned")
+
     def endpoints(self) -> list[str]:
         with self._lock:
             return [r.endpoint for r in self._replicas]
 
     def members(self) -> list[dict]:
         """Routing snapshot: one dict per replica (endpoint, healthy,
-        inflight, failures, last_error)."""
+        cordoned, inflight, failures, last_error)."""
         with self._lock:
             return [{"endpoint": r.endpoint, "healthy": r.healthy,
+                     "cordoned": r.cordoned,
                      "inflight": r.inflight, "failures": r.failures,
                      "last_error": r.last_error}
                     for r in self._replicas]
@@ -208,10 +243,12 @@ class RoutedClient:
               ) -> ReplicaState | None:
         """Healthy replica with the fewest in-flight requests (ties:
         round-robin). ``any_health`` is the last resort — membership may
-        be stale and a 'down' replica may be back."""
+        be stale and a 'down' replica may be back. Cordoned replicas
+        are NEVER picked, not even as the last resort: a drain that
+        leaked new work would never converge."""
         with self._lock:
             pool = [r for r in self._replicas
-                    if r.endpoint not in exclude
+                    if r.endpoint not in exclude and not r.cordoned
                     and (any_health or r.healthy)]
             if not pool:
                 return None
@@ -313,7 +350,8 @@ class RoutedClient:
 
     def _healthy_endpoints(self) -> list[str]:
         with self._lock:
-            return sorted(r.endpoint for r in self._replicas if r.healthy)
+            return sorted(r.endpoint for r in self._replicas
+                          if r.healthy and not r.cordoned)
 
     # -- the routed serving surface ---------------------------------------
     def infer(self, model: str, *inputs) -> list[np.ndarray]:
@@ -324,14 +362,16 @@ class RoutedClient:
 
     def load_model(self, name: str, path: str,
                    broadcast: bool = True) -> None:
-        """Hot-load on every healthy replica (``broadcast=True``,
-        default — replicas should serve the same model set) or on one."""
+        """Hot-load on every healthy non-cordoned replica
+        (``broadcast=True``, default — replicas should serve the same
+        model set) or on one (a draining replica's model set no longer
+        matters)."""
         if not broadcast:
             self._routed(lambda c: c.load_model(name, path))
             return
         errors = []
         for r in list(self._replicas):
-            if not r.healthy:
+            if not r.healthy or r.cordoned:
                 continue
             try:
                 self._client(r).load_model(name, path)
@@ -341,15 +381,47 @@ class RoutedClient:
             raise RuntimeError("load_model failed on: " +
                                "; ".join(errors))
 
-    def health(self) -> dict[str, dict]:
+    def unload_model(self, name: str,
+                     broadcast: bool = True) -> dict[str, bool]:
+        """Drop ``name`` fleet-wide (the control plane's cold-tier
+        transition). Returns endpoint -> unloaded (False where the model
+        was never resident — unload is idempotent per replica). A
+        replica refusing with the typed
+        :class:`~paddle_tpu.io.serving.ModelBusyError` (requests still
+        in its batcher) surfaces in the aggregate error — nothing hangs,
+        the caller retries after the queue drains."""
+        if not broadcast:
+            return {"": bool(self._routed(
+                lambda c: c.unload_model(name)))}
+        out: dict[str, bool] = {}
+        errors = []
+        for r in list(self._replicas):
+            if not r.healthy or r.cordoned:
+                continue
+            try:
+                out[r.endpoint] = self._client(r).unload_model(name)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                errors.append(f"{r.endpoint}: {type(e).__name__}: {e}")
+        if errors:
+            raise RuntimeError("unload_model failed on: " +
+                               "; ".join(errors))
+        return out
+
+    def health(self, stats_prefix: str | None = None,
+               histograms: bool = False) -> dict[str, dict]:
         """endpoint -> server health snapshot (unreachable replicas map
-        to ``{"status": "unreachable", ...}``)."""
+        to ``{"status": "unreachable", ...}``); covers cordoned members
+        too — the control plane watches a draining victim's in-flight
+        work through exactly this. ``stats_prefix``/``histograms`` pass
+        through to each server's health op (raw-bucket histograms merge
+        fleet-wide via ``monitor.merge_histograms``)."""
         out = {}
         for r in list(self._replicas):
             ok, err = self._probe_one(r.endpoint)
             if ok:
                 try:
-                    out[r.endpoint] = self._client(r).health()
+                    out[r.endpoint] = self._client(r).health(
+                        stats_prefix=stats_prefix, histograms=histograms)
                     continue
                 except (ConnectionError, RuntimeError, OSError) as e:
                     err = f"{type(e).__name__}: {e}"

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Serving control-plane benchmark: SLO-driven autoscaling under a
+ramped generation load, and warm/cold multi-model multiplexing.
+
+Two scenarios, both CPU, both end-to-end over the real wire:
+
+1. **autoscale**: a fleet starts at ONE replica (2 generation slots,
+   paced decode). The load ramps from 2 concurrent token streams to
+   ``HIGH_STREAMS`` in repeated waves. The static fleet stays at one
+   replica; the controlled fleet runs a ``ServingController``
+   (queue-pressure + TTFT signals, hysteresis + cooldown) that scales up
+   to three. Measured: client-side TTFT (``generate()`` call → first
+   token) per wave. The acceptance floor: in the LAST high-load wave
+   (steady state after convergence) the autoscaled fleet meets the TTFT
+   SLO that the static fleet violates, with >= 1 scale-up; when the
+   ramp ends, the idle fleet scales back down through a sticky drain
+   with a live pinned stream riding through it — zero lost tokens, zero
+   GenerationFailed, drain clean.
+2. **multiplex**: one replica, warm-tier capacity 2, FOUR registered
+   models. Round-robin inference across all four: every model stays
+   servable (cold faults ride ``load_model``; LRU eviction keeps
+   residency <= 2), outputs exactly match per-model direct Predictor
+   runs.
+
+Writes ``BENCH_control.json`` (repo root by default) with per-wave TTFT
+quantiles for both fleets, the controller's decision log (every scale
+event explainable), and the multiplex residency trace.
+
+Usage: ``JAX_PLATFORMS=cpu python tools/bench_control.py [-o OUT.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu                                      # noqa: E402
+from paddle_tpu import io, nn                          # noqa: E402
+from paddle_tpu.core import monitor                    # noqa: E402
+from paddle_tpu.serving import (                       # noqa: E402
+    InProcSpawner, RoutedClient, ServingController,
+)
+
+VOCAB = 96
+SLOTS = 2               # generation slots per replica
+STEP_WAIT_S = 0.02      # paced decode: queueing is deterministic on CPU
+NEW_TOKENS = 16
+HIGH_STREAMS = 6
+WAVES_HIGH = 4
+TTFT_SLO_S = 0.55       # what the autoscaled fleet must meet at steady
+#                         state (static: ~2 full generations of queue
+#                         wait at HIGH_STREAMS over one replica's slots)
+MAX_REPLICAS = 3
+
+
+def _model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine_factory(model):
+    def factory():
+        srv = io.InferenceServer().start()
+        srv.add_generator("llm", model, slots=SLOTS, max_len=32,
+                          step_wait_s=STEP_WAIT_S)
+        # pre-warm the engine's compiles so a freshly spawned replica
+        # joins at serving speed (real fleets ship warmed images too)
+        eng = srv._generators["llm"]
+        gid = eng.start(np.arange(1, 7, dtype=np.int32), 1)
+        while not eng.poll(gid, start=0, wait_s=1.0)["done"]:
+            pass
+        return srv
+    return factory
+
+
+def _quantiles(vals: list[float]) -> dict:
+    if not vals:
+        return {"n": 0}
+    v = sorted(vals)
+    return {"n": len(v),
+            "p50": round(v[len(v) // 2], 4),
+            "p99": round(v[min(len(v) - 1, int(len(v) * 0.99))], 4),
+            "max": round(v[-1], 4)}
+
+
+def _wave(router: RoutedClient, prompts, n_streams: int,
+          errors: list) -> list[float]:
+    """One wave: n concurrent streams; returns each stream's TTFT."""
+    ttfts = [None] * n_streams
+    gate = threading.Barrier(n_streams)
+
+    def worker(i):
+        try:
+            gate.wait()
+            t0 = time.perf_counter()
+            it = router.session(f"wave-{i}-{t0}").generate(
+                "llm", prompts[i % len(prompts)], NEW_TOKENS,
+                poll_wait_s=0.02)
+            next(it)
+            ttfts[i] = time.perf_counter() - t0
+            list(it)                      # run to completion
+        except Exception as e:
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return [t for t in ttfts if t is not None]
+
+
+def run_fleet(model, controlled: bool) -> dict:
+    """The ramp against either a static 1-replica fleet or the
+    controlled fleet. Returns per-wave TTFT quantiles + fleet events."""
+    spawner = InProcSpawner(_engine_factory(model))
+    ctl = ServingController(
+        spawner, interval_s=0.25 if controlled else 0,
+        min_replicas=1, max_replicas=MAX_REPLICAS if controlled else 0,
+        breach_ticks=1, idle_ticks=3, cooldown_s=1.0,
+        queue_high=0.5, target_ttft_s=TTFT_SLO_S, drain_s=20.0)
+    ctl.start()
+    errors: list = []
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, VOCAB, (6,)).astype(np.int32)
+               for _ in range(4)]
+    waves = []
+    result: dict = {"mode": "controlled" if controlled else "static"}
+    try:
+        # low phase: 2 streams — no pressure, fleet must NOT grow
+        waves.append(("low", _quantiles(
+            _wave(ctl.router, prompts, 2, errors))))
+        # high phase: repeated waves; the controller sees the queue
+        # build and scales between waves
+        for w in range(WAVES_HIGH):
+            waves.append((f"high{w}", _quantiles(
+                _wave(ctl.router, prompts, HIGH_STREAMS, errors))))
+        result["replicas_at_peak"] = len(ctl.router.endpoints())
+
+        if controlled:
+            # ramp over: pin a LIVE stream, then let the idle fleet
+            # scale down THROUGH it (the sticky-drain proof point)
+            sess = ctl.router.session("drain-rider")
+            it = sess.generate("llm", prompts[0], NEW_TOKENS,
+                               poll_wait_s=0.05)
+            toks = [next(it)]
+
+            def rider():                  # keeps polling like a real
+                toks.extend(it)           # client while drains happen
+
+            t = threading.Thread(target=rider)
+            t.start()
+            deadline = time.monotonic() + 30
+            while (len(ctl.router.endpoints()) > 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            t.join(timeout=60)
+            result["drain_rider_tokens"] = len(toks)
+            result["replicas_after_idle"] = len(ctl.router.endpoints())
+    finally:
+        ctl.close()
+    result["waves"] = dict(waves)
+    result["errors"] = errors
+    if controlled:
+        decs = ctl.decisions()
+        result["decisions"] = decs
+        result["scale_ups"] = sum(d["action"] == "scale_up" for d in decs)
+        result["scale_downs"] = sum(
+            d["action"] == "scale_down" for d in decs)
+        result["drains_clean"] = all(
+            d["clean"] for d in decs if d["action"] == "scale_down")
+    return result
+
+
+def run_multiplex(tmp: str) -> dict:
+    """Warm capacity 2, four models, one replica: all servable, correct,
+    residency bounded."""
+    paths, refs = {}, {}
+    for i, name in enumerate("abcd"):
+        paddle_tpu.seed(i + 1)
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
+        p = os.path.join(tmp, f"mux_{name}")
+        io.save_inference_model(p, net, [np.zeros((2, 4), np.float32)],
+                                dynamic_batch=True)
+        paths[name] = p
+        refs[name] = io.Predictor(p)
+    monitor.reset_stats("control/")
+    ctl = ServingController(InProcSpawner(io.InferenceServer),
+                            interval_s=0, min_replicas=1, warm_models=2)
+    resident_trace, bad = [], 0
+    try:
+        ctl.start()
+        for n, p in paths.items():
+            ctl.register_model(n, p)
+        x = np.ones((1, 4), np.float32)
+        rounds = 4
+        for _ in range(rounds):
+            for n in paths:
+                y = ctl.infer(n, x)[0]
+                if not np.allclose(y, np.asarray(refs[n].run(x)),
+                                   rtol=1e-5, atol=1e-6):
+                    bad += 1
+            ctl.tick()
+            doc = next(iter(ctl.router.health().values()))
+            resident_trace.append(sorted(doc["models"]))
+    finally:
+        ctl.close()
+    return {
+        "models_registered": len(paths),
+        "warm_capacity": 2,
+        "rounds": rounds,
+        "bad_results": bad,
+        "resident_trace": resident_trace,
+        "max_resident": max(len(r) for r in resident_trace),
+        "evictions": int(monitor.get_stat("control/model_evictions")),
+        "fault_ins": int(monitor.get_stat("control/model_faults")),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_control.json"))
+    args = ap.parse_args()
+
+    model = _model()
+    results: dict = {
+        "config": {"slots_per_replica": SLOTS, "step_wait_s": STEP_WAIT_S,
+                   "new_tokens": NEW_TOKENS, "high_streams": HIGH_STREAMS,
+                   "waves_high": WAVES_HIGH, "ttft_slo_s": TTFT_SLO_S,
+                   "max_replicas": MAX_REPLICAS},
+    }
+    print("== static fleet (1 replica) ==")
+    static = run_fleet(model, controlled=False)
+    print(json.dumps(static["waves"], indent=2))
+    print("== controlled fleet (autoscaling 1..3) ==")
+    controlled = run_fleet(model, controlled=True)
+    print(json.dumps(controlled["waves"], indent=2))
+    results["static"] = static
+    results["controlled"] = controlled
+
+    last = f"high{WAVES_HIGH - 1}"
+    static_p99 = static["waves"][last]["p99"]
+    auto_p99 = controlled["waves"][last]["p99"]
+    results["autoscale_parsed"] = {
+        "metric": "steady-state TTFT p99 under the high-load ramp, "
+                  "autoscaled vs static single replica",
+        "static_p99_s": static_p99,
+        "autoscaled_p99_s": auto_p99,
+        "speedup": round(static_p99 / auto_p99, 2) if auto_p99 else None,
+    }
+    autoscale_ok = (
+        auto_p99 <= TTFT_SLO_S < static_p99
+        and controlled["scale_ups"] >= 1
+        and controlled["scale_downs"] >= 1
+        and controlled["drains_clean"]
+        and controlled["replicas_after_idle"] == 1
+        and controlled["drain_rider_tokens"] == NEW_TOKENS
+        and not static["errors"] and not controlled["errors"])
+    results["autoscale_ok"] = autoscale_ok
+
+    print("== multiplex (4 models, warm capacity 2, 1 replica) ==")
+    with tempfile.TemporaryDirectory(prefix="ptpu_bench_ctl_") as tmp:
+        mux = run_multiplex(tmp)
+    print(json.dumps({k: v for k, v in mux.items()
+                      if k != "resident_trace"}, indent=2))
+    results["multiplex"] = mux
+    multiplex_ok = (mux["bad_results"] == 0 and mux["max_resident"] <= 2
+                    and mux["evictions"] >= 2)
+    results["multiplex_ok"] = multiplex_ok
+
+    results["parsed"] = {
+        "metric": "autoscaled steady-state TTFT p99 vs TTFT SLO "
+                  "(static fleet violates it); N>warm-tier models "
+                  "servable via LRU eviction",
+        "value": results["autoscale_parsed"]["speedup"],
+        "unit": "x",
+    }
+    results["ok"] = bool(autoscale_ok and multiplex_ok)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results["parsed"], indent=2))
+    print(f"wrote {args.out}; ok={results['ok']}")
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,15 @@ Scenarios (all CPU-only, single process):
    the survivors finish and the prefix cache drains, the pool is back
    to full), survivors stay byte-identical to solo ``generate()``, and
    a prefix-sharing readmit lands in the reclaimed pages.
+9. **control-plane**: (a) a subprocess replica is SIGKILLed right after
+   joining a controller-driven scale-up, under live routed traffic —
+   zero idempotent requests are lost and the controller's reconcile
+   replaces the dead replica (typed ``replace`` decision); (b) a
+   scale-down victim carrying a LIVE session-pinned generation is
+   sticky-drained — the stream finishes byte-identical to solo
+   ``generate()`` on the cordoned replica, zero ``GenerationFailed``,
+   the drain is clean (not deadline-forced), and only then does the
+   replica stop.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -100,6 +109,17 @@ def check_defaults_off() -> None:
     mq = get_flags(["serving_batch_min_queue"])
     check("defaults/batch_watermark_sane",
           mq["serving_batch_min_queue"] >= 0, str(mq))
+    cpl = get_flags(["control_max_replicas", "control_warm_models",
+                     "control_interval_s", "control_cooldown_s",
+                     "control_drain_s", "control_breach_ticks",
+                     "control_idle_ticks"])
+    check("defaults/control_plane_off",
+          cpl["control_max_replicas"] == 0        # autoscaling off
+          and cpl["control_warm_models"] == 0     # eviction off
+          and cpl["control_drain_s"] > 0 and cpl["control_cooldown_s"] > 0
+          and cpl["control_breach_ticks"] >= 1
+          and cpl["control_idle_ticks"] >= cpl["control_breach_ticks"],
+          str(cpl))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -605,6 +625,141 @@ def scenario_gen_paged(tmp: str) -> None:
         srv.stop()     # closes the engine too
 
 
+def scenario_control_plane(tmp: str) -> None:
+    """(a) SIGKILL a subprocess replica right after a controller
+    scale-up, under routed traffic: zero lost requests, reconcile
+    replaces it. (b) Sticky-drain a scale-down victim with a LIVE
+    pinned generation: byte-identical stream, zero GenerationFailed,
+    clean (unforced) drain."""
+    import threading
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import (
+        InProcSpawner, ServingController, SubprocessSpawner,
+    )
+
+    # -- (a) replica killed mid-scale-up (subprocess, real SIGKILL) -----
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = os.path.join(tmp, "ctl_mlp")
+    io.save_inference_model(path, net, [np.zeros((2, 4), np.float32)],
+                            dynamic_batch=True)
+    ref = io.Predictor(path)
+    monitor.reset_stats("control/")
+    spawner = SubprocessSpawner({"m": path})
+    ctl = ServingController(spawner, interval_s=0, min_replicas=1,
+                            max_replicas=3, breach_ticks=1,
+                            cooldown_s=0.0)
+    results: dict = {}
+    errors: list = []
+    try:
+        ctl.start()
+        stop_at = time.perf_counter() + 3.0
+
+        def worker(i):
+            try:
+                j = 0
+                while time.perf_counter() < stop_at:
+                    x = np.full((1, 4), float(i * 1000 + j), np.float32)
+                    results[(i, j)] = (float(x[0, 0]),
+                                       ctl.router.infer("m", x)[0])
+                    j += 1
+                    time.sleep(0.005)
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        before = set(ctl.router.endpoints())
+        ctl.scale_to(2, reason="chaos scale-up")
+        joined = next(iter(set(ctl.router.endpoints()) - before))
+        spawner.kill(joined)              # SIGKILL the fresh replica
+        time.sleep(0.3)
+        ctl.tick()                        # reconcile: replace the corpse
+        for t in threads:
+            t.join(timeout=60)
+        bad = sum(
+            not np.allclose(
+                y, np.asarray(ref.run(np.full((1, 4), v, np.float32))),
+                rtol=1e-5, atol=1e-6)
+            for v, y in results.values())
+        check("control/zero_lost_through_kill",
+              not errors and len(results) > 20 and bad == 0,
+              f"errors={errors[:2]} n={len(results)} bad={bad}")
+        eps = ctl.router.endpoints()
+        check("control/dead_replica_replaced",
+              len(eps) == 2 and joined not in eps, str(eps))
+        acts = [d["action"] for d in ctl.decisions()]
+        check("control/replace_decision_logged",
+              "replace" in acts and "scale_up" in acts, str(acts))
+    finally:
+        ctl.close()
+
+    # -- (b) sticky-drain scale-down with a live pinned generation ------
+    paddle_tpu.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    def factory():
+        srv = io.InferenceServer().start()
+        srv.add_generator("llm", model, slots=2, max_len=32,
+                          step_wait_s=0.02)
+        return srv
+
+    inproc = InProcSpawner(factory)
+    ctl2 = ServingController(inproc, interval_s=0, min_replicas=1,
+                             max_replicas=2, drain_s=20.0)
+    try:
+        ctl2.start()
+        ctl2.scale_to(2, reason="chaos setup")
+        rs = np.random.RandomState(9)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        refs = np.asarray(generate(model, prompt[None], 14))[0, 5:]
+        sess = ctl2.router.session("chaos-pinned")
+        it = sess.generate("llm", prompt, 14, poll_wait_s=0.05)
+        toks = [next(it)]
+        victim = sess.endpoint
+        drained: dict = {}
+
+        def drain():
+            drained["d"] = ctl2.scale_down(victim=victim,
+                                           reason="chaos drain")
+
+        t = threading.Thread(target=drain)
+        t.start()
+        stream_err = None
+        try:
+            toks += list(it)              # rides through the drain
+        except Exception as e:
+            stream_err = f"{type(e).__name__}: {e}"
+        t.join(timeout=60)
+        d = drained.get("d")
+        check("control/sticky_stream_byte_identical",
+              stream_err is None
+              and np.array_equal(np.asarray(toks, np.int32), refs),
+              f"err={stream_err} toks={len(toks)}")
+        check("control/drain_clean_and_victim_stopped",
+              d is not None and d.action == "scale_down" and d.clean
+              and victim not in ctl2.router.endpoints()
+              and victim not in inproc.servers
+              and monitor.get_stat("control/drain_forced") == 0,
+              f"decision={d.as_dict() if d else None}")
+        # the survivor still serves; fleet is one replica
+        toks2 = list(ctl2.router.session("after-drain").generate(
+            "llm", prompt, 14, poll_wait_s=0.05))
+        check("control/survivor_serves_after_drain",
+              len(ctl2.router.endpoints()) == 1
+              and np.array_equal(np.asarray(toks2, np.int32), refs))
+    finally:
+        ctl2.close()
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -612,7 +767,8 @@ def main() -> int:
         for scenario in (scenario_serving_wire, scenario_checkpoint,
                          scenario_elastic_resume, scenario_overload,
                          scenario_obs, scenario_serving_routed,
-                         scenario_gen_engine, scenario_gen_paged):
+                         scenario_gen_engine, scenario_gen_paged,
+                         scenario_control_plane):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
@@ -626,7 +782,7 @@ def main() -> int:
                      for n, p, d in CHECKS if not p],
         "stats": {k: v for k, v in monitor.export_stats().items()
                   if k.split("/")[0] in ("wire", "ckpt", "fault", "train",
-                                         "serving", "gen")},
+                                         "serving", "gen", "control")},
     }, indent=2))
     return 0 if ok else 1
 
