@@ -7,11 +7,34 @@ the FS, and the checkpoint writer fail loudly; this registry lets tests
 and the chaos harness (``tools/chaos_check.py``) *make* them fail, on
 demand and reproducibly.
 
-Sites are dotted names hooked into the production paths:
+Sites are dotted names hooked into the production paths (every
+registered site, by layer):
 
     ``wire.send`` / ``wire.recv``   — FrameClient request round-trip
-    ``fs.upload`` / ``fs.download`` — checkpoint FS transfers
-    ``ckpt.save``                   — orbax save (before manifest commit)
+                                      (core/wire.py)
+    ``fs.upload`` / ``fs.download`` — checkpoint FS transfers (io/fs.py,
+                                      both local and wire FS)
+    ``ckpt.save``                   — orbax save, before the manifest
+                                      commit (io/checkpoint.py)
+    ``engine.prefill``              — GenerationEngine prompt prefill,
+                                      whole-prompt AND chunked
+                                      (serving/engine.py); fires count
+                                      as prefill traps → the self-heal
+                                      rebuild + crash-quarantine paths
+    ``engine.decode_step``          — the fused decode step over all
+                                      slots (serving/engine.py); a fire
+                                      implicates every stepped
+                                      generation's crash fingerprint
+    ``paged.alloc``                 — paged-KV page-pool allocation at
+                                      admission (serving/engine.py)
+    ``batcher.flush``               — a DynamicBatcher coalesced
+                                      execution; the failure fans out to
+                                      every request riding the batch
+                                      (serving/batcher.py)
+    ``control.spawn``               — ServingController replica spawn,
+                                      scale-up and replace; fires drive
+                                      the spawn circuit breaker
+                                      (serving/control.py)
 
 A spec string (the ``fault_inject`` flag, or :func:`configure`) selects
 sites::

@@ -213,6 +213,44 @@ define_flag("gen_prefix_cache", True,
             "prefill runs once per unique prefix "
             "(gen/prefix_hits, gen/prefix_tokens_saved). Cached pages "
             "are LRU-evicted under pool pressure")
+# --- end-to-end generation resilience (serving/engine.py, router.py) ---
+define_flag("gen_resume_budget", 0,
+            "Client-side stream-resumption budget: when a replica dies "
+            "(or its engine resets) under an in-flight generation "
+            "stream, RoutedClient/StickySession.generate replays "
+            "prompt + tokens-already-delivered to a freshly picked "
+            "replica as a prefill-from-prefix and keeps emitting from "
+            "where the stream broke — byte-identical for greedy decode, "
+            "RNG-position-replayed for sampled — up to this many "
+            "restarts per stream, then the typed StreamResumeExhausted "
+            "surfaces. 0 — the default — disables resumption entirely: "
+            "mid-stream replica loss surfaces GenerationFailed exactly "
+            "as before")
+define_flag("gen_quarantine_after", 0,
+            "Crash quarantine: a request whose prefill/decode traps the "
+            "engine this many times (by crash fingerprint — prompt "
+            "bytes + sampling params) is rejected at generate_start "
+            "with the typed RequestQuarantined instead of being "
+            "retried into every replica in the fleet. 0 — the default "
+            "— disables quarantine (no fingerprint bookkeeping)")
+define_flag("gen_engine_rebuilds", 0,
+            "Engine self-healing: how many consecutive decode-loop "
+            "traps the GenerationEngine absorbs by failing the active "
+            "generations loudly (error carries the 'engine reset:' "
+            "marker — resumable), rebuilding the cache pool and slot "
+            "state, and re-admitting work — before falling back to the "
+            "terminal broken state. A successful decode/prefill resets "
+            "the consecutive-trap count. 0 — the default — keeps the "
+            "pre-resilience behavior: the first trap bricks the engine")
+define_flag("gen_watchdog_s", 0.0,
+            "Stuck-step watchdog for the GenerationEngine decode loop: "
+            "when active work exists but the loop has not completed an "
+            "iteration for this long, the watchdog fails the active "
+            "generations loudly (clients resume elsewhere), sheds new "
+            "starts, and the loop rebuilds when the stuck call "
+            "returns. Must comfortably exceed worst-case XLA compile "
+            "time for the engine's buckets. 0 — the default — no "
+            "watchdog thread at all")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "
@@ -273,6 +311,22 @@ define_flag("control_drain_s", 10.0,
             "gets this long for in-flight generations and infers to "
             "finish before it is stopped (a forced stop past the "
             "deadline is counted and logged, never silent)")
+define_flag("control_spawn_breaker", 0,
+            "Circuit breaker on ReplicaSpawner failures: after this "
+            "many consecutive failed spawns (scale-up or dead-replica "
+            "replace), the controller stops calling the spawner and "
+            "backs off exponentially (control_spawn_backoff_s base, "
+            "doubling per further failure) — a poisoned artifact "
+            "degrades the fleet instead of hot-looping crash spawns. "
+            "One trial spawn is allowed when the backoff elapses "
+            "(half-open); success closes the breaker. 0 — the default "
+            "— disables the breaker: every scale decision calls the "
+            "spawner, exactly the pre-resilience behavior")
+define_flag("control_spawn_backoff_s", 2.0,
+            "Base of the spawn circuit-breaker backoff (doubles per "
+            "consecutive failure past the breaker threshold, capped at "
+            "32x). Only read once control_spawn_breaker > 0 opens the "
+            "breaker path")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

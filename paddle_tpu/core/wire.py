@@ -202,7 +202,8 @@ class FrameService:
                             # never shed: probes must answer under load
                             send_frame(sock, 0, outer.health(
                                 header.get("stats_prefix"),
-                                bool(header.get("histograms"))))
+                                bool(header.get("histograms")),
+                                bool(header.get("deep"))))
                             continue
                         if op == TRACE_OP:
                             # span scrape: never shed either (observing
@@ -279,10 +280,13 @@ class FrameService:
 
     def _shed_frame(self, sock, reason: str, *, closing: bool = False):
         """Fast rejection: the request was NOT executed; the client may
-        retry any op after ``retry_after_s``."""
+        retry any op after ``retry_after_s`` — jittered (U[0.5, 1.5) of
+        the base), so a crowd of clients shed in the same instant does
+        not come back in the same instant."""
         retry_after = float(flag("wire_backoff_s"))
-        if reason == "draining":
-            retry_after = max(retry_after, 0.5)   # we are going away
+        retry_after *= 0.5 + random.random()
+        if reason == "draining":   # we are going away: jittered floor
+            retry_after = max(retry_after, 0.5 + 0.5 * random.random())
         header: dict[str, Any] = {
             "error": f"{type(self).__name__} {reason}",
             "retry_after_s": retry_after}
@@ -318,7 +322,7 @@ class FrameService:
 
     # -- health ------------------------------------------------------------
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False) -> dict:
         """Uniform liveness/load snapshot, also served to any client as
         op :data:`HEALTH_OP` (``FrameClient.health()``). ``stats_prefix``
         (probe-header ``stats_prefix``) filters the monitor-stats
@@ -327,7 +331,12 @@ class FrameService:
         for none). ``histograms`` (probe-header ``histograms``) adds the
         matching latency histograms with raw bucket counts, so fleet
         scrapers (``tools/obs_dump.py``) can merge distributions across
-        endpoints instead of averaging quantiles."""
+        endpoints instead of averaging quantiles. ``deep`` (probe-header
+        ``deep``) asks for a work-proving liveness probe where the
+        service has one — the base service ignores it (wire liveness IS
+        its work); ``InferenceServer`` runs a one-token canary decode
+        per generation engine, distinguishing "port open" from "device
+        healthy"."""
         if stats_prefix is not None:
             stats_prefix = str(stats_prefix)   # header value is untrusted
         with self._load_cv:
@@ -505,19 +514,26 @@ class FrameClient:
             return {k: v for k, v in self._inflight_by_op.items() if v}
 
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False) -> dict:
         """Probe the server's universal health op (:data:`HEALTH_OP`,
         served by ``FrameService`` itself for every service): liveness,
         in-flight/connection depth, drain status, uptime, stats.
         ``stats_prefix`` asks the server to filter the stats snapshot
         (high-frequency pollers shouldn't ship every counter);
         ``histograms`` also ships the matching raw-bucket histograms
-        (mergeable across endpoints — see ``monitor.merge_histograms``)."""
+        (mergeable across endpoints — see ``monitor.merge_histograms``);
+        ``deep`` asks for the work-proving probe (an InferenceServer
+        runs a one-token canary decode per generation engine — engine
+        liveness distinct from the wire liveness this op otherwise
+        measures). Deep probes cost real device work; keep them off the
+        high-frequency path."""
         header: dict[str, Any] = {}
         if stats_prefix is not None:
             header["stats_prefix"] = stats_prefix
         if histograms:
             header["histograms"] = True
+        if deep:
+            header["deep"] = True
         return self._request("health", header, idempotent=True)[0]
 
     def trace_dump(self, clear: bool = False) -> dict:

@@ -223,7 +223,7 @@ class InferenceServer(FrameService):
         return eng
 
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False) -> dict:
         """FrameService health + per-generator slot AND page-pool
         occupancy (paged engines report ``pages_free``/``pages`` +
         ``prefix_entries``) + per-model usage stats (infer count,
@@ -232,14 +232,25 @@ class InferenceServer(FrameService):
         capacity and warm-tier residency without a dedicated op.
         ``stats_prefix`` keeps filtering the monitor-stats snapshot
         only — the ``models``/``generators`` sections always ship (they
-        are the decision inputs a control loop polls for)."""
-        doc = super().health(stats_prefix, histograms)
+        are the decision inputs a control loop polls for). ``deep``
+        additionally runs a one-token canary decode per generation
+        engine (``GenerationEngine.canary``) and ships the result under
+        each generator's ``engine`` key: *engine* liveness — "device
+        healthy" — as distinct from the *wire* liveness a shallow probe
+        measures ("port open"), so a router prober or controller can
+        tell a wedged device from a dead socket. Deep probes cost real
+        decode work; the background router prober stays shallow."""
+        doc = super().health(stats_prefix, histograms, deep)
         now = time.time()
         with self._lock:
-            gens = {n: e.stats() for n, e in self._generators.items()}
+            engines = dict(self._generators)
             models = {n: dict(st, idle_s=max(now - st["last_used_ts"],
                                              0.0))
                       for n, st in self._model_stats.items()}
+        gens = {n: e.stats() for n, e in engines.items()}
+        if deep:
+            for n, e in engines.items():
+                gens[n]["engine"] = e.canary()
         if gens:
             doc["generators"] = gens
         doc["models"] = models
@@ -297,7 +308,8 @@ class InferenceServer(FrameService):
                         top_k=int(header.get("top_k", 0)),
                         top_p=float(header.get("top_p", 1.0)),
                         eos_token_id=None if eos is None else int(eos),
-                        seed=int(header.get("seed", 0)))
+                        seed=int(header.get("seed", 0)),
+                        rng_skip=int(header.get("rng_skip", 0)))
                 except EngineOverloaded as e:
                     # full engine: shed, not error — the status is
                     # retryable for every client (the start never ran)
@@ -395,11 +407,15 @@ class InferenceClient(FrameClient):
     def generate_start(self, model: str, prompt, max_new_tokens: int, *,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, eos_token_id: int | None = None,
-                       seed: int = 0) -> str:
+                       seed: int = 0, rng_skip: int = 0) -> str:
         """Admit a generation into ``model``'s engine; returns its id.
         A full engine surfaces as the retryable shed status (the client
         backs off per ``retry_after_s`` and retries within its budget,
-        then raises :class:`~paddle_tpu.core.wire.WireShedError`)."""
+        then raises :class:`~paddle_tpu.core.wire.WireShedError`); a
+        quarantined crash fingerprint re-raises the typed
+        :class:`~paddle_tpu.serving.engine.RequestQuarantined` — final,
+        never retried. ``rng_skip`` fast-forwards the sampling-key
+        schedule (stream resumption's RNG-position replay)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         header = {"model": model, "prompt": prompt.tolist(),
                   "max_new_tokens": int(max_new_tokens),
@@ -407,16 +423,37 @@ class InferenceClient(FrameClient):
                   "top_p": float(top_p), "seed": int(seed)}
         if eos_token_id is not None:
             header["eos_token_id"] = int(eos_token_id)
-        return self._request("generate_start", header)[0]["gen_id"]
+        if rng_skip:
+            header["rng_skip"] = int(rng_skip)
+        try:
+            return self._request("generate_start", header)[0]["gen_id"]
+        except RuntimeError as e:
+            from paddle_tpu.serving.engine import (
+                QUARANTINE_MARKER, RequestQuarantined,
+            )
+            if QUARANTINE_MARKER in str(e):
+                raise RequestQuarantined(str(e)) from e
+            raise
 
     def generate_poll(self, model: str, gen_id: str, start: int = 0,
                       wait_s: float = 0.0) -> dict:
         """Tokens past ``start`` (long-polls up to ``wait_s`` server-side)
-        → ``{"tokens", "done", "error", "queued"}``."""
-        return self._request(
-            "generate_poll", {"model": model, "gen_id": gen_id,
-                              "start": int(start),
-                              "wait_s": float(wait_s)})[0]
+        → ``{"tokens", "done", "error", "queued"}``. A generation the
+        server reaped via the poll TTL re-raises the typed
+        :class:`~paddle_tpu.serving.engine.GenerationExpired` (distinct
+        from plain unknown-id — the stream existed there and is gone)."""
+        try:
+            return self._request(
+                "generate_poll", {"model": model, "gen_id": gen_id,
+                                  "start": int(start),
+                                  "wait_s": float(wait_s)})[0]
+        except RuntimeError as e:
+            from paddle_tpu.serving.engine import (
+                EXPIRED_MARKER, GenerationExpired,
+            )
+            if EXPIRED_MARKER in str(e):
+                raise GenerationExpired(str(e)) from e
+            raise
 
     def generate_cancel(self, model: str, gen_id: str) -> bool:
         return self._request(

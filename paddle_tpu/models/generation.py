@@ -19,7 +19,22 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
-           "paged_gather", "paged_scatter"]
+           "paged_gather", "paged_scatter", "advance_key"]
+
+
+def advance_key(key, steps):
+    """Advance a PRNG key by ``steps`` split-and-keep-first operations —
+    exactly the per-emitted-token key schedule of the serving
+    ``GenerationEngine`` (each token consumes one
+    ``key, sub = jax.random.split(key)``). A resumed sampled stream
+    replays its RNG position by starting from
+    ``advance_key(PRNGKey(seed), tokens_already_delivered)``: token
+    ``k`` of the resumed stream then draws from the same subkey as
+    token ``k`` of the uninterrupted one. ``steps`` may be traced (the
+    loop is a ``lax.fori_loop``); 0 returns the key unchanged."""
+    return jax.lax.fori_loop(
+        0, jnp.asarray(steps, jnp.int32),
+        lambda i, k: jax.random.split(k)[0], key)
 
 
 def sample_logits(logits, key=None, *, temperature: float = 1.0,

@@ -27,14 +27,17 @@ from paddle_tpu.serving.control import (
     SubprocessSpawner,
 )
 from paddle_tpu.serving.engine import (
-    EngineOverloaded, Generation, GenerationEngine,
+    EngineOverloaded, Generation, GenerationEngine, GenerationExpired,
+    RequestQuarantined,
 )
 from paddle_tpu.serving.router import (
     GenerationFailed, ReplicaState, RoutedClient, StickySession,
+    StreamResumeExhausted,
 )
 
 __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "GenerationEngine", "Generation", "EngineOverloaded",
            "StickySession", "GenerationFailed", "ServingController",
            "ControlDecision", "ReplicaSpawner", "InProcSpawner",
-           "SubprocessSpawner"]
+           "SubprocessSpawner", "RequestQuarantined", "GenerationExpired",
+           "StreamResumeExhausted"]

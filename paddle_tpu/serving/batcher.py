@@ -53,6 +53,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.core import fault as _fault
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.monitor import observe, stat_add
@@ -248,6 +249,9 @@ class DynamicBatcher:
         for it in take:
             observe("serving/batch_wait_s", t_exec - it.t0)
         try:
+            # injection site for the whole coalesced execution: a flush
+            # failure must fan out to every rider, never hang one
+            _fault.inject("batcher.flush")
             if len(take) == 1:
                 # solo flush: no concat/pad — identical to a direct run
                 take[0].outputs = self._run(pred, model, take[0].inputs,

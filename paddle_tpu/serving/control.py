@@ -52,10 +52,20 @@ Defaults are hard-off (the ``FLAGS_trace`` pattern): with
 data path reads any ``control_*`` flag — a fleet without a controller
 is byte-identical to the PR-6 state.
 
+Spawner hardening (``control_spawn_breaker``, hard-off): consecutive
+``ReplicaSpawner`` failures — a poisoned artifact crash-looping
+``replace``, an exhausted quota failing scale-up — open a circuit
+breaker with exponential backoff (``control_spawn_backoff_s`` base,
+doubling, capped at 32x): the controller records a ``spawn_breaker``
+decision instead of calling the spawner, lets one half-open trial
+through when the backoff elapses, and closes the breaker on the first
+success. The fleet degrades predictably instead of hot-looping spawns.
+
 Observability: ``control/replicas`` gauge; ``control/ticks`` /
 ``control/scale_ups`` / ``control/scale_downs`` / ``control/replaced`` /
 ``control/model_evictions`` / ``control/model_faults`` /
-``control/drain_forced`` / ``control/spawn_failures`` counters;
+``control/drain_forced`` / ``control/spawn_failures`` /
+``control/spawn_breaker_opened`` / ``control/spawn_skipped`` counters;
 ``control/drain_s`` histogram; ``control/tick`` / ``control/scale_up`` /
 ``control/drain`` spans.
 """
@@ -70,6 +80,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from paddle_tpu.core import fault as _fault
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.logging import get_logger
@@ -95,7 +106,8 @@ class ControlDecision:
     straight into logs/benches."""
 
     action: str                  # scale_up | scale_down | hold | evict |
-    #                              fault_in | replace | spawn_failed
+    #                              fault_in | replace | spawn_failed |
+    #                              spawn_breaker
     reason: str
     endpoint: str | None = None
     clean: bool = True           # drains: finished inside the deadline?
@@ -286,6 +298,8 @@ class ServingController:
                  idle_ticks: int | None = None,
                  cooldown_s: float | None = None,
                  drain_s: float | None = None,
+                 spawn_breaker: int | None = None,
+                 spawn_backoff_s: float | None = None,
                  decisions_max: int = 256):
         def _f(v, name):
             return flag(name) if v is None else v
@@ -310,6 +324,14 @@ class ServingController:
         self.idle_ticks = int(_f(idle_ticks, "control_idle_ticks"))
         self.cooldown_s = float(_f(cooldown_s, "control_cooldown_s"))
         self.drain_s = float(_f(drain_s, "control_drain_s"))
+        self.spawn_breaker = int(_f(spawn_breaker,
+                                    "control_spawn_breaker"))
+        self.spawn_backoff_s = float(_f(spawn_backoff_s,
+                                        "control_spawn_backoff_s"))
+        # spawn circuit-breaker state: consecutive failures and the
+        # monotonic instant before which the spawner must not be called
+        self._spawn_fails = 0
+        self._spawn_open_until = 0.0
 
         self._lock = threading.RLock()
         self._registry: dict[str, dict[str, Any]] = {}   # name -> spec
@@ -692,19 +714,58 @@ class ServingController:
             return warm + cold
         return (warm + cold)[:max(self.warm_models, len(warm))]
 
+    def _spawn_failed(self, reason: str, signals: dict[str, Any],
+                      e: BaseException) -> ControlDecision:
+        """Count a spawner failure toward the circuit breaker: past
+        ``control_spawn_breaker`` consecutive failures the breaker
+        opens for ``control_spawn_backoff_s * 2^(extra failures)``
+        (capped at 32x) — a poisoned artifact degrades the fleet
+        instead of hot-looping crash spawns. The next attempt after the
+        backoff elapses is the half-open trial; success closes the
+        breaker."""
+        stat_add("control/spawn_failures")
+        suffix = ""
+        with self._lock:
+            self._spawn_fails += 1
+            if 0 < self.spawn_breaker <= self._spawn_fails:
+                backoff = self.spawn_backoff_s * min(
+                    2 ** (self._spawn_fails - self.spawn_breaker), 32)
+                self._spawn_open_until = time.monotonic() + backoff
+                stat_add("control/spawn_breaker_opened")
+                suffix = (f"; circuit breaker OPEN for {backoff:g}s "
+                          f"({self._spawn_fails} consecutive failures "
+                          f">= control_spawn_breaker="
+                          f"{self.spawn_breaker})")
+        d = ControlDecision(
+            "spawn_failed", ts=time.time(), signals=signals,
+            reason=f"{reason}; spawn raised "
+                   f"{type(e).__name__}: {e}{suffix}")
+        self._record(d)
+        return d
+
     def _scale_up(self, reason: str,
                   signals: dict[str, Any]) -> ControlDecision:
         with _trace.span("control/scale_up"):
-            try:
-                ep = self._spawner.spawn()
-            except Exception as e:
-                stat_add("control/spawn_failures")
+            with self._lock:
+                remaining = self._spawn_open_until - time.monotonic()
+            if self.spawn_breaker > 0 and remaining > 0:
+                stat_add("control/spawn_skipped")
                 d = ControlDecision(
-                    "spawn_failed", ts=time.time(), signals=signals,
-                    reason=f"{reason}; spawn raised "
-                           f"{type(e).__name__}: {e}")
+                    "spawn_breaker", ts=time.time(), signals=signals,
+                    reason=f"{reason}; spawn circuit breaker open for "
+                           f"{remaining:.1f}s more after "
+                           f"{self._spawn_fails} consecutive spawn "
+                           "failures — not calling the spawner")
                 self._record(d)
                 return d
+            try:
+                _fault.inject("control.spawn")
+                ep = self._spawner.spawn()
+            except Exception as e:
+                return self._spawn_failed(reason, signals, e)
+            with self._lock:         # half-open trial succeeded (or the
+                self._spawn_fails = 0     # breaker was never tripped):
+                self._spawn_open_until = 0.0   # close the breaker
             try:                 # registry models before traffic arrives
                 with InferenceClient(ep, retries=1) as c:
                     for name, path in self._spawn_model_set():

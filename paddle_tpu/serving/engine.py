@@ -52,18 +52,40 @@ are deterministic per ``(prompt, seed)`` — each slot splits its own key
 once per emitted token — but follow a different key schedule than solo
 ``generate``.
 
+Self-healing (``FLAGS_gen_engine_rebuilds`` / ``FLAGS_gen_watchdog_s``
+/ ``FLAGS_gen_quarantine_after``, all hard-off): a decode-loop trap no
+longer bricks the engine forever — the active generations fail loudly
+(their error carries the ``engine reset:`` marker, which the routed
+client treats as resumable), the cache pool and slot state are rebuilt,
+and work is re-admitted, up to ``gen_engine_rebuilds`` *consecutive*
+traps. A watchdog thread detects a stuck decode step (loop heartbeat
+older than ``gen_watchdog_s`` with active work), fails the stranded
+generations so their clients resume elsewhere, and sheds new starts
+until the stuck call returns and the loop rebuilds. Crash quarantine
+fingerprints the request under a trap (prompt bytes + sampling params);
+a fingerprint that traps ``gen_quarantine_after`` times is rejected at
+:meth:`~GenerationEngine.start` with the typed
+:class:`RequestQuarantined` instead of being retried into every replica
+in the fleet. Fault-injection sites ``engine.prefill`` /
+``engine.decode_step`` / ``paged.alloc`` (``core/fault.py``) make every
+one of these paths deterministically testable.
+
 Observability: ``gen/slots_active`` / ``gen/queue_depth`` /
 ``gen/pages_free`` gauges, ``gen/prefill_s`` / ``gen/prefill_chunk_s`` /
 ``gen/decode_step_s`` / ``gen/ttft_s`` (enqueue → first token — the
 autoscaling SLO signal) histograms, ``gen/tokens`` / ``gen/evictions`` /
 ``gen/prefix_hits`` / ``gen/prefix_tokens_saved`` /
-``gen/prefix_evictions`` counters, ``gen/prefill`` +
-``gen/prefill_chunk`` + ``gen/decode_step`` spans, and slot + page-pool
-occupancy in the serving ``health`` op.
+``gen/prefix_evictions`` / ``gen/traps`` / ``gen/rebuilds`` /
+``gen/stuck`` / ``gen/quarantined`` / ``gen/quarantine_rejected`` /
+``gen/expired_polls`` counters, ``gen/prefill`` + ``gen/prefill_chunk``
++ ``gen/decode_step`` spans, and slot + page-pool occupancy in the
+serving ``health`` op.
 """
 
 from __future__ import annotations
 
+import hashlib
+import random as _random_mod
 import threading
 import time
 import uuid
@@ -72,13 +94,34 @@ from typing import Any
 
 import numpy as np
 
+from paddle_tpu.core import fault as _fault
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.monitor import observe, stat_add, stat_set
 
-__all__ = ["GenerationEngine", "Generation", "EngineOverloaded"]
+__all__ = ["GenerationEngine", "Generation", "EngineOverloaded",
+           "RequestQuarantined", "GenerationExpired", "RESET_MARKER",
+           "QUARANTINE_MARKER", "EXPIRED_MARKER"]
 
 _UNSET = object()
+
+# Marker prefixes for typed failures as they cross the wire (the frame
+# protocol carries error strings; clients re-raise the typed class when
+# they see the marker — the io/serving ``ModelBusyError`` pattern).
+RESET_MARKER = "engine reset:"          # resumable: slot state lost,
+#                                         engine (and replica) still up
+QUARANTINE_MARKER = "request quarantined:"   # typed give-up, never retry
+EXPIRED_MARKER = "generation expired:"       # poll-TTL reap, not unknown
+
+# private shed-jitter stream: synchronized clients whose starts were all
+# shed in the same instant must not re-stampede in the same instant
+_jitter_rng = _random_mod.Random()
+
+
+def _jittered(base: float) -> float:
+    """``base`` scaled by U[0.5, 1.5) — the retry hint synchronized
+    shed clients back off by must de-synchronize them."""
+    return base * (0.5 + _jitter_rng.random())
 
 
 class EngineOverloaded(RuntimeError):
@@ -91,6 +134,31 @@ class EngineOverloaded(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class RequestQuarantined(RuntimeError):
+    """This request's crash fingerprint (prompt bytes + sampling
+    params) has trapped the engine ``FLAGS_gen_quarantine_after``
+    times; it is rejected at admission instead of being retried into
+    every replica in the fleet. NOT retryable — the typed give-up the
+    stream-resumption layer must surface, never resume past."""
+
+    def __init__(self, msg: str, fingerprint: str = ""):
+        super().__init__(msg)
+        self.fingerprint = fingerprint
+
+
+class GenerationExpired(KeyError):
+    """The polled generation existed here but was reaped by the poll
+    TTL (client presumed disconnected). Distinct from a plain
+    ``KeyError`` — "expired" is a fact about THIS replica, "unknown"
+    may mean the caller polled the wrong replica entirely."""
+
+
+class _EpochChanged(RuntimeError):
+    """Internal: the watchdog failed this step's generations while the
+    compiled call was in flight — its results (and the state it
+    returned) must be discarded, and the loop must rebuild or break."""
+
+
 class Generation:
     """Host-side record of one generation request (the engine's unit of
     scheduling). ``tokens`` grows as decode steps emit; ``slot`` is None
@@ -100,7 +168,8 @@ class Generation:
                  "top_k", "top_p", "eos_token_id", "seed", "tokens",
                  "done", "error", "slot", "created", "last_poll",
                  "cancelled", "pages", "shared", "prefilling",
-                 "prefill_pos", "prefill_t0", "delivered")
+                 "prefill_pos", "prefill_t0", "delivered", "fingerprint",
+                 "rng_skip")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -130,6 +199,14 @@ class Generation:
         self.prefilling = False
         self.prefill_pos = 0
         self.prefill_t0 = 0.0
+        # crash fingerprint (quarantine identity) and the RNG position a
+        # resumed sampled stream replays (splits consumed before this
+        # stream's first token — 0 for a fresh stream)
+        self.fingerprint = hashlib.sha1(
+            prompt.tobytes()
+            + f"|{temperature}|{top_k}|{top_p}|{seed}".encode()
+        ).hexdigest()[:16]
+        self.rng_skip = 0
 
 
 class _PagePool:
@@ -148,6 +225,7 @@ class _PagePool:
         return len(self._free)
 
     def alloc(self, n: int) -> list[int]:
+        _fault.inject("paged.alloc")
         if n > len(self._free):
             raise RuntimeError(
                 f"page pool exhausted: need {n}, free {len(self._free)}")
@@ -314,6 +392,12 @@ class GenerationEngine:
     ``generate()`` in both modes, under any co-tenant mix, page reuse,
     and chunked prefill.
 
+    ``quarantine_after``/``rebuilds``/``watchdog_s`` default to the
+    ``gen_quarantine_after``/``gen_engine_rebuilds``/``gen_watchdog_s``
+    flags (all 0 = the pre-resilience behavior: no quarantine books, the
+    first decode-loop trap breaks the engine terminally, no watchdog
+    thread). See the module docstring's self-healing section.
+
     The background loop starts on construction; :meth:`close` retires it.
     All device state is touched only by the loop thread — the public
     surface (:meth:`start`/:meth:`poll`/:meth:`cancel`) is host-side and
@@ -327,9 +411,10 @@ class GenerationEngine:
                  min_bucket: int = 8, step_wait_s: float = 0.0,
                  paged: bool | None = None, page_tokens: int | None = None,
                  pages: int | None = None, prefill_chunk: int | None = None,
-                 prefix_cache: bool | None = None):
-        import jax.numpy as jnp
-
+                 prefix_cache: bool | None = None,
+                 quarantine_after: int | None = None,
+                 rebuilds: int | None = None,
+                 watchdog_s: float | None = None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -360,12 +445,16 @@ class GenerationEngine:
         self._prefill_chunk = int(flag("gen_prefill_chunk")
                                   if prefill_chunk is None
                                   else prefill_chunk)
-
-        proto = model.init_cache(1, self.max_len, dtype=cache_dtype)
-        import jax
+        # self-healing knobs (all hard-off by default; see module doc)
+        self._quarantine_after = int(flag("gen_quarantine_after")
+                                     if quarantine_after is None
+                                     else quarantine_after)
+        self._rebuild_max = int(flag("gen_engine_rebuilds")
+                                if rebuilds is None else rebuilds)
+        self._watchdog_s = float(flag("gen_watchdog_s")
+                                 if watchdog_s is None else watchdog_s)
 
         if self._paged:
-            from paddle_tpu.models.generation import init_paged_cache
             P = int(flag("gen_page_tokens") if page_tokens is None
                     else page_tokens)
             if P < 1:
@@ -384,24 +473,12 @@ class GenerationEngine:
             # host-side page tables, uploaded per compiled call (0 =
             # null page); rows zero whenever the slot is free
             self._pt = np.zeros((self.slots, self._maxp), np.int32)
-            cache = init_paged_cache(proto, npages, P)
             stat_set("gen/pages_free", self._pool.free_count)
         else:
             self._pool = None
             self._prefix = None
             self._pt = None
-            cache = jax.tree_util.tree_map(
-                lambda x: jnp.zeros((self.slots,) + x.shape, x.dtype),
-                proto)
-        self._state: dict[str, Any] = {
-            "cache": cache,
-            "tok": jnp.zeros((self.slots,), jnp.int32),
-            "pos": jnp.zeros((self.slots,), jnp.int32),
-            "keys": jnp.zeros((self.slots, 2), jnp.uint32),
-            "temp": jnp.zeros((self.slots,), jnp.float32),
-            "top_k": jnp.zeros((self.slots,), jnp.int32),
-            "top_p": jnp.ones((self.slots,), jnp.float32),
-        }
+        self._state: dict[str, Any] = self._init_state()
         if self._paged:
             self._step = self._build_paged_step()
             self._prefill_fn = self._build_paged_prefill()
@@ -415,9 +492,57 @@ class GenerationEngine:
         self._gens: dict[str, Generation] = {}
         self._stopping = False
         self._broken: str | None = None
+        # self-healing books: crash fingerprints, quarantine set, reaped
+        # tombstones (typed GenerationExpired instead of unknown-id),
+        # rebuild/trap counters, watchdog heartbeat + stuck latch, and
+        # the state epoch that invalidates an in-flight compiled call's
+        # results after the watchdog failed its generations
+        self._crash_counts: dict[str, int] = {}
+        self._quarantined: dict[str, str] = {}
+        self._expired: dict[str, float] = {}
+        self._rebuilds = 0
+        self._consec_traps = 0
+        self._epoch = 0
+        self._stuck = False
+        self._last_beat = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gen-engine")
         self._thread.start()
+        self._watch_stop = threading.Event()
+        self._watchdog: threading.Thread | None = None
+        if self._watchdog_s > 0:
+            self._watchdog = threading.Thread(target=self._watchdog_loop,
+                                              daemon=True,
+                                              name="gen-watchdog")
+            self._watchdog.start()
+
+    def _init_state(self) -> dict[str, Any]:
+        """Fresh device-side engine state (the batched KV cache/page
+        pool plus per-slot token/position/key/sampling arrays). Called
+        at construction AND by :meth:`_rebuild` — self-healing replaces
+        the whole device state, never patches a possibly-poisoned one."""
+        import jax
+        import jax.numpy as jnp
+
+        proto = self._model.init_cache(1, self.max_len,
+                                       dtype=self._cache_dtype)
+        if self._paged:
+            from paddle_tpu.models.generation import init_paged_cache
+            cache = init_paged_cache(proto, self._pool.num_pages,
+                                     self._page_tokens)
+        else:
+            cache = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((self.slots,) + x.shape, x.dtype),
+                proto)
+        return {
+            "cache": cache,
+            "tok": jnp.zeros((self.slots,), jnp.int32),
+            "pos": jnp.zeros((self.slots,), jnp.int32),
+            "keys": jnp.zeros((self.slots, 2), jnp.uint32),
+            "temp": jnp.zeros((self.slots,), jnp.float32),
+            "top_k": jnp.zeros((self.slots,), jnp.int32),
+            "top_p": jnp.ones((self.slots,), jnp.float32),
+        }
 
     # -- compiled pieces ---------------------------------------------------
     def _build_step(self):
@@ -581,16 +706,25 @@ class GenerationEngine:
     # -- public surface ----------------------------------------------------
     def start(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
               top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
-              seed: int = 0) -> str:
+              seed: int = 0, rng_skip: int = 0) -> str:
         """Enqueue a generation; returns its id immediately. Raises
         :class:`EngineOverloaded` (retryable) when every slot is busy and
-        the admit queue is at ``queue_max``."""
+        the admit queue is at ``queue_max``, and the typed
+        :class:`RequestQuarantined` when the request's crash fingerprint
+        is quarantined. ``rng_skip`` advances the per-(prompt, seed)
+        sampling-key schedule by that many splits before the first
+        token — how a resumed sampled stream replays its RNG position
+        (see ``models.generation.advance_key``); greedy requests ignore
+        it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        rng_skip = int(rng_skip)
+        if rng_skip < 0:
+            raise ValueError("rng_skip must be >= 0")
         if prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens "
@@ -606,12 +740,31 @@ class GenerationEngine:
         gen = Generation(uuid.uuid4().hex[:16], prompt, max_new_tokens,
                          float(temperature), int(top_k), float(top_p),
                          None if eos is None else int(eos), int(seed))
+        gen.rng_skip = rng_skip
         with self._cond:
             if self._stopping:
                 raise RuntimeError("GenerationEngine is stopped")
             if self._broken is not None:
                 raise RuntimeError(
                     f"GenerationEngine is broken: {self._broken}")
+            if (self._quarantine_after > 0
+                    and gen.fingerprint in self._quarantined):
+                stat_add("gen/quarantine_rejected")
+                raise RequestQuarantined(
+                    f"{QUARANTINE_MARKER} request {gen.fingerprint} "
+                    f"trapped the engine "
+                    f"{self._crash_counts.get(gen.fingerprint, 0)} "
+                    f"time(s) (last: "
+                    f"{self._quarantined[gen.fingerprint]}); refusing "
+                    "to re-admit it", fingerprint=gen.fingerprint)
+            if self._stuck:
+                # the decode loop is wedged in a device call; shed
+                # retryably so the routed layer sends work elsewhere
+                stat_add("gen/shed")
+                raise EngineOverloaded(
+                    "engine stuck: decode loop unresponsive "
+                    f"(gen_watchdog_s={self._watchdog_s:g}); retry "
+                    "elsewhere", retry_after_s=_jittered(0.5))
             free = sum(g is None for g in self._slot_gen)
             if (self._queue_max > 0
                     and len(self._queue) - free >= self._queue_max):
@@ -622,7 +775,8 @@ class GenerationEngine:
                 raise EngineOverloaded(
                     f"engine full: {self.slots} slots busy, "
                     f"{len(self._queue)} queued (queue_max="
-                    f"{self._queue_max}){pool}")
+                    f"{self._queue_max}){pool}",
+                    retry_after_s=_jittered(0.25))
             self._queue.append(gen)
             self._gens[gen.gen_id] = gen
             stat_set("gen/queue_depth", len(self._queue))
@@ -641,6 +795,15 @@ class GenerationEngine:
         with self._cond:
             gen = self._gens.get(gen_id)
             if gen is None:
+                if gen_id in self._expired:
+                    # reaped by the TTL (possibly while this poll was
+                    # in flight): typed, so the caller can tell "your
+                    # stream expired HERE" from "never started here"
+                    stat_add("gen/expired_polls")
+                    raise GenerationExpired(
+                        f"{EXPIRED_MARKER} generation {gen_id} was "
+                        "reaped by the poll TTL (client presumed "
+                        "disconnected); restart it")
                 raise KeyError(f"unknown generation {gen_id!r} "
                                "(finished long ago, evicted, or never "
                                "started here)")
@@ -703,6 +866,9 @@ class GenerationEngine:
                        if not (g.done and g.delivered)),
                    "max_len": self.max_len,
                    "broken": self._broken,
+                   "stuck": self._stuck,
+                   "rebuilds": self._rebuilds,
+                   "quarantined": len(self._quarantined),
                    "paged": self._paged}
             if self._paged:
                 doc.update(
@@ -724,6 +890,40 @@ class GenerationEngine:
             stat_set("gen/pages_free", self._pool.free_count)
             return freed
 
+    def canary(self, timeout_s: float = 5.0, prompt_token: int = 1) -> dict:
+        """One-token liveness decode through the real admit → prefill →
+        sample path: *engine* liveness as distinct from *wire* liveness
+        ("device healthy" vs "port open") — what the serving ``health``
+        op ships per generator under ``deep=True``. A full engine counts
+        as alive (``busy=True``: it is making progress for someone);
+        broken/stuck/timed-out engines report ``ok=False`` with the
+        error. Returns ``{"ok", "busy", "latency_s", "error"}``."""
+        t0 = time.monotonic()
+        try:
+            gid = self.start(np.asarray([int(prompt_token)], np.int32), 1)
+        except EngineOverloaded:
+            return {"ok": True, "busy": True,
+                    "latency_s": time.monotonic() - t0, "error": None}
+        except RuntimeError as e:        # broken / quarantined canary
+            return {"ok": False, "busy": False,
+                    "latency_s": time.monotonic() - t0,
+                    "error": f"{type(e).__name__}: {e}"}
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        ok, err = False, f"canary timed out after {timeout_s:g}s"
+        try:
+            while time.monotonic() < deadline:
+                doc = self.poll(gid, wait_s=min(0.25, float(timeout_s)))
+                if doc["done"]:
+                    ok = doc["error"] is None
+                    err = doc["error"]
+                    break
+        except (KeyError, RuntimeError) as e:
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            self.cancel(gid)
+        return {"ok": ok, "busy": False,
+                "latency_s": time.monotonic() - t0, "error": err}
+
     def close(self) -> None:
         """Stop the loop; error out queued/active generations."""
         with self._cond:
@@ -731,6 +931,9 @@ class GenerationEngine:
                 return
             self._stopping = True
             self._cond.notify_all()
+        self._watch_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
         self._thread.join(timeout=10.0)
         with self._cond:
             for gen in list(self._gens.values()):
@@ -760,6 +963,7 @@ class GenerationEngine:
             with self._cond:
                 if self._stopping:
                     return
+                self._last_beat = time.monotonic()   # watchdog heartbeat
                 if (not self._queue
                         and not any(g is not None for g in self._slot_gen)):
                     # idle: wake on new work, and periodically anyway so
@@ -768,6 +972,12 @@ class GenerationEngine:
                     if self._stopping:
                         return
             try:
+                if self._stuck:
+                    # the watchdog failed this loop's generations while
+                    # a call was (apparently) wedged; whatever state the
+                    # call left behind is garbage — rebuild or break
+                    raise _EpochChanged("watchdog marked the engine "
+                                        "stuck")
                 self._reap_expired()
                 if self._paged:
                     progressed = self._admit_paged()
@@ -783,14 +993,118 @@ class GenerationEngine:
                 else:
                     self._admit()
                     self._decode_step(jnp)
-            except Exception as e:   # device-side failure: fail loudly,
-                self._break(e)       # refuse new work, keep pollers sane
-                return
+            except Exception as e:   # device-side failure: fail loudly
+                with self._cond:
+                    self._consec_traps += 1
+                    consec = self._consec_traps
+                if self._rebuild_max > 0 and consec <= self._rebuild_max:
+                    try:              # self-heal: fail active gens,
+                        self._rebuild(e)   # fresh state, re-admit
+                        continue
+                    except Exception as e2:   # rebuild itself trapped
+                        self._break(e2)
+                        return
+                self._break(e)       # terminal: refuse new work,
+                return               # keep pollers sane
+
+    def _note_trap(self, gens: list[Generation], e: BaseException) -> None:
+        """Record a prefill/decode trap against the implicated
+        generations' crash fingerprints; a fingerprint that reaches
+        ``gen_quarantine_after`` is quarantined — its future starts get
+        the typed :class:`RequestQuarantined`. Prefill traps implicate
+        exactly the prefilling request; decode traps implicate every
+        generation in the fused step (co-tenants of a poison request
+        accumulate counts too — set the threshold above 1 when mixed
+        traffic shares an engine)."""
+        stat_add("gen/traps")
+        if self._quarantine_after <= 0 or not gens:
+            return
+        msg = f"{type(e).__name__}: {e}"
+        with self._cond:
+            for gen in gens:
+                fp = gen.fingerprint
+                self._crash_counts[fp] = self._crash_counts.get(fp, 0) + 1
+                if (self._crash_counts[fp] >= self._quarantine_after
+                        and fp not in self._quarantined):
+                    self._quarantined[fp] = msg
+                    stat_add("gen/quarantined")
+            while len(self._crash_counts) > 1024:   # bounded books
+                self._crash_counts.pop(next(iter(self._crash_counts)))
+
+    def _fail_active_locked(self, msg: str) -> list[Generation]:
+        """Fail every slotted generation loudly (queued generations
+        never touched the device — they stay queued and survive the
+        reset). Caller holds the lock and is about to discard/rebuild
+        the device state, so pages are NOT returned to the old pool.
+        Returns the failed generations."""
+        victims = [g for g in self._slot_gen if g is not None]
+        for g in victims:
+            if not g.done:
+                g.done = True
+                g.error = msg
+            g.slot = None
+            g.prefilling = False
+            g.pages = []
+        self._slot_gen = [None] * self.slots
+        if self._paged:
+            self._pt[:] = 0
+        self._epoch += 1              # in-flight compiled results are
+        stat_set("gen/slots_active", 0)   # garbage from here on
+        return victims
+
+    def _rebuild(self, e: Exception) -> None:
+        """Self-heal after a decode-loop trap: fail the active
+        generations with the resumable ``engine reset:`` marker, replace
+        the device state (cache pool, page books, prefix cache) wholesale,
+        and re-admit — queued work proceeds, new starts are accepted.
+        Raises if rebuilding itself fails (the caller then breaks)."""
+        msg = f"{RESET_MARKER} {type(e).__name__}: {e}"
+        stat_add("gen/rebuilds")
+        fresh = self._init_state()           # allocate outside the lock
+        with self._cond:
+            self._rebuilds += 1
+            self._fail_active_locked(msg)
+            if self._paged:
+                self._pool = _PagePool(self._pool.num_pages)
+                if self._prefix is not None:
+                    self._prefix = _PrefixCache(self._page_tokens)
+                stat_set("gen/pages_free", self._pool.free_count)
+            self._state = fresh
+            self._stuck = False
+            self._cond.notify_all()
+
+    def _watchdog_loop(self) -> None:
+        """Stuck-step detection: active work but no loop heartbeat for
+        ``gen_watchdog_s`` → fail the stranded generations loudly (their
+        clients resume elsewhere), shed new starts, and let the loop
+        rebuild/break when the wedged call finally returns."""
+        period = max(min(self._watchdog_s / 4.0, 1.0), 0.05)
+        while not self._watch_stop.wait(period):
+            victims: list[Generation] = []
+            with self._cond:
+                if self._stopping:
+                    return
+                if self._stuck or self._broken is not None:
+                    continue
+                busy = any(g is not None for g in self._slot_gen)
+                stalled = time.monotonic() - self._last_beat
+                if not busy or stalled <= self._watchdog_s:
+                    continue
+                stat_add("gen/stuck")
+                victims = self._fail_active_locked(
+                    f"{RESET_MARKER} stuck step: decode loop "
+                    f"unresponsive for {stalled:.1f}s "
+                    f"(gen_watchdog_s={self._watchdog_s:g})")
+                self._stuck = True
+                self._cond.notify_all()
+            self._note_trap(victims,
+                            TimeoutError("stuck decode step"))
 
     def _break(self, e: Exception) -> None:
         msg = f"{type(e).__name__}: {e}"
         with self._cond:
             self._broken = msg
+            self._stuck = False       # broken supersedes stuck
             for gen in list(self._gens.values()):
                 if not gen.done:
                     gen.done = True
@@ -826,6 +1140,13 @@ class GenerationEngine:
         stat_set("gen/slots_active",
                  sum(g is not None for g in self._slot_gen))
 
+    def _tombstone_locked(self, gen_id: str) -> None:
+        """Remember a reaped generation id (bounded) so a late poll
+        gets the typed :class:`GenerationExpired`, not unknown-id."""
+        self._expired[gen_id] = time.monotonic()
+        while len(self._expired) > 256:        # oldest first (dict order)
+            self._expired.pop(next(iter(self._expired)))
+
     def _reap_expired(self) -> None:
         if self._ttl_s <= 0:
             return
@@ -835,12 +1156,22 @@ class GenerationEngine:
                        if now - max(g.created, g.last_poll) > self._ttl_s]
         for gen in expired:
             with self._cond:
-                g = self._gens.pop(gen.gen_id, None)
+                g = self._gens.get(gen.gen_id)
                 if g is None:
                     continue
+                # re-check under the lock: a poll that arrived while
+                # this reap was walking the candidates refreshed the
+                # TTL — it must keep its generation, not observe a
+                # half-reclaimed slot
+                if (time.monotonic() - max(g.created, g.last_poll)
+                        <= self._ttl_s):
+                    continue
+                self._gens.pop(g.gen_id, None)
+                self._tombstone_locked(g.gen_id)
                 if not g.done:
                     g.done = True
-                    g.error = "evicted: poll TTL exceeded (client gone?)"
+                    g.error = (f"{EXPIRED_MARKER} poll TTL exceeded "
+                               "(client gone?)")
                     self._release_slot_locked(g, evicted=True)
                     try:
                         self._queue.remove(g)
@@ -934,6 +1265,7 @@ class GenerationEngine:
             work = [(s, g) for s, g in enumerate(self._slot_gen)
                     if g is not None and g.prefilling]
             pt = None if not work else self._pt.copy()
+            epoch0 = self._epoch
         ticked = False
         for slot, gen in work:
             T0 = gen.prompt.size
@@ -948,20 +1280,33 @@ class GenerationEngine:
             bucket = min(self._bucket(b - a), smax - a)
             padded = np.full((bucket,), self._pad, np.int32)
             padded[:b - a] = gen.prompt[a:b]
+            key = jax.random.PRNGKey(gen.seed)
+            if gen.rng_skip:
+                from paddle_tpu.models.generation import advance_key
+                key = advance_key(key, gen.rng_skip)
             t0 = time.perf_counter()
-            with _trace.span("gen/prefill_chunk", slot=slot, index=a,
-                             tokens=b - a, final=final):
-                self._state, tok0 = self._prefill_fn(
-                    self._state, jnp.asarray(pt),
-                    jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
-                    jnp.asarray(a, jnp.int32),
-                    jnp.asarray(b - a, jnp.int32),
-                    jax.random.PRNGKey(gen.seed),
-                    jnp.asarray(gen.temperature, jnp.float32),
-                    jnp.asarray(gen.top_k, jnp.int32),
-                    jnp.asarray(gen.top_p, jnp.float32))
-                tok0 = int(tok0) if final else None
+            try:
+                with _trace.span("gen/prefill_chunk", slot=slot, index=a,
+                                 tokens=b - a, final=final):
+                    _fault.inject("engine.prefill")
+                    self._state, tok0 = self._prefill_fn(
+                        self._state, jnp.asarray(pt),
+                        jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
+                        jnp.asarray(a, jnp.int32),
+                        jnp.asarray(b - a, jnp.int32), key,
+                        jnp.asarray(gen.temperature, jnp.float32),
+                        jnp.asarray(gen.top_k, jnp.int32),
+                        jnp.asarray(gen.top_p, jnp.float32))
+                    tok0 = int(tok0) if final else None
+            except Exception as e:       # a prefill trap implicates
+                self._note_trap([gen], e)     # exactly this request
+                raise
             observe("gen/prefill_chunk_s", time.perf_counter() - t0)
+            self._last_beat = time.monotonic()
+            self._consec_traps = 0       # real device work succeeded
+            if self._epoch != epoch0:
+                raise _EpochChanged("prefill chunk outlived the "
+                                    "watchdog deadline")
             ticked = True
             with self._cond:
                 if self._slot_gen[slot] is not gen:
@@ -997,17 +1342,30 @@ class GenerationEngine:
         padded = np.full((bucket,), self._pad, np.int32)
         padded[:T0] = gen.prompt
         key = jax.random.PRNGKey(gen.seed)
+        if gen.rng_skip:
+            from paddle_tpu.models.generation import advance_key
+            key = advance_key(key, gen.rng_skip)
+        epoch0 = self._epoch
         t0 = time.perf_counter()
-        with _trace.span("gen/prefill", slot=slot, prompt_len=T0,
-                         bucket=bucket):
-            self._state, tok0 = self._prefill_fn(
-                self._state, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(padded), jnp.asarray(T0, jnp.int32), key,
-                jnp.asarray(gen.temperature, jnp.float32),
-                jnp.asarray(gen.top_k, jnp.int32),
-                jnp.asarray(gen.top_p, jnp.float32))
-            tok0 = int(tok0)
+        try:
+            with _trace.span("gen/prefill", slot=slot, prompt_len=T0,
+                             bucket=bucket):
+                _fault.inject("engine.prefill")
+                self._state, tok0 = self._prefill_fn(
+                    self._state, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(padded), jnp.asarray(T0, jnp.int32), key,
+                    jnp.asarray(gen.temperature, jnp.float32),
+                    jnp.asarray(gen.top_k, jnp.int32),
+                    jnp.asarray(gen.top_p, jnp.float32))
+                tok0 = int(tok0)
+        except Exception as e:           # a prefill trap implicates
+            self._note_trap([gen], e)         # exactly this request
+            raise
         observe("gen/prefill_s", time.perf_counter() - t0)
+        self._last_beat = time.monotonic()
+        self._consec_traps = 0           # real device work succeeded
+        if self._epoch != epoch0:
+            raise _EpochChanged("prefill outlived the watchdog deadline")
         with self._cond:
             if self._slot_gen[slot] is not gen:   # cancelled mid-prefill
                 return
@@ -1031,17 +1389,31 @@ class GenerationEngine:
             for s, _ in stepped:
                 active[s] = True
             pt = None if not self._paged else self._pt.copy()
+            epoch0 = self._epoch
         t0 = time.perf_counter()
-        with _trace.span("gen/decode_step", active=len(stepped)):
-            if self._paged:
-                self._state, toks = self._step(self._state,
-                                               jnp.asarray(pt),
-                                               jnp.asarray(active))
-            else:
-                self._state, toks = self._step(self._state,
-                                               jnp.asarray(active))
-            toks = np.asarray(toks)
+        try:
+            with _trace.span("gen/decode_step", active=len(stepped)):
+                _fault.inject("engine.decode_step")
+                if self._paged:
+                    self._state, toks = self._step(self._state,
+                                                   jnp.asarray(pt),
+                                                   jnp.asarray(active))
+                else:
+                    self._state, toks = self._step(self._state,
+                                                   jnp.asarray(active))
+                toks = np.asarray(toks)
+        except Exception as e:
+            # the fused step shares one compiled call: every stepped
+            # generation is implicated (co-tenant counts — see
+            # _note_trap's threshold note)
+            self._note_trap([g for _, g in stepped], e)
+            raise
         observe("gen/decode_step_s", time.perf_counter() - t0)
+        self._last_beat = time.monotonic()
+        self._consec_traps = 0           # real device work succeeded
+        if self._epoch != epoch0:
+            raise _EpochChanged("decode step outlived the watchdog "
+                                "deadline")
         with self._cond:
             emitted = 0
             for s, gen in stepped:

@@ -13,6 +13,15 @@ like.
 ``FLAGS_*`` environment variables apply as usual (the flag registry
 reads them at import), so a spawner can configure batching, caps, and
 timeouts per fleet through the child environment.
+
+``--gen NAME`` additionally registers a continuous-batching generation
+engine under ``NAME``, over a deterministically seeded tiny-Llama
+(``--gen-seed``, fixed config): every replica spawned with the same
+seed holds byte-identical weights, so greedy streams are comparable —
+and resumable — ACROSS replicas without shipping an artifact. This is
+the chaos/test path for killing a subprocess replica that holds a live
+stream (``tools/chaos_check.py gen-resilience``); real deployments
+register generators in their own entry point.
 """
 
 from __future__ import annotations
@@ -35,6 +44,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--port", type=int, default=0,
                     help="0 picks a free port (the default — the spawner "
                          "reads the ENDPOINT line)")
+    ap.add_argument("--gen", default=None, metavar="NAME",
+                    help="register a generation engine under NAME over a "
+                         "deterministically seeded tiny-Llama (chaos/test "
+                         "replicas; same --gen-seed => same weights on "
+                         "every replica)")
+    ap.add_argument("--gen-seed", type=int, default=7)
+    ap.add_argument("--gen-slots", type=int, default=2)
+    ap.add_argument("--gen-max-len", type=int, default=32)
+    ap.add_argument("--gen-step-wait-s", type=float, default=0.0,
+                    help="engine pacing knob (slows decode so chaos "
+                         "harnesses can kill a replica mid-stream)")
+    ap.add_argument("--gen-paged", action="store_true",
+                    help="paged KV cache for the --gen engine")
+    ap.add_argument("--gen-page-tokens", type=int, default=8)
     args = ap.parse_args(argv)
 
     from paddle_tpu.core.flags import flag
@@ -47,7 +70,22 @@ def main(argv: list[str] | None = None) -> int:
             ap.error(f"bad model spec {spec!r}; expected name=path")
         models[name] = path
 
-    srv = InferenceServer(models, host=args.host, port=args.port).start()
+    srv = InferenceServer(models, host=args.host, port=args.port)
+    if args.gen:
+        import paddle_tpu
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle_tpu.seed(args.gen_seed)
+        cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32,
+                               num_layers=2, num_heads=2, num_kv_heads=2,
+                               max_seq_len=64)
+        srv.add_generator(args.gen, LlamaForCausalLM(cfg),
+                          slots=args.gen_slots,
+                          max_len=args.gen_max_len,
+                          step_wait_s=args.gen_step_wait_s,
+                          paged=args.gen_paged,
+                          page_tokens=args.gen_page_tokens)
+    srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
 
     def _term(signum, frame):        # scheduler preemption: drain, exit

@@ -31,14 +31,30 @@ excludes a replica from every new pick — routed AND session — while its
 pooled connections stay open, so in-flight streams finish on the replica
 that holds their state; ``remove_endpoint`` then finalizes.
 
+Stream resumption (``FLAGS_gen_resume_budget``, hard-off): with a
+budget set, a generation stream that loses its replica mid-flight —
+connection loss, replica death, or a server-side engine reset (the
+``engine reset:`` error marker) — is transparently restarted on a
+freshly picked replica by replaying ``prompt + tokens already
+delivered`` as a prefill-from-prefix (cheap when the radix prefix cache
+shares the replayed prefix) and continues emitting from where it broke:
+byte-identical for greedy decode, RNG-position-replayed for sampled
+streams (the engine's ``rng_skip``). Exhausting the budget surfaces the
+typed :class:`StreamResumeExhausted`; a
+:class:`~paddle_tpu.serving.engine.RequestQuarantined` rejection is
+final and never resumed — a poisoned request must not be walked across
+the fleet.
+
 Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
 ``serving/router/marked_down``, ``serving/router/recovered``,
-``serving/router/cordoned``, ``serving/router/uncordoned``.
+``serving/router/cordoned``, ``serving/router/uncordoned``,
+``serving/router/stream_resumes``, ``serving/router/resume_exhausted``.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 import zlib
 from typing import Callable
@@ -49,9 +65,12 @@ from paddle_tpu.core.flags import flag
 from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.core.wire import FrameClient, WireShedError
 from paddle_tpu.io.serving import InferenceClient
+from paddle_tpu.serving.engine import (
+    EXPIRED_MARKER, RESET_MARKER, GenerationExpired,
+)
 
 __all__ = ["RoutedClient", "ReplicaState", "StickySession",
-           "GenerationFailed"]
+           "GenerationFailed", "StreamResumeExhausted"]
 
 
 class GenerationFailed(ConnectionError):
@@ -60,11 +79,24 @@ class GenerationFailed(ConnectionError):
     stream) lives on exactly one replica, so rerouting a poll would
     return "unknown generation" and rerouting a start would leak a slot.
     ``endpoint`` names the replica so the caller can restart the
-    generation elsewhere."""
+    generation elsewhere (or let stream resumption do it:
+    ``FLAGS_gen_resume_budget``)."""
 
     def __init__(self, msg: str, endpoint: str):
         super().__init__(msg)
         self.endpoint = endpoint
+
+
+class StreamResumeExhausted(GenerationFailed):
+    """Stream resumption gave up: the generation lost its replica more
+    times than ``FLAGS_gen_resume_budget`` allows. ``attempts`` counts
+    the restarts tried; ``endpoint`` is the last replica that failed.
+    Tokens already yielded to the caller remain valid — the stream is
+    merely incomplete."""
+
+    def __init__(self, msg: str, endpoint: str, attempts: int = 0):
+        super().__init__(msg, endpoint)
+        self.attempts = attempts
 
 
 class ReplicaState:
@@ -337,7 +369,10 @@ class RoutedClient:
 
     def generate(self, model: str, prompt, max_new_tokens: int, **kw):
         """Streaming generation through a fresh sticky session (see
-        :meth:`session` for multi-op affinity)."""
+        :meth:`session` for multi-op affinity). With
+        ``FLAGS_gen_resume_budget`` (or ``resume_budget=``) set, the
+        stream survives mid-flight replica loss by resuming on a fresh
+        replica — byte-identical for greedy decode."""
         return self.session().generate(model, prompt, max_new_tokens,
                                        **kw)
 
@@ -408,20 +443,25 @@ class RoutedClient:
         return out
 
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False) -> dict[str, dict]:
+               histograms: bool = False,
+               deep: bool = False) -> dict[str, dict]:
         """endpoint -> server health snapshot (unreachable replicas map
         to ``{"status": "unreachable", ...}``); covers cordoned members
         too — the control plane watches a draining victim's in-flight
         work through exactly this. ``stats_prefix``/``histograms`` pass
         through to each server's health op (raw-bucket histograms merge
-        fleet-wide via ``monitor.merge_histograms``)."""
+        fleet-wide via ``monitor.merge_histograms``); ``deep`` asks each
+        replica to run a one-token canary decode per generator — engine
+        liveness ("device healthy") as distinct from the wire liveness
+        ("port open") the shallow probe measures."""
         out = {}
         for r in list(self._replicas):
             ok, err = self._probe_one(r.endpoint)
             if ok:
                 try:
                     out[r.endpoint] = self._client(r).health(
-                        stats_prefix=stats_prefix, histograms=histograms)
+                        stats_prefix=stats_prefix, histograms=histograms,
+                        deep=deep)
                     continue
                 except (ConnectionError, RuntimeError, OSError) as e:
                     err = f"{type(e).__name__}: {e}"
@@ -544,17 +584,51 @@ class StickySession:
     def generate(self, model: str, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: int | None = None,
-                 seed: int = 0, poll_wait_s: float = 0.25):
+                 seed: int = 0, poll_wait_s: float = 0.25,
+                 resume_budget: int | None = None):
         """Streaming generation pinned to the session's replica: start,
         every poll, and the close-time cancel all hit the replica
-        holding the slot. Returns an iterator of token ids."""
+        holding the slot. Returns an iterator of token ids.
+
+        ``resume_budget`` (default: ``FLAGS_gen_resume_budget``) turns
+        on lossless stream resumption: when the stream breaks mid-flight
+        — connection loss, replica death, or a server-side engine reset
+        — the session re-pins to a fresh healthy replica and replays
+        ``prompt + tokens already delivered`` as a prefill-from-prefix
+        (``rng_skip`` replays the sampling-RNG position), continuing the
+        stream from where it broke; greedy output is byte-identical to
+        an uninterrupted run. More than ``resume_budget`` restarts
+        surfaces the typed :class:`StreamResumeExhausted`. A
+        :class:`~paddle_tpu.serving.engine.RequestQuarantined` rejection
+        is never resumed. Budget 0 — the flag default — keeps the
+        original fail-loud behavior byte-identically."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        budget = (int(flag("gen_resume_budget")) if resume_budget is None
+                  else int(resume_budget))
+        kw = dict(temperature=temperature, top_k=top_k, top_p=top_p,
+                  eos_token_id=eos_token_id, seed=seed,
+                  poll_wait_s=poll_wait_s)
+        if budget <= 0:
+            return self._stream_once(model, prompt, max_new_tokens, **kw)
+        return self._resuming_stream(model, prompt, max_new_tokens,
+                                     budget=budget, **kw)
+
+    def _stream_once(self, model: str, prompt, max_new_tokens: int, *,
+                     temperature: float, top_k: int, top_p: float,
+                     eos_token_id: int | None, seed: int,
+                     poll_wait_s: float, rng_skip: int = 0):
+        """One pinned stream attempt (the pre-resumption ``generate``
+        body). Server-side failures that lost the slot state but left
+        the replica up — the ``engine reset:`` marker — surface as
+        :class:`GenerationFailed` (resumable), a TTL reap as the typed
+        :class:`~paddle_tpu.serving.engine.GenerationExpired`."""
         client = self._client()
         ep = self._endpoint
         gen_id = self._wrap(
             lambda: client.generate_start(
                 model, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
-                seed=seed),
+                seed=seed, rng_skip=rng_skip),
             during_generation=True)
         with self._lock:
             self._active += 1
@@ -572,10 +646,22 @@ class StickySession:
                     n += len(doc["tokens"])
                     if doc["done"]:
                         finished = True
-                        if doc.get("error"):
+                        err = doc.get("error")
+                        if err:
+                            if RESET_MARKER in err:
+                                # slot state lost to a self-healing
+                                # engine reset; the replica is up —
+                                # resumable, never silently retried
+                                raise GenerationFailed(
+                                    f"generation {gen_id} on {ep} "
+                                    f"failed: {err}", ep or "?")
+                            if EXPIRED_MARKER in err:
+                                raise GenerationExpired(
+                                    f"generation {gen_id} on {ep}: "
+                                    f"{err}")
                             raise RuntimeError(
                                 f"generation {gen_id} on {ep} failed: "
-                                f"{doc['error']}")
+                                f"{err}")
                         return
             finally:
                 with self._lock:
@@ -587,3 +673,68 @@ class StickySession:
                         pass
 
         return stream()
+
+    def _resuming_stream(self, model: str, prompt, max_new_tokens: int,
+                         *, temperature: float, top_k: int, top_p: float,
+                         eos_token_id: int | None, seed: int,
+                         poll_wait_s: float, budget: int):
+        """Drive :meth:`_stream_once` attempts, replaying
+        ``prompt + delivered`` onto a freshly pinned replica after each
+        mid-flight loss, until the stream completes or the budget is
+        exhausted (typed :class:`StreamResumeExhausted`). Delivered
+        tokens are never re-yielded; greedy replays are byte-identical
+        by the engine's prefill-from-prefix determinism contract, and
+        sampled replays pass ``rng_skip=len(delivered)`` so the engine
+        fast-forwards the per-(prompt, seed) key schedule to the break
+        position."""
+        delivered: list[int] = []
+        attempts = 0
+        last: BaseException | None = None
+        while True:
+            n0 = len(delivered)
+            try:
+                if n0 == 0:
+                    inner = self._stream_once(
+                        model, prompt, max_new_tokens,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, eos_token_id=eos_token_id,
+                        seed=seed, poll_wait_s=poll_wait_s)
+                else:
+                    replay = np.concatenate(
+                        [prompt, np.asarray(delivered, np.int32)])
+                    inner = self._stream_once(
+                        model, replay, max_new_tokens - n0,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, eos_token_id=eos_token_id,
+                        seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0)
+                for tok in inner:
+                    delivered.append(int(tok))
+                    yield int(tok)
+                return
+            except StreamResumeExhausted:
+                raise
+            except GenerationFailed as e:
+                last = e
+            except (ConnectionError, TimeoutError, OSError) as e:
+                if attempts == 0 and n0 == 0:
+                    raise            # initial start errors keep their type
+                last = e             # restart-time failure: consume budget
+            if len(delivered) >= max_new_tokens or (
+                    eos_token_id is not None and delivered
+                    and delivered[-1] == int(eos_token_id)):
+                return               # broke after the final token: done
+            attempts += 1
+            if attempts > budget:
+                stat_add("serving/router/resume_exhausted")
+                raise StreamResumeExhausted(
+                    f"generation stream lost its replica {attempts} "
+                    f"time(s), past the resume budget "
+                    f"({budget}; FLAGS_gen_resume_budget) — "
+                    f"{len(delivered)}/{max_new_tokens} tokens were "
+                    f"delivered; last: {type(last).__name__}: {last}",
+                    getattr(last, "endpoint", None) or "?",
+                    attempts=attempts) from last
+            stat_add("serving/router/stream_resumes")
+            with self._lock:
+                self._endpoint = None    # re-pin over current membership
+            time.sleep(min(0.05 * attempts, 0.5))

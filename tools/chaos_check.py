@@ -46,6 +46,15 @@ Scenarios (all CPU-only, single process):
    ``generate()`` on the cordoned replica, zero ``GenerationFailed``,
    the drain is clean (not deadline-forced), and only then does the
    replica stop.
+10. **gen-resilience**: (a) the subprocess replica holding a LIVE
+    greedy stream is SIGKILLed under routed load — with a resume
+    budget the stream replays prompt + delivered tokens onto the
+    survivor and completes byte-identical to an uninterrupted solo
+    ``generate()``, zero ``GenerationFailed`` surfaces, and the
+    survivor's page pool drains back to full (zero leaked pages);
+    (b) a poison request that traps an engine is quarantined by crash
+    fingerprint — the typed ``RequestQuarantined`` surfaces through
+    the resuming client and the second replica never crashes.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost.
@@ -120,6 +129,17 @@ def check_defaults_off() -> None:
           and cpl["control_breach_ticks"] >= 1
           and cpl["control_idle_ticks"] >= cpl["control_breach_ticks"],
           str(cpl))
+    rz = get_flags(["gen_resume_budget", "gen_quarantine_after",
+                    "gen_engine_rebuilds", "gen_watchdog_s",
+                    "control_spawn_breaker", "control_spawn_backoff_s"])
+    check("defaults/gen_resilience_off",
+          rz["gen_resume_budget"] == 0            # no stream resumption
+          and rz["gen_quarantine_after"] == 0     # no quarantine books
+          and rz["gen_engine_rebuilds"] == 0      # trap still breaks
+          and rz["gen_watchdog_s"] == 0           # no watchdog thread
+          and rz["control_spawn_breaker"] == 0    # spawner never skipped
+          and rz["control_spawn_backoff_s"] > 0,  # sane base when opted in
+          str(rz))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -760,6 +780,126 @@ def scenario_control_plane(tmp: str) -> None:
         ctl2.close()
 
 
+def scenario_gen_resilience(tmp: str) -> None:
+    """(a) SIGKILL the subprocess replica holding a live greedy stream:
+    with a resume budget the routed stream completes byte-identical on
+    the survivor — zero GenerationFailed, zero leaked pages. (b) A
+    poison request that traps an engine is quarantined typed; the
+    second replica never crashes."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import (
+        GenerationEngine, RequestQuarantined, RoutedClient,
+        SubprocessSpawner,
+    )
+
+    # local reference weights: same seed + config as the --gen replicas
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    # -- (a) SIGKILL under a live stream; resume on the survivor --------
+    monitor.reset_stats("serving/router/")
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05",
+        "--gen-paged", "--gen-page-tokens", "8"))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(51)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 5:]
+        sess = router.session("kill-victim")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]          # the stream is live
+        victim = sess.endpoint
+        rider = router.session("rider")      # concurrent routed load
+        it2 = rider.generate("llm", prompt, 12, poll_wait_s=0.05,
+                             resume_budget=2)
+        toks2 = [next(it2)]
+        spawner.kill(victim)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes on the survivor
+            toks2 += list(it2)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("genres/stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref)
+              and np.array_equal(np.asarray(toks2, np.int32), ref),
+              f"err={err} toks={len(toks)}/{len(toks2)}")
+        check("genres/resume_counted_no_failure_surfaced",
+              err is None
+              and monitor.get_stat("serving/router/stream_resumes") >= 1
+              and monitor.get_stat("serving/router/resume_exhausted")
+              == 0,
+              str(monitor.export_stats("serving/router/")))
+        survivor = next(ep for ep in eps if ep != victim)
+        g = {}
+        with io.InferenceClient(survivor, timeout=5.0) as c:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                g = c.health()["generators"]["llm"]
+                if (g.get("active") == 0 and g.get("pages_free", 0)
+                        + g.get("prefix_entries", 0) == g.get("pages")):
+                    break
+                time.sleep(0.1)
+        check("genres/zero_leaked_pages_on_survivor",
+              g.get("pages_free", -1) + g.get("prefix_entries", 0)
+              == g.get("pages"), str(g))
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+
+    # -- (b) quarantined poison never crashes a second replica ----------
+    servers, engines = [], []
+    for _ in range(2):
+        eng = GenerationEngine(model, slots=1, max_len=32, rebuilds=4,
+                               quarantine_after=1)
+        srv = io.InferenceServer().start()
+        srv.add_generator("llm", eng)
+        servers.append(srv)
+        engines.append(eng)
+    router2 = RoutedClient([s.endpoint for s in servers],
+                           probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(52)
+        poison = rs.randint(0, 96, (4,)).astype(np.int32)
+        clean = rs.randint(0, 96, (4,)).astype(np.int32)
+        qerr, other = None, None
+        with fault.inject_faults({"engine.prefill": (1.0, 1)}):
+            try:
+                list(router2.session("poison").generate(
+                    "llm", poison, 4, poll_wait_s=0.05, resume_budget=3))
+            except RequestQuarantined as e:
+                qerr = e
+            except Exception as e:
+                other = f"{type(e).__name__}: {e}"
+        check("genres/quarantine_typed_giveup",
+              qerr is not None and other is None,
+              f"quarantined={qerr} other={other}")
+        check("genres/second_replica_never_crashed",
+              sum(e.stats()["rebuilds"] for e in engines) == 1
+              and all(e.stats()["broken"] is None for e in engines),
+              str([e.stats() for e in engines]))
+        ref = np.asarray(generate(model, clean[None], 3))[0, 4:]
+        toks = list(router2.generate("llm", clean, 3))
+        check("genres/fleet_serves_after_quarantine",
+              np.array_equal(np.asarray(toks, np.int32), ref),
+              str(toks))
+    finally:
+        router2.close()
+        for s in servers:
+            s.stop()
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -768,7 +908,7 @@ def main() -> int:
                          scenario_elastic_resume, scenario_overload,
                          scenario_obs, scenario_serving_routed,
                          scenario_gen_engine, scenario_gen_paged,
-                         scenario_control_plane):
+                         scenario_control_plane, scenario_gen_resilience):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
