@@ -251,6 +251,38 @@ define_flag("gen_watchdog_s", 0.0,
             "returns. Must comfortably exceed worst-case XLA compile "
             "time for the engine's buckets. 0 — the default — no "
             "watchdog thread at all")
+# --- speculative decoding (models/generation.py, serving/engine.py) ---
+define_flag("gen_spec_k", 0,
+            "Speculative-decoding draft length for the GenerationEngine: "
+            "a cheap drafter proposes up to k tokens that ONE batched "
+            "target forward verifies (accept the longest matching "
+            "prefix), turning k memory-bound decode steps into one "
+            "compute-denser step. Greedy output stays byte-identical to "
+            "non-speculative decode; sampled streams keep the one-split-"
+            "per-emitted-token key schedule, so rng_skip stream "
+            "resumption composes unchanged. 0 — the default — disables "
+            "speculation entirely: the engine compiles the PR-5 fused "
+            "step only and the decode path is byte-identical to the "
+            "pre-speculation build")
+define_flag("gen_spec_mode", "ngram",
+            "Drafter for speculative decoding: 'ngram' (model-free "
+            "prompt-lookup — propose the continuation of the most "
+            "recent prior occurrence of the stream's own suffix; zero "
+            "extra weights, the right default for serving) or 'draft' "
+            "(a small draft model with the same init_cache/"
+            "forward_with_cache contract, passed as draft_model= to "
+            "the engine). Ignored while gen_spec_k=0")
+define_flag("gen_spec_ngram", 3,
+            "Longest suffix n-gram the model-free drafter tries to "
+            "match against the stream's own prompt + emitted tokens "
+            "(falls back to shorter n-grams down to 1). Ignored unless "
+            "gen_spec_k > 0 and gen_spec_mode=ngram")
+define_flag("gen_spec_shed_occupancy", 0.5,
+            "Slot-occupancy fraction above which the engine sheds "
+            "speculation (per-slot draft budget drops to 0): batched "
+            "decode already fills the MXU under load, so speculative "
+            "extra FLOPs would only steal from co-tenants. Speculation "
+            "resumes as occupancy falls. Ignored while gen_spec_k=0")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

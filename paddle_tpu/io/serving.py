@@ -229,7 +229,13 @@ class InferenceServer(FrameService):
         ``prefix_entries``) + per-model usage stats (infer count,
         last-used timestamp/idle seconds, approx resident bytes), so
         routers, probes, and the serving control plane see generation
-        capacity and warm-tier residency without a dedicated op.
+        capacity and warm-tier residency without a dedicated op. Each
+        generator also ships ``tokens_per_step`` (emitted tokens per
+        fused decode iteration) and — on speculating engines
+        (``FLAGS_gen_spec_k>0``) — a ``spec`` block with the
+        proposed/accepted/rejected counts and ``accept_rate``, so the
+        control plane can see speculation efficiency next to slot
+        occupancy and tell a speculation win from a batching win.
         ``stats_prefix`` keeps filtering the monitor-stats snapshot
         only — the ``models``/``generators`` sections always ship (they
         are the decision inputs a control loop polls for). ``deep``

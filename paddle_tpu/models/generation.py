@@ -11,15 +11,28 @@ soon as every row has emitted EOS, so short completions stop paying for
 
 Works with any model exposing ``init_cache(B, S)`` and
 ``forward_with_cache(ids, cache, index)``.
+
+Speculative decoding (:func:`speculative_generate`, Leviathan et al.
+ICML '23): a cheap drafter — the model-free n-gram lookup of
+:func:`ngram_propose`, or a small draft model with the same cache
+contract — proposes k tokens, ONE multi-token target forward verifies
+them all, and the longest matching prefix is accepted. Greedy output is
+byte-identical to :func:`generate`; sampled output follows the same
+one-split-per-emitted-token key schedule, so a fixed ``key`` replays
+identically with speculation on or off. The serving engine
+(``serving/engine.py``) carries the batched, flag-gated version of the
+same algorithm.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
-           "paged_gather", "paged_scatter", "advance_key"]
+           "paged_gather", "paged_scatter", "advance_key", "ngram_propose",
+           "speculative_generate"]
 
 
 def advance_key(key, steps):
@@ -131,6 +144,171 @@ def paged_scatter(pool, table, chunk, index, page_tokens: int,
         data = jnp.moveaxis(ch[:, 0], 2, 0)   # [T, L, Hkv, *rest]
         out.append(leaf.at[pages, :, :, offs].set(data.astype(leaf.dtype)))
     return tuple(out)
+
+
+def ngram_propose(context, k: int, *, max_ngram: int = 3,
+                  min_ngram: int = 1) -> np.ndarray:
+    """Model-free draft proposal by suffix n-gram lookup ("Prompt
+    Lookup Decoding"): find a PRIOR occurrence of the stream's own
+    trailing n-gram inside ``context`` (prompt + emitted tokens) and
+    propose the up-to-``k`` tokens that followed it — the most recent
+    occurrence with a full ``k``-token continuation, else the one with
+    the longest continuation (a recent match truncated by the context
+    edge drafts almost nothing exactly when the stream is looping and
+    a full draft would be nearly free). Tries ``max_ngram`` down to
+    ``min_ngram``; returns an int32 array of 0..k proposed tokens (0 =
+    no match — the caller falls back to a plain decode step). Host-side
+    numpy, O(len(context)) per n tried — zero extra weights, zero
+    device work."""
+    ctx = np.asarray(context, np.int64).reshape(-1)
+    k = int(k)
+    if k <= 0 or ctx.size < min_ngram + 1:
+        return np.zeros((0,), np.int32)
+    for n in range(min(max_ngram, ctx.size - 1), min_ngram - 1, -1):
+        suffix = ctx[ctx.size - n:]
+        # candidate starts 0 .. ctx.size-1-n: every window has at least
+        # one continuation token, and the suffix occurrence itself
+        # (start ctx.size-n) is excluded
+        windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        if hits.size:
+            full = hits[hits + n + k <= ctx.size]
+            s = int(full[-1]) if full.size else int(hits[0])
+            return ctx[s + n:s + n + k].astype(np.int32)
+    return np.zeros((0,), np.int32)
+
+
+def _draft_model_propose(draft_model, context, k: int,
+                         cache_dtype=None) -> np.ndarray:
+    """Greedy k-token lookahead from a small draft model sharing the
+    ``init_cache``/``forward_with_cache`` contract: prefill the full
+    context, then argmax-decode ``k`` tokens. Eager (re-prefills per
+    call) — the jitted/bucketed variant lives in the serving engine."""
+    ctx = np.asarray(context, np.int32).reshape(1, -1)
+    T = ctx.shape[1]
+    k = int(k)
+    if k <= 0:
+        return np.zeros((0,), np.int32)
+    cache = draft_model.init_cache(1, T + k, dtype=cache_dtype)
+    logits, cache = draft_model.forward_with_cache(
+        jnp.asarray(ctx), cache, index=0)
+    tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+    out = [int(tok)]
+    for i in range(k - 1):
+        logits, cache = draft_model.forward_with_cache(
+            tok[None, None], cache, index=T + i)
+        tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+        out.append(int(tok))
+    return np.asarray(out, np.int32)
+
+
+def speculative_generate(model, input_ids, max_new_tokens: int, *,
+                         spec_k: int = 4, draft_model=None,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 1.0, eos_token_id: int | None = None,
+                         pad_token_id: int = 0, key=None, cache_dtype=None,
+                         max_ngram: int = 3):
+    """Speculative decode for ONE sequence — same output contract as
+    :func:`generate` (shape [1, T0 + max_new_tokens], pad-filled past
+    EOS) with fewer serial target-model forwards.
+
+    Per round: the drafter (``draft_model`` if given, else
+    :func:`ngram_propose` over the sequence's own prompt + emitted
+    tokens) proposes up to ``spec_k`` tokens; ONE target forward over
+    ``[pending, d_1..d_m]`` at the current position yields the target's
+    pick at every proposed position; the longest prefix of drafts
+    matching those picks is accepted, plus the target's own pick at the
+    first mismatch — so each round emits 1..m+1 tokens and every
+    emitted token is EXACTLY what non-speculative decode would have
+    produced (greedy byte-identity; sampled picks are deterministic per
+    key because each position's pick uses its scheduled subkey).
+
+    RNG contract: one ``key, sub = jax.random.split(key)`` is consumed
+    per EMITTED token regardless of acceptance pattern — the
+    :func:`generate` /serving-engine schedule — so speculative and
+    non-speculative runs replay identically and ``advance_key``-based
+    stream resumption composes unchanged.
+
+    Rollback: rejected drafts were written into cache positions at or
+    past the new decode position; attention masks every position at or
+    past the forward index (see ``models/_common.cached_attention``),
+    and later writes overwrite them, so rollback is pure position-
+    pointer arithmetic. The cache carries ``spec_k`` scratch positions
+    past ``T0 + max_new_tokens`` so a full-width verify near the end of
+    generation stays in bounds.
+
+    Host-driven and eager (one device sync per round) — the reference
+    implementation the tests pin the serving engine's compiled path
+    against."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, T0 = input_ids.shape
+    if B != 1:
+        raise ValueError(
+            f"speculative_generate handles one sequence (got batch {B}); "
+            "per-row acceptance lengths desynchronize a shared cache "
+            "index — use the serving engine for batched speculation")
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens <= 0:
+        return input_ids
+    spec_k = max(int(spec_k), 0)
+    S = T0 + max_new_tokens + spec_k          # spec_k scratch tail
+    cache = model.init_cache(1, S, dtype=cache_dtype)
+    logits, cache = model.forward_with_cache(input_ids, cache, index=0)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def pick(row_logits, key):
+        return int(sample_logits(
+            row_logits[None], None if temperature == 0.0 else key,
+            temperature=temperature, top_k=top_k, top_p=top_p)[0])
+
+    key, sub = jax.random.split(key)
+    pending = pick(logits[0, T0 - 1], sub)
+    emitted = [pending]
+    finished = eos_token_id is not None and pending == eos_token_id
+    prompt_np = np.asarray(input_ids[0])
+    pos = T0                                  # pending not yet in cache
+
+    while len(emitted) < max_new_tokens and not finished:
+        remaining = max_new_tokens - len(emitted)
+        budget = min(spec_k, remaining - 1)
+        draft = np.zeros((0,), np.int32)
+        if budget > 0:
+            ctx = np.concatenate(
+                [prompt_np, np.asarray(emitted, np.int32)])
+            draft = (_draft_model_propose(draft_model, ctx, budget,
+                                          cache_dtype=cache_dtype)
+                     if draft_model is not None
+                     else ngram_propose(ctx, budget, max_ngram=max_ngram))
+        ids = np.concatenate(
+            [np.asarray([pending], np.int32), draft])[None]
+        logits, cache = model.forward_with_cache(
+            jnp.asarray(ids), cache, index=pos)
+        # prospective per-position picks: position i's pick uses the
+        # subkey of the (i+1)-th split past the current key, but only
+        # the splits of ACCEPTED (emitted) tokens are committed below
+        chain, cur, picks = [], key, []
+        for i in range(ids.shape[1]):
+            cur, sub = jax.random.split(cur)
+            chain.append(cur)
+            picks.append(pick(logits[0, i], sub))
+        accept = 0
+        while accept < draft.size and picks[accept] == int(draft[accept]):
+            accept += 1
+        new_toks = [int(t) for t in draft[:accept]] + [picks[accept]]
+        for t in new_toks:
+            emitted.append(t)
+            if eos_token_id is not None and t == eos_token_id:
+                finished = True
+                break
+        pos += accept + 1
+        pending = picks[accept]
+        key = chain[accept]                  # one split per emitted token
+
+    seq = np.full((1, T0 + max_new_tokens), pad_token_id, np.int32)
+    seq[0, :T0] = prompt_np
+    seq[0, T0:T0 + len(emitted)] = emitted
+    return jnp.asarray(seq)
 
 
 def generate(model, input_ids, max_new_tokens: int, *,

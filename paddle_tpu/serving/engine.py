@@ -43,6 +43,34 @@ paged cache, SOSP '23) to the framework's autoregressive path:
   (``FLAGS_gen_prefill_chunk``) admits long prompts in token slices
   interleaved with decode steps, so active streams keep emitting
   during a long prefill instead of stalling behind it.
+- **Speculative decoding** (``FLAGS_gen_spec_k``, off by default).
+  Decode is memory-bandwidth-bound, so the only way past the roofline
+  is fewer serial target-model steps: a cheap drafter proposes up to
+  ``k`` tokens per slot — the model-free n-gram lookup of
+  ``models.generation.ngram_propose`` (``FLAGS_gen_spec_mode=ngram``,
+  zero extra weights) or a small draft model sharing the cache
+  contract (``mode=draft``, ``draft_model=``) — and ONE fused verify
+  forward of the target model over the ``k+1`` proposed positions
+  (the multi-token prefill machinery) yields the target's pick at
+  every position; the longest matching draft prefix is accepted plus
+  the target's own pick at the first mismatch, so a slot emits 1..k+1
+  tokens per step and every emitted token is exactly what
+  non-speculative decode would produce. Rejected drafts roll back by
+  position-pointer arithmetic (contiguous mode: attention masks
+  positions at/past the decode index, later writes overwrite them;
+  paged mode: rejected in-page offsets are scattered to the null page
+  — refcount-safe truncation), and each generation reserves ``k``
+  scratch positions past its declared worst case so a full-width
+  verify near the end of generation stays in bounds. Speculation is
+  per-slot and load-adaptive: the draft budget sheds to 0 above
+  ``FLAGS_gen_spec_shed_occupancy`` slot occupancy (batched decode
+  already fills the MXU then), and mixed speculating/non-speculating
+  slots coexist in one compiled verify call (draft length 0 = a plain
+  step for that slot; an all-shed iteration runs the original fused
+  step unchanged). One ``key`` split is consumed per EMITTED token
+  regardless of acceptance pattern, so sampled streams replay
+  identically with speculation on or off and ``rng_skip`` stream
+  resumption composes unchanged.
 
 Determinism: a greedy (``temperature=0``) generation through the engine
 is byte-identical to a solo :func:`paddle_tpu.models.generation.generate`
@@ -73,7 +101,13 @@ one of these paths deterministically testable.
 Observability: ``gen/slots_active`` / ``gen/queue_depth`` /
 ``gen/pages_free`` gauges, ``gen/prefill_s`` / ``gen/prefill_chunk_s`` /
 ``gen/decode_step_s`` / ``gen/ttft_s`` (enqueue → first token — the
-autoscaling SLO signal) histograms, ``gen/tokens`` / ``gen/evictions`` /
+autoscaling SLO signal) / ``gen/spec_verify_s`` (the fused verify
+forward) / ``gen/spec_accept_len`` (draft tokens accepted per verify)
+histograms, ``gen/spec_proposed`` / ``gen/spec_accepted`` /
+``gen/spec_rejected`` counters plus per-engine acceptance rate and
+``tokens_per_step`` in :meth:`~GenerationEngine.stats` (shipped in the
+serving ``health`` op next to slot occupancy, so the controller sees
+speculation efficiency), ``gen/tokens`` / ``gen/evictions`` /
 ``gen/prefix_hits`` / ``gen/prefix_tokens_saved`` /
 ``gen/prefix_evictions`` / ``gen/traps`` / ``gen/rebuilds`` /
 ``gen/stuck`` / ``gen/quarantined`` / ``gen/quarantine_rejected`` /
@@ -169,7 +203,7 @@ class Generation:
                  "done", "error", "slot", "created", "last_poll",
                  "cancelled", "pages", "shared", "prefilling",
                  "prefill_pos", "prefill_t0", "delivered", "fingerprint",
-                 "rng_skip")
+                 "rng_skip", "spec_proposed", "spec_accepted")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -207,6 +241,10 @@ class Generation:
             + f"|{temperature}|{top_k}|{top_p}|{seed}".encode()
         ).hexdigest()[:16]
         self.rng_skip = 0
+        # speculative-decoding acceptance accounting (draft tokens this
+        # generation proposed / had accepted; stays 0 with spec off)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class _PagePool:
@@ -414,7 +452,10 @@ class GenerationEngine:
                  prefix_cache: bool | None = None,
                  quarantine_after: int | None = None,
                  rebuilds: int | None = None,
-                 watchdog_s: float | None = None):
+                 watchdog_s: float | None = None,
+                 spec_k: int | None = None, spec_mode: str | None = None,
+                 draft_model=None, spec_ngram: int | None = None,
+                 spec_shed_occupancy: float | None = None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -453,6 +494,41 @@ class GenerationEngine:
                                 if rebuilds is None else rebuilds)
         self._watchdog_s = float(flag("gen_watchdog_s")
                                  if watchdog_s is None else watchdog_s)
+        # speculative decoding (hard-off by default: gen_spec_k=0 keeps
+        # the compiled surface and decode path byte-identical to the
+        # pre-speculation build — flags are read HERE only, never on
+        # the data path)
+        self._spec_k = int(flag("gen_spec_k") if spec_k is None
+                           else spec_k)
+        self._spec_mode = str(flag("gen_spec_mode") if spec_mode is None
+                              else spec_mode)
+        self._spec_ngram = int(flag("gen_spec_ngram") if spec_ngram is None
+                               else spec_ngram)
+        self._spec_shed = float(flag("gen_spec_shed_occupancy")
+                                if spec_shed_occupancy is None
+                                else spec_shed_occupancy)
+        self._draft_model = draft_model
+        if self._spec_k > 0:
+            if self._spec_mode not in ("ngram", "draft"):
+                raise ValueError(
+                    f"unknown gen_spec_mode {self._spec_mode!r}; expected "
+                    "'ngram' or 'draft'")
+            if self._spec_mode == "draft" and draft_model is None:
+                raise ValueError(
+                    "gen_spec_mode=draft needs a draft_model= (any model "
+                    "with the init_cache/forward_with_cache contract)")
+        else:
+            self._spec_mode = "off"
+        # per-bucket compiled draft-model proposers (mode=draft only)
+        self._draft_fns: dict[int, Any] = {}
+        # tokens_per_step books: decode-step emitted tokens over decode
+        # iterations — distinguishes speculation wins (>1 per slot-step)
+        # from batching wins; spec acceptance totals ride along
+        self._emit_total = 0
+        self._decode_iters = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_verify_steps = 0
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -482,9 +558,13 @@ class GenerationEngine:
         if self._paged:
             self._step = self._build_paged_step()
             self._prefill_fn = self._build_paged_prefill()
+            self._spec_step = (self._build_paged_spec_step()
+                               if self._spec_k > 0 else None)
         else:
             self._step = self._build_step()
             self._prefill_fn = self._build_prefill()
+            self._spec_step = (self._build_spec_step()
+                               if self._spec_k > 0 else None)
 
         self._cond = threading.Condition()
         self._queue: deque[Generation] = deque()
@@ -697,6 +777,186 @@ class GenerationEngine:
 
         return jax.jit(prefill, donate_argnums=(0,))
 
+    def _spec_pick_accept(self, jax, jnp, logits, key, temp, top_k, top_p,
+                          draft, dlen):
+        """Shared verify core of both spec steps (traced, per slot):
+        compute the target's pick at every one of the K+1 forwarded
+        positions — position ``i``'s pick drawing from the subkey of the
+        ``i+1``-th split past the slot key, the exact per-emitted-token
+        schedule — then accept the longest draft prefix matching those
+        picks. Returns ``(out [K+1], emit, new_key)`` where
+        ``out[:emit]`` are the emitted tokens (accepted drafts + the
+        target's pick at the first mismatch) and ``new_key`` is the slot
+        key advanced by exactly ``emit`` splits, so a slot's key
+        schedule is indistinguishable from ``emit`` plain steps."""
+        K = self._spec_k
+        keys, subs, cur = [], [], key
+        for _ in range(K + 1):
+            cur, sub = jax.random.split(cur)
+            keys.append(cur)
+            subs.append(sub)
+        picks = jnp.stack([
+            _sample_slot(logits[i], subs[i], temp, top_k, top_p)
+            for i in range(K + 1)])                          # [K+1]
+        good = (picks[:K] == draft) & (jnp.arange(K) < dlen)
+        acc = jnp.sum(jnp.cumprod(good.astype(jnp.int32)))
+        j = jnp.arange(K + 1)
+        out = jnp.where(j < acc, jnp.concatenate([draft, draft[-1:]]),
+                        picks)
+        new_key = jnp.stack(keys)[acc]       # acc+1 = emit splits in
+        return out, acc + 1, new_key
+
+    def _build_spec_step(self):
+        """ONE fused speculative verify for all slots (contiguous mode):
+        each slot forwards ``[pending, draft_1..draft_K]`` at its
+        position — the multi-token prefill machinery — and accepts the
+        longest draft prefix matching the target's per-position picks.
+        Mixed speculating/non-speculating slots coexist: draft length 0
+        degrades to a plain single-token step for that slot (identical
+        pick at position 0; causal masking makes the extra positions
+        inert). Rollback is position-pointer arithmetic: rejected-draft
+        KV sits at positions >= the new decode index, which attention
+        masks and later writes overwrite; admission reserved ``spec_k``
+        scratch positions so the fixed K+1 write window never clamps."""
+        import jax
+        import jax.numpy as jnp
+
+        model, slots = self._model, self.slots
+
+        def one(cache, tok, idx, key, temp, top_k, top_p, draft, dlen):
+            ids = jnp.concatenate([tok[None], draft])[None]   # [1, K+1]
+            logits, cache = model.forward_with_cache(ids, cache,
+                                                     index=idx)
+            out, emit, new_key = self._spec_pick_accept(
+                jax, jnp, logits[0], key, temp, top_k, top_p, draft,
+                dlen)
+            return cache, out, emit, new_key
+
+        def step(state, active, drafts, dlens):
+            cache, out, emit, keys = jax.vmap(one)(
+                state["cache"], state["tok"], state["pos"], state["keys"],
+                state["temp"], state["top_k"], state["top_p"], drafts,
+                dlens)
+            emit = jnp.where(active, emit, 0)
+            last = jnp.take_along_axis(
+                out, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
+            tok = jnp.where(active, last, state["tok"])
+            pos = state["pos"] + emit
+            return dict(state, cache=cache, tok=tok, pos=pos,
+                        keys=keys), out, emit
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _build_paged_spec_step(self):
+        """Speculative verify in paged mode: gather each slot's pages,
+        forward the K+1-token window, then scatter ONLY the emitted
+        positions back through the page table — the rejected tail is
+        redirected to the null page (page-refcount-safe truncation:
+        rejected drafts never land in a live page, so rollback cannot
+        interact with prefix-shared pages or refcounts)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import paged_gather
+
+        model, P, maxp = self._model, self._page_tokens, self._maxp
+        K = self._spec_k
+
+        def one(pt_row, tok, idx, key, temp, top_k, top_p, draft, dlen,
+                pool):
+            cache = paged_gather(pool, pt_row)
+            ids = jnp.concatenate([tok[None], draft])[None]
+            logits, cache = model.forward_with_cache(ids, cache,
+                                                     index=idx)
+            chunk = tuple(
+                jax.lax.dynamic_slice_in_dim(c, idx, K + 1, axis=3)[:, 0]
+                for c in cache)               # [L, Hkv, K+1, *rest]
+            out, emit, new_key = self._spec_pick_accept(
+                jax, jnp, logits[0], key, temp, top_k, top_p, draft,
+                dlen)
+            return out, emit, new_key, chunk
+
+        def step(state, pt, active, drafts, dlens):
+            pool = state["cache"]
+            out, emit, keys, chunks = jax.vmap(
+                one, in_axes=(0,) * 9 + (None,))(
+                pt, state["tok"], state["pos"], state["keys"],
+                state["temp"], state["top_k"], state["top_p"], drafts,
+                dlens, pool)
+            emit = jnp.where(active, emit, 0)
+            j = jnp.arange(K + 1)
+            pos = state["pos"][:, None] + j[None, :]      # [slots, K+1]
+            pidx = jnp.clip(pos // P, 0, maxp - 1)
+            pages = jnp.take_along_axis(pt, pidx, axis=1)
+            # truncation: positions past the accept point (and every
+            # position of inactive slots, emit 0) go to the null page
+            pages = jnp.where(j[None, :] < emit[:, None], pages, 0)
+            offs = pos % P
+            pool = tuple(
+                buf.at[pages, :, :, offs].set(
+                    jnp.moveaxis(ch, 3, 1).astype(buf.dtype))
+                for buf, ch in zip(pool, chunks))
+            last = jnp.take_along_axis(
+                out, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
+            tok = jnp.where(active, last, state["tok"])
+            pos1 = state["pos"] + emit
+            return dict(state, cache=pool, tok=tok, pos=pos1,
+                        keys=keys), out, emit
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # -- drafters (host side) ----------------------------------------------
+    def _propose(self, ctx: np.ndarray, cap: int) -> np.ndarray:
+        """Draft up to ``cap`` tokens for one slot from its own context
+        (prompt + emitted tokens so far). May return fewer (or none —
+        the slot then takes a plain step this iteration)."""
+        if self._spec_mode == "draft":
+            return self._draft_propose(ctx, cap)
+        from paddle_tpu.models.generation import ngram_propose
+        return ngram_propose(ctx, cap, max_ngram=self._spec_ngram)
+
+    def _draft_propose(self, ctx: np.ndarray, cap: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        T = int(ctx.size)
+        bucket = self._bucket(T)
+        fn = self._draft_fns.get(bucket)
+        if fn is None:
+            fn = self._draft_fns[bucket] = self._build_draft_fn(bucket)
+        padded = np.full((bucket,), self._pad, np.int32)
+        padded[:T] = ctx
+        out = np.asarray(fn(jnp.asarray(padded),
+                            jnp.asarray(T, jnp.int32)))
+        return out[:cap]
+
+    def _build_draft_fn(self, bucket: int):
+        """Compiled greedy K-token lookahead of the draft model over a
+        right-padded context bucket (one compile per pow-2 bucket, the
+        prefill discipline): prefill the context, then argmax-decode K
+        tokens against the draft's own scratch cache. The draft cache is
+        call-local — the draft never holds persistent per-slot state, so
+        engine rebuilds and slot churn cannot desynchronize it."""
+        import jax
+        import jax.numpy as jnp
+
+        draft, K, dtype = self._draft_model, self._spec_k, self._cache_dtype
+
+        def fn(padded, true_len):
+            cache = draft.init_cache(1, bucket + K, dtype=dtype)
+            logits, cache = draft.forward_with_cache(padded[None], cache,
+                                                     index=0)
+            tok = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
+            out = [tok]
+            idx = jnp.asarray(true_len, jnp.int32)
+            for i in range(K - 1):
+                logits, cache = draft.forward_with_cache(
+                    tok[None, None], cache, index=idx + i)
+                tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+                out.append(tok)
+            return jnp.stack(out)
+
+        return jax.jit(fn)
+
     def _bucket(self, n: int) -> int:
         b = self._min_bucket
         while b < n:
@@ -725,13 +985,24 @@ class GenerationEngine:
         rng_skip = int(rng_skip)
         if rng_skip < 0:
             raise ValueError("rng_skip must be >= 0")
-        if prompt.size + max_new_tokens > self.max_len:
+        # with speculation on, a slot's verify step writes a fixed
+        # K+1-token window at the decode position — the last emitted
+        # token can sit at prompt+max_new-1, so spec_k scratch positions
+        # past the declared worst case keep that write in bounds
+        # (dynamic_update_slice clamps its start; an out-of-bounds
+        # window would silently shift live positions)
+        reserve = prompt.size + max_new_tokens + self._spec_k
+        if reserve > self.max_len:
+            spec = (f" + spec_k ({self._spec_k}) scratch"
+                    if self._spec_k else "")
+            fix = (" or lower FLAGS_gen_spec_k" if self._spec_k else "")
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds the engine's per-slot "
-                f"capacity ({self.max_len}); raise FLAGS_gen_max_len")
+                f"({max_new_tokens}){spec} exceeds the engine's per-slot "
+                f"capacity ({self.max_len}); raise FLAGS_gen_max_len"
+                + fix)
         if self._paged:
-            need = -(-(prompt.size + max_new_tokens) // self._page_tokens)
+            need = -(-reserve // self._page_tokens)
             if need > self._pool.num_pages:
                 raise ValueError(
                     f"request needs {need} pages but the pool only has "
@@ -869,7 +1140,27 @@ class GenerationEngine:
                    "stuck": self._stuck,
                    "rebuilds": self._rebuilds,
                    "quarantined": len(self._quarantined),
+                   # emitted tokens per fused decode iteration: >1.0
+                   # means speculation is landing (batching wins show up
+                   # in aggregate tokens/s, not here — this isolates the
+                   # per-stream speedup the controller cares about)
+                   "tokens_per_step": (
+                       self._emit_total / self._decode_iters
+                       if self._decode_iters else 0.0),
                    "paged": self._paged}
+            if self._spec_k > 0:
+                prop = self._spec_proposed
+                doc["spec"] = {
+                    "k": self._spec_k,
+                    "mode": self._spec_mode,
+                    "proposed": prop,
+                    "accepted": self._spec_accepted,
+                    "rejected": prop - self._spec_accepted,
+                    "accept_rate": (self._spec_accepted / prop
+                                    if prop else 0.0),
+                    "verify_steps": self._spec_verify_steps,
+                    "shed_occupancy": self._spec_shed,
+                }
             if self._paged:
                 doc.update(
                     page_tokens=self._page_tokens,
@@ -1219,7 +1510,12 @@ class GenerationEngine:
                     self._queue.popleft()
                     continue
                 P = self._page_tokens
-                need = -(-(gen.prompt.size + gen.max_new_tokens) // P)
+                # spec_k extra positions: the verify step's fixed-width
+                # scatter may touch one page past the declared worst
+                # case (rejected offsets are null-page-masked, but the
+                # ACCEPTED prefix must land in owned pages)
+                need = -(-(gen.prompt.size + gen.max_new_tokens
+                           + self._spec_k) // P)
                 matched: list[int] = []
                 if self._prefix is not None:
                     matched = self._prefix.match(gen.prompt, self._pool)
@@ -1390,25 +1686,73 @@ class GenerationEngine:
                 active[s] = True
             pt = None if not self._paged else self._pt.copy()
             epoch0 = self._epoch
+            specable: list[tuple[int, np.ndarray, int]] = []
+            if self._spec_k > 0:
+                # load-adaptive shedding: above the occupancy threshold
+                # batched decode already fills the device — speculative
+                # FLOPs would only starve co-tenant slots, so the whole
+                # iteration falls back to the plain fused step
+                occ = (sum(g is not None for g in self._slot_gen)
+                       / self.slots)
+                if occ <= self._spec_shed:
+                    specable = [
+                        (s,
+                         np.concatenate(
+                             [g.prompt,
+                              np.asarray(g.tokens, np.int32)]),
+                         min(self._spec_k,
+                             g.max_new_tokens - len(g.tokens) - 1))
+                        for s, g in stepped]
+        use_spec = False
+        if specable:
+            # drafting happens OUTSIDE the lock (ngram is host-side
+            # numpy; draft-model lookahead is its own compiled call)
+            dlens = np.zeros((self.slots,), np.int32)
+            drafts = np.zeros((self.slots, self._spec_k), np.int32)
+            for s, ctx, cap in specable:
+                if cap <= 0:
+                    continue       # last token due: nothing to verify
+                d = self._propose(ctx, cap)
+                if d.size:
+                    dlens[s] = d.size
+                    drafts[s, :d.size] = d
+            # no slot produced a draft -> the plain step is strictly
+            # cheaper (width 1 vs K+1) and byte-identical
+            use_spec = bool(dlens.any())
         t0 = time.perf_counter()
         try:
-            with _trace.span("gen/decode_step", active=len(stepped)):
+            with _trace.span("gen/decode_step", active=len(stepped),
+                             spec=int(use_spec)):
                 _fault.inject("engine.decode_step")
-                if self._paged:
+                if use_spec:
+                    with _trace.span("gen/spec_verify",
+                                     drafted=int(dlens.sum())):
+                        args = ((jnp.asarray(pt),) if self._paged
+                                else ())
+                        self._state, out, emit = self._spec_step(
+                            self._state, *args, jnp.asarray(active),
+                            jnp.asarray(drafts), jnp.asarray(dlens))
+                        out = np.asarray(out)
+                        emit = np.asarray(emit)
+                elif self._paged:
                     self._state, toks = self._step(self._state,
                                                    jnp.asarray(pt),
                                                    jnp.asarray(active))
+                    toks = np.asarray(toks)
                 else:
                     self._state, toks = self._step(self._state,
                                                    jnp.asarray(active))
-                toks = np.asarray(toks)
+                    toks = np.asarray(toks)
         except Exception as e:
             # the fused step shares one compiled call: every stepped
             # generation is implicated (co-tenant counts — see
             # _note_trap's threshold note)
             self._note_trap([g for _, g in stepped], e)
             raise
-        observe("gen/decode_step_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        observe("gen/decode_step_s", dt)
+        if use_spec:
+            observe("gen/spec_verify_s", dt)
         self._last_beat = time.monotonic()
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
@@ -1419,14 +1763,38 @@ class GenerationEngine:
             for s, gen in stepped:
                 if self._slot_gen[s] is not gen:   # cancelled mid-step
                     continue
-                tok = int(toks[s])
-                gen.tokens.append(tok)
-                emitted += 1
-                if ((gen.eos_token_id is not None
-                     and tok == gen.eos_token_id)
-                        or len(gen.tokens) >= gen.max_new_tokens):
-                    gen.done = True
-                    self._release_slot_locked(gen)
+                if use_spec:
+                    n = int(emit[s])
+                    new = [int(t) for t in out[s, :n]]
+                    dlen = int(dlens[s])
+                    if dlen:
+                        acc = n - 1
+                        gen.spec_proposed += dlen
+                        gen.spec_accepted += acc
+                        self._spec_proposed += dlen
+                        self._spec_accepted += acc
+                        stat_add("gen/spec_proposed", dlen)
+                        stat_add("gen/spec_accepted", acc)
+                        stat_add("gen/spec_rejected", dlen - acc)
+                        observe("gen/spec_accept_len", float(acc))
+                else:
+                    new = [int(toks[s])]
+                for tok in new:
+                    gen.tokens.append(tok)
+                    emitted += 1
+                    if ((gen.eos_token_id is not None
+                         and tok == gen.eos_token_id)
+                            or len(gen.tokens) >= gen.max_new_tokens):
+                        # accepted tokens past EOS are discarded on the
+                        # host; the device state past this point is
+                        # garbage but the slot is released right here
+                        gen.done = True
+                        self._release_slot_locked(gen)
+                        break
+            if use_spec:
+                self._spec_verify_steps += 1
+            self._emit_total += emitted
+            self._decode_iters += 1
             if emitted:
                 stat_add("gen/tokens", emitted)
             self._cond.notify_all()

@@ -58,6 +58,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--gen-paged", action="store_true",
                     help="paged KV cache for the --gen engine")
     ap.add_argument("--gen-page-tokens", type=int, default=8)
+    ap.add_argument("--gen-spec-k", type=int, default=0,
+                    help="speculative decoding lookahead for the --gen "
+                         "engine (0 = off, the default)")
+    ap.add_argument("--gen-spec-mode", default="ngram",
+                    choices=("ngram", "draft"),
+                    help="drafter for --gen-spec-k>0; 'draft' builds a "
+                         "1-layer draft Llama from the same --gen-seed")
     args = ap.parse_args(argv)
 
     from paddle_tpu.core.flags import flag
@@ -79,12 +86,24 @@ def main(argv: list[str] | None = None) -> int:
         cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32,
                                num_layers=2, num_heads=2, num_kv_heads=2,
                                max_seq_len=64)
-        srv.add_generator(args.gen, LlamaForCausalLM(cfg),
+        model = LlamaForCausalLM(cfg)
+        draft = None
+        if args.gen_spec_k > 0 and args.gen_spec_mode == "draft":
+            # deterministically derived from the same seed stream, so
+            # every replica drafts identically too
+            dcfg = LlamaConfig.tiny(vocab_size=96, hidden_size=16,
+                                    num_layers=1, num_heads=2,
+                                    num_kv_heads=2, max_seq_len=64)
+            draft = LlamaForCausalLM(dcfg)
+        srv.add_generator(args.gen, model,
                           slots=args.gen_slots,
                           max_len=args.gen_max_len,
                           step_wait_s=args.gen_step_wait_s,
                           paged=args.gen_paged,
-                          page_tokens=args.gen_page_tokens)
+                          page_tokens=args.gen_page_tokens,
+                          spec_k=args.gen_spec_k,
+                          spec_mode=args.gen_spec_mode,
+                          draft_model=draft)
     srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
 

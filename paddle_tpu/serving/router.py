@@ -45,6 +45,14 @@ typed :class:`StreamResumeExhausted`; a
 final and never resumed — a poisoned request must not be walked across
 the fleet.
 
+Speculative decoding (``FLAGS_gen_spec_k``) composes with resumption
+unchanged: the engine consumes exactly one RNG split per EMITTED token
+regardless of how many drafts each verify step accepted, so
+``rng_skip = len(delivered)`` lands on the same key schedule whether
+the original replica, the resuming replica, both, or neither were
+speculating — speculative rollback is per-slot device state the wire
+contract never sees (``tools/chaos_check.py`` gen-spec pins this).
+
 Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
 ``serving/router/marked_down``, ``serving/router/recovered``,
 ``serving/router/cordoned``, ``serving/router/uncordoned``,

@@ -1,0 +1,347 @@
+"""Speculative decoding: n-gram + draft-model lookahead with batched
+verification.
+
+The load-bearing property is the repo's universal acceptance criterion
+applied to the hottest path: a GREEDY speculative decode — whatever the
+drafter proposed and however many tokens each verify step accepted —
+must be byte-identical to the non-speculative decode, both solo
+(``speculative_generate`` vs ``generate``) and through the engine's
+fused step (contiguous AND paged cache modes, under interleaving).
+Sampled streams must stay deterministic per (prompt, seed): one key is
+consumed per EMITTED token regardless of acceptance pattern, so
+speculation on/off cannot change a sampled stream and PR 8's
+``rng_skip`` resumption composes unchanged.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core.monitor import get_histogram, get_stat
+from paddle_tpu.io.serving import InferenceClient, InferenceServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import (
+    generate, ngram_propose, speculative_generate,
+)
+from paddle_tpu.serving import GenerationEngine
+
+pytestmark = pytest.mark.spec
+
+VOCAB = 96
+MAX_NEW = 12
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def draft_model():
+    paddle_tpu.seed(3)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=16, num_layers=1,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _prompts(n, seed=1, size=None):
+    # fixed ``size`` keeps the eager solo path on ONE compiled cache
+    # shape (S = prompt + max_new + k); varied sizes exercise the
+    # engine's bucketing instead
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, VOCAB,
+                       size=size or rs.randint(4, 10)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        assert doc["error"] is None, doc["error"]
+        if doc["done"]:
+            return toks
+
+
+# -- drafter ----------------------------------------------------------------
+
+def test_ngram_propose_suffix_match():
+    # suffix [2, 3] last occurs at the start; continuation is [9, 5, 2]
+    out = ngram_propose([1, 2, 3, 9, 5, 2, 3], 3)
+    assert out.tolist() == [9, 5, 2]
+
+
+def test_ngram_propose_prefers_longest_then_most_recent():
+    # 3-gram suffix [1, 2, 3] matches at index 0 — wins over the later
+    # 2-gram match of [2, 3]
+    ctx = [1, 2, 3, 7, 8, 2, 3, 6, 1, 2, 3]
+    assert ngram_propose(ctx, 2).tolist() == [7, 8]
+    # most recent occurrence wins among equal-length matches
+    ctx = [5, 9, 1, 5, 9, 2, 5, 9]
+    assert ngram_propose(ctx, 1).tolist() == [2]
+
+
+def test_ngram_propose_no_match_and_clamps():
+    assert ngram_propose([1, 2, 3, 4, 5], 4).size == 0     # no repeat
+    assert ngram_propose([7], 4).size == 0                 # too short
+    assert ngram_propose([1, 2, 3], 0).size == 0           # k=0
+    # draft truncated at the end of the context
+    assert ngram_propose([4, 6, 4], 5).tolist() == [6, 4]
+
+
+# -- solo speculative_generate ----------------------------------------------
+
+def test_solo_greedy_byte_identity_ngram(model):
+    p = _prompts(1, size=8)[0]
+    ref = generate(model, p[None], MAX_NEW)
+    for k in (1, 4):
+        out = speculative_generate(model, p[None], MAX_NEW, spec_k=k)
+        assert np.array_equal(ref, out), f"k={k} diverged"
+
+
+def test_solo_greedy_byte_identity_draft(model, draft_model):
+    p = _prompts(1, seed=2, size=8)[0]
+    ref = generate(model, p[None], MAX_NEW)
+    out = speculative_generate(model, p[None], MAX_NEW, spec_k=4,
+                               draft_model=draft_model)
+    assert np.array_equal(ref, out)
+
+
+def test_solo_sampled_deterministic_spec_on_off(model):
+    """One key per EMITTED token: the sampled stream is a function of
+    (prompt, seed) alone — acceptance pattern, k, and drafter choice
+    cannot perturb it."""
+    p = _prompts(1, size=8)[0]
+    ref = generate(model, p[None], MAX_NEW, temperature=0.8, top_k=20,
+                   key=paddle_tpu.seed(11))
+    out = speculative_generate(model, p[None], MAX_NEW, spec_k=4,
+                               temperature=0.8, top_k=20,
+                               key=paddle_tpu.seed(11))
+    assert np.array_equal(ref, out), "sampled stream diverged"
+
+
+def test_solo_eos_respected(model):
+    """EOS emitted inside an accepted draft run truncates the output at
+    exactly the same token as the non-speculative decode."""
+    p = _prompts(1, seed=5, size=8)[0]
+    ref = generate(model, p[None], MAX_NEW)
+    eos = int(ref[0, p.size + MAX_NEW // 2])   # force a mid-stream EOS
+    ref = generate(model, p[None], MAX_NEW, eos_token_id=eos)
+    out = speculative_generate(model, p[None], MAX_NEW, spec_k=4,
+                               eos_token_id=eos)
+    assert np.array_equal(ref, out)
+
+
+# -- engine: byte-identity under interleaving --------------------------------
+
+@pytest.fixture(scope="module")
+def refs6(model):
+    # solo generate() runs eagerly — compute the 6 reference streams
+    # ONCE and share them across the engine-identity tests (same seed-1
+    # prompt list everywhere)
+    prompts = _prompts(6)
+    refs = [generate(model, p[None], MAX_NEW)[0, p.size:].tolist()
+            for p in prompts]
+    return prompts, refs
+
+
+def _engine_matches_solo(model, refs, prompts, **kw):
+    with GenerationEngine(model, **kw) as eng:
+        gids = [eng.start(p, MAX_NEW) for p in prompts]
+        outs = [_drain(eng, g) for g in gids]
+        st = eng.stats()
+    assert outs == refs
+    return st
+
+
+def test_engine_greedy_identity_contiguous(model, refs6):
+    """6 greedy streams through 3 speculating slots (queueing forces
+    admits/retires mid-flight; slots speculate and plain-step in the
+    same compiled call as drafts come and go) — byte-identical to solo
+    generate(). The same workload doubles as the contiguous rollback
+    test: the random model rejects plenty of n-gram drafts (rejected
+    positions sit past the decode index, masked by attention and
+    overwritten by later steps), and identity holds anyway."""
+    prompts, refs = refs6
+    st = _engine_matches_solo(model, refs, prompts, slots=3, max_len=40,
+                              queue_max=8, spec_k=4, spec_mode="ngram",
+                              spec_shed_occupancy=1.0)
+    assert st["spec"]["proposed"] > 0
+    assert st["spec"]["rejected"] > 0
+    assert st["spec"]["accepted"] == st["spec"]["proposed"] - \
+        st["spec"]["rejected"]
+    assert st["tokens_per_step"] > 0
+
+
+def test_engine_greedy_identity_paged_and_rollback(model, refs6):
+    """Paged identity under the same interleaving — plus the rollback
+    pool invariant: rejected drafts are truncated to the null page, and
+    after the streams retire and the prefix cache is dropped every page
+    is back in the pool (rollback cannot leak or double-free a page)."""
+    prompts, refs = refs6
+    with GenerationEngine(model, slots=3, max_len=40, queue_max=8,
+                          paged=True, page_tokens=8, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=1.0) as eng:
+        outs = [_drain(eng, eng.start(p, MAX_NEW)) for p in prompts]
+        st = eng.stats()
+        assert st["spec"]["proposed"] > 0
+        assert st["spec"]["rejected"] > 0
+        assert outs == refs
+        eng.clear_prefix_cache()
+        st = eng.stats()
+        assert st["pages_free"] == st["pages"]
+
+
+def test_engine_greedy_identity_draft_mode(model, draft_model, refs6):
+    prompts, refs = refs6[0][:3], refs6[1][:3]
+    st = _engine_matches_solo(model, refs, prompts, slots=2, max_len=40,
+                              queue_max=8, spec_k=4, spec_mode="draft",
+                              draft_model=draft_model,
+                              spec_shed_occupancy=1.0)
+    assert st["spec"]["mode"] == "draft"
+    assert st["spec"]["proposed"] > 0
+
+
+def test_engine_sampled_deterministic_spec_on_off(model):
+    p = _prompts(1)[0]
+    with GenerationEngine(model, slots=2, max_len=40) as base:
+        a = _drain(base, base.start(p, MAX_NEW, temperature=0.9, top_k=12,
+                                    seed=5))
+    with GenerationEngine(model, slots=2, max_len=40, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=1.0) as spec:
+        b = _drain(spec, spec.start(p, MAX_NEW, temperature=0.9, top_k=12,
+                                    seed=5))
+    assert a == b
+
+
+def test_engine_rng_skip_resume_interop(model):
+    """PR 8's resume contract survives speculation: replaying the
+    emitted prefix into the prompt with rng_skip=len(prefix) continues
+    the sampled stream byte-identically on a SPECULATING engine."""
+    p = _prompts(1, seed=9)[0]
+    with GenerationEngine(model, slots=2, max_len=60, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=1.0) as eng:
+        A = _drain(eng, eng.start(p, 16, temperature=0.9, top_k=12,
+                                  seed=5))
+        m = 6
+        p2 = np.concatenate([p, np.asarray(A[:m], np.int32)])
+        B = _drain(eng, eng.start(p2, 16 - m, temperature=0.9, top_k=12,
+                                  seed=5, rng_skip=m))
+    assert B == A[m:]
+
+
+def test_spec_capacity_reserve(model):
+    """Admission reserves spec_k scratch positions: a request that
+    would let the fixed K+1 verify window clamp past max_len is
+    rejected up front."""
+    with GenerationEngine(model, slots=1, max_len=32, spec_k=4,
+                          spec_mode="ngram") as eng:
+        with pytest.raises(ValueError, match="spec_k"):
+            eng.start(np.arange(1, 17, dtype=np.int32), 16)
+        gid = eng.start(np.arange(1, 13, dtype=np.int32), 16)  # 12+16+4
+        assert len(_drain(eng, gid)) == 16
+
+
+# -- load-adaptive shedding -------------------------------------------------
+
+def test_occupancy_shedding(model):
+    """Above the occupancy threshold the engine sheds speculation
+    entirely (batched decode already fills the device) — output stays
+    byte-identical, zero drafts are proposed. Below it, speculation
+    engages."""
+    p = _prompts(1)[0]
+    ref = generate(model, p[None], MAX_NEW)[0, p.size:].tolist()
+    with GenerationEngine(model, slots=2, max_len=40, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=0.0) as shed:
+        out = _drain(shed, shed.start(p, MAX_NEW))
+        st = shed.stats()
+        assert out == ref
+        assert st["spec"]["proposed"] == 0          # always shed
+        assert st["spec"]["verify_steps"] == 0
+    with GenerationEngine(model, slots=2, max_len=40, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=1.0) as solo:
+        out = _drain(solo, solo.start(p, MAX_NEW))
+        assert out == ref
+        assert solo.stats()["spec"]["proposed"] > 0  # engaged
+
+
+# -- observability ----------------------------------------------------------
+
+def test_spec_counters_and_histograms(model):
+    p0 = get_stat("gen/spec_proposed")
+    a0 = get_stat("gen/spec_accepted")
+    r0 = get_stat("gen/spec_rejected")
+    with GenerationEngine(model, slots=2, max_len=40, spec_k=4,
+                          spec_mode="ngram",
+                          spec_shed_occupancy=1.0) as eng:
+        _drain(eng, eng.start(_prompts(1)[0], MAX_NEW))
+        st = eng.stats()["spec"]
+    assert get_stat("gen/spec_proposed") - p0 == st["proposed"] > 0
+    assert get_stat("gen/spec_accepted") - a0 == st["accepted"]
+    assert get_stat("gen/spec_rejected") - r0 == st["rejected"]
+    assert 0.0 <= st["accept_rate"] <= 1.0
+    assert get_histogram("gen/spec_accept_len") is not None
+    assert get_histogram("gen/spec_verify_s") is not None
+
+
+def test_health_ships_spec_stats(model):
+    srv = InferenceServer().start()
+    try:
+        with GenerationEngine(model, slots=2, max_len=40, spec_k=4,
+                              spec_mode="ngram",
+                              spec_shed_occupancy=1.0) as eng:
+            srv.add_generator("sllm", eng)
+            client = InferenceClient(srv.endpoint)
+            try:
+                _drain_client = eng.start(_prompts(1)[0], MAX_NEW)
+                _drain(eng, _drain_client)
+                g = client.health()["generators"]["sllm"]
+            finally:
+                client.close()
+        assert g["spec"]["k"] == 4
+        assert g["spec"]["accept_rate"] >= 0.0
+        assert g["tokens_per_step"] > 0
+    finally:
+        srv.stop()
+
+
+# -- defaults-off -----------------------------------------------------------
+
+def test_defaults_off(model):
+    """With the gen_spec_* flags at their defaults the engine builds no
+    spec step, reports no spec stats, and moves no spec counters — the
+    decode path is the pre-speculation one."""
+    from paddle_tpu.core.flags import get_flags
+    f = get_flags(["gen_spec_k", "gen_spec_mode", "gen_spec_ngram",
+                   "gen_spec_shed_occupancy"])
+    assert f["gen_spec_k"] == 0
+    p0 = get_stat("gen/spec_proposed")
+    p = _prompts(1)[0]
+    ref = generate(model, p[None], MAX_NEW)[0, p.size:].tolist()
+    with GenerationEngine(model, slots=2, max_len=40) as eng:
+        out = _drain(eng, eng.start(p, MAX_NEW))
+        st = eng.stats()
+    assert out == ref
+    assert "spec" not in st
+    assert st["tokens_per_step"] > 0       # backfilled on the plain path
+    assert eng._spec_step is None
+    assert get_stat("gen/spec_proposed") == p0
+
+
+def test_spec_config_validation(model):
+    with pytest.raises(ValueError, match="gen_spec_mode"):
+        GenerationEngine(model, slots=1, max_len=32, spec_k=2,
+                         spec_mode="bogus")
+    with pytest.raises(ValueError, match="draft_model"):
+        GenerationEngine(model, slots=1, max_len=32, spec_k=2,
+                         spec_mode="draft")

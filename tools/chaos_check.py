@@ -55,9 +55,17 @@ Scenarios (all CPU-only, single process):
     (b) a poison request that traps an engine is quarantined by crash
     fingerprint — the typed ``RequestQuarantined`` surfaces through
     the resuming client and the second replica never crashes.
+11. **gen-spec**: the subprocess replica holding a LIVE *speculating*
+    stream (paged engine, ``--gen-spec-k 4`` n-gram drafter) is
+    SIGKILLed — the stream resumes on the (also speculating) survivor
+    byte-identical to solo ``generate()`` (``stream_resumes>=1``), the
+    survivor's page pool drains back to full despite speculative
+    rollback traffic, and health ships the acceptance stats.
 
 Also asserts the production posture: every fault/retry/overload flag
-defaults to hard-off/zero-cost.
+defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
+speculation is opt-in; the unflagged decode path is byte-identical to
+the pre-speculation build).
 
 Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py``. Exits nonzero
 (with a JSON report on stdout) if any recovery path or stat fails — a
@@ -140,6 +148,14 @@ def check_defaults_off() -> None:
           and rz["control_spawn_breaker"] == 0    # spawner never skipped
           and rz["control_spawn_backoff_s"] > 0,  # sane base when opted in
           str(rz))
+    sk = get_flags(["gen_spec_k", "gen_spec_mode", "gen_spec_ngram",
+                    "gen_spec_shed_occupancy"])
+    check("defaults/gen_spec_off",
+          sk["gen_spec_k"] == 0                   # no speculation at all
+          and sk["gen_spec_mode"] == "ngram"      # weight-free drafter
+          and sk["gen_spec_ngram"] >= 1           # sane when opted in
+          and 0.0 <= sk["gen_spec_shed_occupancy"] <= 1.0,
+          str(sk))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -900,6 +916,79 @@ def scenario_gen_resilience(tmp: str) -> None:
             s.stop()
 
 
+def scenario_gen_spec(tmp: str) -> None:
+    """SIGKILL a subprocess replica mid-stream while the stream is
+    SPECULATING (paged engine, n-gram drafter): the routed resume
+    replays the delivered prefix on the survivor — itself speculating —
+    byte-identical, with ``stream_resumes>=1`` and zero leaked pages.
+    Speculative rollback state is per-slot device state the resume
+    never sees: the wire contract (delivered tokens + rng_skip) is
+    unchanged, which is exactly what this scenario pins down."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    monitor.reset_stats("serving/router/")
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05",
+        "--gen-paged", "--gen-page-tokens", "8",
+        "--gen-spec-k", "4", "--gen-spec-mode", "ngram"))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    try:
+        # templated prompt: gives the n-gram drafter something to match
+        # so the killed stream is genuinely speculating
+        prompt = np.asarray([3, 9, 3, 9, 3], np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 5:]
+        sess = router.session("spec-victim")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]          # live speculating stream
+        victim = sess.endpoint
+        spawner.kill(victim)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes on the survivor
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("genspec/stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={toks} ref={ref.tolist()}")
+        check("genspec/resume_counted",
+              monitor.get_stat("serving/router/stream_resumes") >= 1,
+              str(monitor.export_stats("serving/router/")))
+        survivor = next(ep for ep in eps if ep != victim)
+        g = {}
+        with io.InferenceClient(survivor, timeout=5.0) as c:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                g = c.health()["generators"]["llm"]
+                if (g.get("active") == 0 and g.get("pages_free", 0)
+                        + g.get("prefix_entries", 0) == g.get("pages")):
+                    break
+                time.sleep(0.1)
+        check("genspec/zero_leaked_pages_on_survivor",
+              g.get("pages_free", -1) + g.get("prefix_entries", 0)
+              == g.get("pages"), str(g))
+        check("genspec/acceptance_stats_in_health",
+              g.get("spec", {}).get("k") == 4
+              and "accept_rate" in g.get("spec", {})
+              and "tokens_per_step" in g, str(g))
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -908,7 +997,8 @@ def main() -> int:
                          scenario_elastic_resume, scenario_overload,
                          scenario_obs, scenario_serving_routed,
                          scenario_gen_engine, scenario_gen_paged,
-                         scenario_control_plane, scenario_gen_resilience):
+                         scenario_control_plane, scenario_gen_resilience,
+                         scenario_gen_spec):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
