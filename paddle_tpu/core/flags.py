@@ -359,6 +359,23 @@ define_flag("control_spawn_backoff_s", 2.0,
             "consecutive failure past the breaker threshold, capped at "
             "32x). Only read once control_spawn_breaker > 0 opens the "
             "breaker path")
+define_flag("control_slo_budget", 0.1,
+            "SLO error budget as a fraction of observations allowed to "
+            "violate the TTFT target (burn rate = violating fraction / "
+            "this budget; burn 1.0 == burning the budget exactly as "
+            "fast as allowed)")
+define_flag("control_burn_fast_ticks", 5,
+            "Fast burn-rate window in controller ticks: a scale-up "
+            "needs the burn rate over this window above "
+            "control_burn_threshold (catches an acute breach quickly)")
+define_flag("control_burn_slow_ticks", 60,
+            "Slow burn-rate window in controller ticks: the same burn "
+            "threshold must also hold over this window (filters "
+            "single-tick noise a raw p99 check would chase)")
+define_flag("control_burn_threshold", 1.0,
+            "Burn-rate level both windows must exceed before TTFT "
+            "pressure fires (1.0 = consuming the error budget exactly "
+            "at the allowed rate)")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "
@@ -376,7 +393,7 @@ def _on_trace(v) -> None:
 def _on_trace_buffer(v) -> None:
     from paddle_tpu.core import trace
 
-    if trace.enabled():                 # live resize; drops buffered spans
+    if trace.enabled():            # live resize; keeps the newest spans
         trace.configure(True, capacity=int(v))
 
 
@@ -399,6 +416,13 @@ define_flag("trace", False,
             "with per-op latency histograms. Hard-off default: the wire "
             "fast path pays a single flag check",
             on_set=_on_trace)
+define_flag("trace_sample", 0,
+            "Per-iteration stream-trace sampling: with tracing on, emit "
+            "a gen/decode_sample span for every Nth decoded token of a "
+            "stream that carries a stream trace id (N = this value). "
+            "0 — the default — records no per-iteration spans at all; "
+            "lifecycle events (admitted/prefill/retire) are always "
+            "recorded for traced streams")
 define_flag("log_json", False,
             "Structured logging: one JSON object per line (ts, level, "
             "msg, trace_id of the active span) instead of the human "

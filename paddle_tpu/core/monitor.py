@@ -25,9 +25,9 @@ from typing import Any
 
 __all__ = ["StatRegistry", "stats", "stat_add", "stat_set", "get_stat",
            "observe", "get_histogram", "export_stats", "export_histograms",
-           "export_prometheus", "merge_histograms", "reset_stats",
-           "StepTimer", "device_memory_stats", "host_rss_bytes",
-           "host_peak_rss_bytes"]
+           "export_prometheus", "merge_histograms", "hist_fraction_above",
+           "reset_stats", "StepTimer", "device_memory_stats",
+           "host_rss_bytes", "host_peak_rss_bytes"]
 
 
 # Fixed log-spaced histogram buckets: 3 per decade from 1e-7 to 1e+3
@@ -227,6 +227,26 @@ def merge_histograms(docs: list[dict[str, Any]],
     for doc in docs:
         merged.merge(_Histogram.from_raw(doc))
     return merged.summary(raw)
+
+
+def hist_fraction_above(doc: dict[str, Any], threshold: float) -> float:
+    """Fraction of a raw histogram snapshot's observations at or above
+    ``threshold``: the mass in every bucket whose lower bound is >=
+    threshold (observations below it in the threshold's own bucket
+    can't be separated, so the boundary bucket counts as below — a
+    conservative under-count). This is the SLO-violation numerator for
+    burn-rate math (``serving/metrics.py``); 0.0 when the snapshot is
+    empty or carries no buckets."""
+    buckets = doc.get("buckets") if doc else None
+    total = int(doc.get("count", 0)) if doc else 0
+    if not buckets or total <= 0:
+        return 0.0
+    # first bucket whose LOWER bound >= threshold: bucket i holds values
+    # v with bisect_left(bounds, v) == i, i.e. (bounds[i-1], bounds[i]],
+    # so the first all-violating bucket is one past threshold's own
+    i = bisect.bisect_left(_BUCKET_BOUNDS, threshold) + 1
+    violating = sum(int(c) for c in buckets[i:])
+    return min(violating / total, 1.0)
 
 
 def reset_stats(prefix: str | None = None) -> None:

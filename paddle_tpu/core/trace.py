@@ -81,9 +81,9 @@ _ctx = threading.local()          # per-thread stack of (trace_id, span_id)
 
 
 def configure(enable: bool, capacity: int | None = None) -> None:
-    """(Re)configure tracing; wired to ``FLAGS_trace``. Reconfiguring
-    with a new capacity drops buffered spans (the buffer is a debugging
-    artifact, not durable state)."""
+    """(Re)configure tracing; wired to ``FLAGS_trace``. Resizing a live
+    tracer keeps the newest buffered spans that still fit the new
+    capacity (shrinking drops only the oldest tail)."""
     global _ACTIVE
     with _lock:
         if not enable:
@@ -94,7 +94,13 @@ def configure(enable: bool, capacity: int | None = None) -> None:
                 capacity = int(flag("trace_buffer"))
             except KeyError:       # flag not registered yet (import order)
                 capacity = 4096
-        _ACTIVE = _Tracer(capacity)
+        tracer = _Tracer(capacity)
+        old = _ACTIVE
+        if old is not None:
+            # deque(maxlen=capacity) keeps the newest tail automatically
+            with old._lock:
+                tracer._buf.extend(old._buf)
+        _ACTIVE = tracer
 
 
 def enabled() -> bool:

@@ -203,7 +203,8 @@ class FrameService:
                             send_frame(sock, 0, outer.health(
                                 header.get("stats_prefix"),
                                 bool(header.get("histograms")),
-                                bool(header.get("deep"))))
+                                bool(header.get("deep")),
+                                stats=bool(header.get("stats", True))))
                             continue
                         if op == TRACE_OP:
                             # span scrape: never shed either (observing
@@ -322,7 +323,8 @@ class FrameService:
 
     # -- health ------------------------------------------------------------
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False, deep: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False,
+               stats: bool = True) -> dict:
         """Uniform liveness/load snapshot, also served to any client as
         op :data:`HEALTH_OP` (``FrameClient.health()``). ``stats_prefix``
         (probe-header ``stats_prefix``) filters the monitor-stats
@@ -336,7 +338,10 @@ class FrameService:
         service has one — the base service ignores it (wire liveness IS
         its work); ``InferenceServer`` runs a one-token canary decode
         per generation engine, distinguishing "port open" from "device
-        healthy"."""
+        healthy". ``stats=False`` (probe-header ``stats``) skips the
+        stats snapshot entirely (``doc["stats"] == {}``) — the
+        liveness-only probe path, replacing the old non-matching-prefix
+        trick (which still works)."""
         if stats_prefix is not None:
             stats_prefix = str(stats_prefix)   # header value is untrusted
         with self._load_cv:
@@ -354,7 +359,7 @@ class FrameService:
             "max_conns": int(flag("wire_max_conns")),
             "uptime_s": (time.monotonic() - self._started
                          if self._started is not None else 0.0),
-            "stats": export_stats(stats_prefix),
+            "stats": export_stats(stats_prefix) if stats else {},
         }
         if histograms:
             doc["histograms"] = export_histograms(stats_prefix, raw=True)
@@ -514,19 +519,21 @@ class FrameClient:
             return {k: v for k, v in self._inflight_by_op.items() if v}
 
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False, deep: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False,
+               stats: bool = True) -> dict:
         """Probe the server's universal health op (:data:`HEALTH_OP`,
         served by ``FrameService`` itself for every service): liveness,
         in-flight/connection depth, drain status, uptime, stats.
         ``stats_prefix`` asks the server to filter the stats snapshot
         (high-frequency pollers shouldn't ship every counter);
-        ``histograms`` also ships the matching raw-bucket histograms
-        (mergeable across endpoints — see ``monitor.merge_histograms``);
-        ``deep`` asks for the work-proving probe (an InferenceServer
-        runs a one-token canary decode per generation engine — engine
-        liveness distinct from the wire liveness this op otherwise
-        measures). Deep probes cost real device work; keep them off the
-        high-frequency path."""
+        ``stats=False`` skips the stats snapshot entirely — the
+        cheapest liveness-only probe; ``histograms`` also ships the
+        matching raw-bucket histograms (mergeable across endpoints —
+        see ``monitor.merge_histograms``); ``deep`` asks for the
+        work-proving probe (an InferenceServer runs a one-token canary
+        decode per generation engine — engine liveness distinct from
+        the wire liveness this op otherwise measures). Deep probes cost
+        real device work; keep them off the high-frequency path."""
         header: dict[str, Any] = {}
         if stats_prefix is not None:
             header["stats_prefix"] = stats_prefix
@@ -534,6 +541,8 @@ class FrameClient:
             header["histograms"] = True
         if deep:
             header["deep"] = True
+        if not stats:
+            header["stats"] = False
         return self._request("health", header, idempotent=True)[0]
 
     def trace_dump(self, clear: bool = False) -> dict:

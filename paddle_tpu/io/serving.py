@@ -223,7 +223,8 @@ class InferenceServer(FrameService):
         return eng
 
     def health(self, stats_prefix: str | None = None,
-               histograms: bool = False, deep: bool = False) -> dict:
+               histograms: bool = False, deep: bool = False,
+               stats: bool = True) -> dict:
         """FrameService health + per-generator slot AND page-pool
         occupancy (paged engines report ``pages_free``/``pages`` +
         ``prefix_entries``) + per-model usage stats (infer count,
@@ -246,7 +247,7 @@ class InferenceServer(FrameService):
         measures ("port open"), so a router prober or controller can
         tell a wedged device from a dead socket. Deep probes cost real
         decode work; the background router prober stays shallow."""
-        doc = super().health(stats_prefix, histograms, deep)
+        doc = super().health(stats_prefix, histograms, deep, stats)
         now = time.time()
         with self._lock:
             engines = dict(self._generators)
@@ -315,7 +316,12 @@ class InferenceServer(FrameService):
                         top_p=float(header.get("top_p", 1.0)),
                         eos_token_id=None if eos is None else int(eos),
                         seed=int(header.get("seed", 0)),
-                        rng_skip=int(header.get("rng_skip", 0)))
+                        rng_skip=int(header.get("rng_skip", 0)),
+                        # stream trace id ("st"): minted by the first
+                        # generate_start of the logical stream, replayed
+                        # by failover resume — joins this replica's slot
+                        # events into the stream's fleet-wide trace
+                        trace_id=header.get("st"))
                 except EngineOverloaded as e:
                     # full engine: shed, not error — the status is
                     # retryable for every client (the start never ran)
@@ -413,7 +419,8 @@ class InferenceClient(FrameClient):
     def generate_start(self, model: str, prompt, max_new_tokens: int, *,
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, eos_token_id: int | None = None,
-                       seed: int = 0, rng_skip: int = 0) -> str:
+                       seed: int = 0, rng_skip: int = 0,
+                       trace_id: str | None = None) -> str:
         """Admit a generation into ``model``'s engine; returns its id.
         A full engine surfaces as the retryable shed status (the client
         backs off per ``retry_after_s`` and retries within its budget,
@@ -421,7 +428,11 @@ class InferenceClient(FrameClient):
         quarantined crash fingerprint re-raises the typed
         :class:`~paddle_tpu.serving.engine.RequestQuarantined` — final,
         never retried. ``rng_skip`` fast-forwards the sampling-key
-        schedule (stream resumption's RNG-position replay)."""
+        schedule (stream resumption's RNG-position replay). ``trace_id``
+        is the stream's fleet-unique trace id (header ``st``): with
+        tracing on one is minted here when not given; a resuming caller
+        passes the ORIGINAL stream's id so the replacement replica's
+        slot events join the same trace."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         header = {"model": model, "prompt": prompt.tolist(),
                   "max_new_tokens": int(max_new_tokens),
@@ -431,6 +442,10 @@ class InferenceClient(FrameClient):
             header["eos_token_id"] = int(eos_token_id)
         if rng_skip:
             header["rng_skip"] = int(rng_skip)
+        if trace_id is None and _trace.enabled():
+            trace_id = _trace.new_id()
+        if trace_id:
+            header["st"] = str(trace_id)
         try:
             return self._request("generate_start", header)[0]["gen_id"]
         except RuntimeError as e:

@@ -30,6 +30,7 @@ from paddle_tpu.serving.engine import (
     EngineOverloaded, Generation, GenerationEngine, GenerationExpired,
     RequestQuarantined,
 )
+from paddle_tpu.serving.metrics import MetricsHub, hist_delta
 from paddle_tpu.serving.router import (
     GenerationFailed, ReplicaState, RoutedClient, StickySession,
     StreamResumeExhausted,
@@ -40,4 +41,4 @@ __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "StickySession", "GenerationFailed", "ServingController",
            "ControlDecision", "ReplicaSpawner", "InProcSpawner",
            "SubprocessSpawner", "RequestQuarantined", "GenerationExpired",
-           "StreamResumeExhausted"]
+           "StreamResumeExhausted", "MetricsHub", "hist_delta"]

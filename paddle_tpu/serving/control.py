@@ -20,16 +20,24 @@ engine:
   the new ``unload_model`` wire op. ``register_model(..., warm=True)``
   pins a model against eviction.
 - **SLO-driven autoscaling** — each ``control_interval_s`` the loop
-  merges the fleet's already-shipped signals: queued generations and
-  slot occupancy from ``health``'s ``generators`` section, mean wire
-  in-flight, and the p99 of the per-window ``gen/ttft_s`` histogram
-  delta (raw bucket counts are mergeable across endpoints —
-  ``monitor.merge_histograms``) against ``control_target_ttft_s``.
-  Sustained pressure (``control_breach_ticks`` consecutive breaching
-  ticks) scales up through a :class:`ReplicaSpawner`; sustained idleness
-  (``control_idle_ticks``) scales down; ``control_cooldown_s`` spaces
-  scale events. Hysteresis + cooldown make the loop flap-proof by
-  construction.
+  feeds every replica's health snapshot into a
+  :class:`~paddle_tpu.serving.metrics.MetricsHub` (the windowed
+  in-memory fleet TSDB) and reads the fleet's signals back out of it:
+  queued generations and slot occupancy from ``health``'s
+  ``generators`` section, mean wire in-flight, and the ``gen/ttft_s``
+  **multi-window SLO burn rate** against ``control_target_ttft_s`` —
+  TTFT pressure requires BOTH the fast (``control_burn_fast_ticks``)
+  and slow (``control_burn_slow_ticks``) windows to burn error budget
+  (``control_slo_budget``) faster than ``control_burn_threshold``, the
+  standard two-window page condition that replaces the old single-tick
+  raw-p99 breach check (noisy by construction: one slow request per
+  tick paged).  Sustained pressure (``control_breach_ticks``
+  consecutive breaching ticks) scales up through a
+  :class:`ReplicaSpawner`; sustained idleness (``control_idle_ticks``)
+  scales down; ``control_cooldown_s`` spaces scale events. Hysteresis
+  + cooldown make the loop flap-proof by construction.  Every scale
+  decision records its burn-rate evidence in the
+  :class:`ControlDecision` signals.
 - **Sticky-drain scale-down** — the victim is ``cordon``\\ ed (no new
   routed or session picks; pooled connections stay open), the controller
   watches its health until in-flight requests hit zero and every
@@ -84,12 +92,11 @@ from paddle_tpu.core import fault as _fault
 from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.logging import get_logger
-from paddle_tpu.core.monitor import (
-    merge_histograms, observe, stat_add, stat_set,
-)
+from paddle_tpu.core.monitor import observe, stat_add, stat_set
 from paddle_tpu.io.serving import (
     InferenceClient, InferenceServer, ModelBusyError,
 )
+from paddle_tpu.serving.metrics import MetricsHub
 from paddle_tpu.serving.router import RoutedClient
 
 __all__ = ["ServingController", "ControlDecision", "ReplicaSpawner",
@@ -238,29 +245,6 @@ class SubprocessSpawner(ReplicaSpawner):
             proc.wait()
 
 
-def _hist_delta(prev: dict | None, cur: dict | None) -> dict | None:
-    """Per-window histogram: raw bucket counts of ``cur`` minus
-    ``prev`` (both ``export_histograms(raw=True)`` entries). None until
-    a baseline exists or when nothing landed in the window — an SLO
-    judges *recent* latency, not the life of the process (and in-proc
-    test fleets share one registry, so absolute counts only grow)."""
-    if not cur or not cur.get("buckets"):
-        return None
-    if not prev or not prev.get("buckets"):
-        return None                         # first tick: baseline only
-    buckets = [max(int(c) - int(p), 0)
-               for c, p in zip(cur["buckets"], prev["buckets"])]
-    count = sum(buckets)
-    if count == 0:
-        return None
-    return {"buckets": buckets, "count": count,
-            "sum": max(float(cur.get("sum", 0.0))
-                       - float(prev.get("sum", 0.0)), 0.0),
-            # min/max only clamp quantile interpolation; the lifetime
-            # bounds are a safe (slightly loose) envelope for the window
-            "min": cur.get("min", 0.0), "max": cur.get("max", 0.0)}
-
-
 class ServingController:
     """The fleet manager: owns a managed replica set (created through
     ``spawner``), a model registry bigger than any replica's warm tier,
@@ -300,6 +284,10 @@ class ServingController:
                  drain_s: float | None = None,
                  spawn_breaker: int | None = None,
                  spawn_backoff_s: float | None = None,
+                 slo_budget: float | None = None,
+                 burn_fast_ticks: int | None = None,
+                 burn_slow_ticks: int | None = None,
+                 burn_threshold: float | None = None,
                  decisions_max: int = 256):
         def _f(v, name):
             return flag(name) if v is None else v
@@ -328,6 +316,18 @@ class ServingController:
                                     "control_spawn_breaker"))
         self.spawn_backoff_s = float(_f(spawn_backoff_s,
                                         "control_spawn_backoff_s"))
+        self.slo_budget = float(_f(slo_budget, "control_slo_budget"))
+        self.burn_fast_ticks = int(_f(burn_fast_ticks,
+                                      "control_burn_fast_ticks"))
+        self.burn_slow_ticks = int(_f(burn_slow_ticks,
+                                      "control_burn_slow_ticks"))
+        self.burn_threshold = float(_f(burn_threshold,
+                                       "control_burn_threshold"))
+        # the windowed fleet TSDB every tick's health scrape feeds; all
+        # latency/rate signals (and the burn-rate pressure check) read
+        # from it instead of ad-hoc previous-snapshot bookkeeping
+        self._hub = MetricsHub(fast_ticks=self.burn_fast_ticks,
+                               slow_ticks=self.burn_slow_ticks)
         # spawn circuit-breaker state: consecutive failures and the
         # monotonic instant before which the spawner must not be called
         self._spawn_fails = 0
@@ -342,7 +342,6 @@ class ServingController:
         self._idle = 0
         self._last_scale = 0.0           # monotonic; 0 = never
         self._unreachable: dict[str, int] = {}   # ep -> consecutive ticks
-        self._ttft_prev: dict[str, dict] = {}    # ep -> raw hist snapshot
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._closed = False
@@ -450,6 +449,12 @@ class ServingController:
         it steers)."""
         return self._router
 
+    @property
+    def hub(self) -> MetricsHub:
+        """The windowed fleet TSDB the tick loop feeds (read-only use:
+        dashboards, tests, and chaos checks query it directly)."""
+        return self._hub
+
     def replicas(self) -> list[dict]:
         """Router membership annotated with who manages each replica."""
         with self._lock:
@@ -543,7 +548,7 @@ class ServingController:
                                        ts=time.time())
         with self._lock, _trace.span("control/tick"):
             stat_add("control/ticks")
-            healths = self._router.health(stats_prefix="gen/ttft_s",
+            healths = self._router.health(stats_prefix="gen/",
                                           histograms=True)
             self._heal(healths)
             if self.warm_models > 0:
@@ -584,7 +589,10 @@ class ServingController:
     def _signals(self, healths: dict[str, dict]) -> dict[str, Any]:
         """Fold per-replica health into the fleet signal snapshot the
         scale decision reads (cordoned members are draining capacity —
-        excluded)."""
+        excluded).  The scrape also feeds the :class:`MetricsHub`, and
+        every latency signal — the windowed TTFT p99 and both burn
+        rates — is read back out of the hub's windows, so a decision's
+        recorded evidence IS the hub's answer at that tick."""
         cordoned = {m["endpoint"] for m in self._router.members()
                     if m["cordoned"]}
         live = {ep: doc for ep, doc in healths.items()
@@ -598,15 +606,15 @@ class ServingController:
                 slots += int(g.get("slots", 0))
                 active += int(g.get("active", 0))
                 queued += int(g.get("queued", 0))
-        deltas = []
-        for ep, d in live.items():
-            cur = (d.get("histograms") or {}).get("gen/ttft_s")
-            delta = _hist_delta(self._ttft_prev.get(ep), cur)
-            if cur:
-                self._ttft_prev[ep] = cur
-            if delta is not None:
-                deltas.append(delta)
-        ttft_p99 = (merge_histograms(deltas)["p99"] if deltas else None)
+        self._hub.ingest(healths)
+        win = self._hub.window_histogram("gen/ttft_s",
+                                         self.burn_fast_ticks)
+        ttft_p99 = float(win["p99"]) if win else None
+        if self.target_ttft_s > 0 and self.slo_budget > 0:
+            burn_fast, burn_slow = self._hub.burn_rates(
+                "gen/ttft_s", self.target_ttft_s, self.slo_budget)
+        else:
+            burn_fast = burn_slow = 0.0
         return {
             "replicas": n,
             "managed": len(self._managed),
@@ -616,6 +624,8 @@ class ServingController:
             "occupancy": active / slots if slots else 0.0,
             "queue_per_replica": queued / n if n else 0.0,
             "ttft_p99_s": ttft_p99,
+            "ttft_burn_fast": burn_fast,
+            "ttft_burn_slow": burn_slow,
         }
 
     def _pressure(self, s: dict[str, Any]) -> list[str]:
@@ -631,10 +641,19 @@ class ServingController:
         if s["slots"] and s["occupancy"] >= self.occupancy_high:
             out.append(f"slot occupancy {s['occupancy']:.2f} >= "
                        f"{self.occupancy_high:g}")
-        if (self.target_ttft_s > 0 and s["ttft_p99_s"] is not None
-                and s["ttft_p99_s"] > self.target_ttft_s):
-            out.append(f"TTFT p99 {s['ttft_p99_s']:.3f}s > SLO "
-                       f"{self.target_ttft_s:g}s")
+        if (self.target_ttft_s > 0 and self.slo_budget > 0
+                and s["ttft_burn_fast"] > self.burn_threshold
+                and s["ttft_burn_slow"] > self.burn_threshold):
+            # multi-window burn-rate page: the acute window proves it is
+            # happening NOW, the sustained window proves it is not a
+            # one-tick blip — both must burn budget past the threshold
+            p99 = s.get("ttft_p99_s")
+            out.append(f"TTFT burn rate fast {s['ttft_burn_fast']:.1f}x"
+                       f"/slow {s['ttft_burn_slow']:.1f}x > "
+                       f"{self.burn_threshold:g}x of SLO budget "
+                       f"{self.slo_budget:g} (p99 "
+                       f"{p99 if p99 is None else round(p99, 3)}s vs "
+                       f"target {self.target_ttft_s:g}s)")
         if (self.inflight_high > 0
                 and s["inflight_mean"] >= self.inflight_high):
             out.append(f"inflight {s['inflight_mean']:.2f}/replica >= "
@@ -794,7 +813,7 @@ class ServingController:
         best, best_load = None, None
         for ep in sorted(managed - cordoned):
             try:
-                doc = self._client_for(ep).health(stats_prefix="\x00none")
+                doc = self._client_for(ep).health(stats=False)
                 load = int(doc.get("inflight", 0)) + sum(
                     int(g.get("active", 0)) + int(g.get("queued", 0))
                     for g in (doc.get("generators") or {}).values())
@@ -858,7 +877,7 @@ class ServingController:
         consecutive = 0
         while time.monotonic() < end:
             try:
-                doc = self._client_for(ep).health(stats_prefix="\x00none")
+                doc = self._client_for(ep).health(stats=False)
             except (ConnectionError, RuntimeError, OSError):
                 return True              # already gone
             busy = int(doc.get("inflight", 0)) + sum(

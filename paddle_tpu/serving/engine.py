@@ -203,7 +203,7 @@ class Generation:
                  "done", "error", "slot", "created", "last_poll",
                  "cancelled", "pages", "shared", "prefilling",
                  "prefill_pos", "prefill_t0", "delivered", "fingerprint",
-                 "rng_skip", "spec_proposed", "spec_accepted")
+                 "rng_skip", "spec_proposed", "spec_accepted", "trace_id")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -241,6 +241,11 @@ class Generation:
             + f"|{temperature}|{top_k}|{top_p}|{seed}".encode()
         ).hexdigest()[:16]
         self.rng_skip = 0
+        # stream trace id (wire header "st"): the fleet-unique identity
+        # of the LOGICAL stream this generation serves — minted once at
+        # the first generate_start and replayed verbatim by failover
+        # resume, so one stream's slot events merge across replicas
+        self.trace_id: str | None = None
         # speculative-decoding acceptance accounting (draft tokens this
         # generation proposed / had accepted; stays 0 with spec off)
         self.spec_proposed = 0
@@ -529,6 +534,14 @@ class GenerationEngine:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_verify_steps = 0
+        # XLA compile books: (entry point, shape signature) pairs seen.
+        # The first call with a new signature IS the compile (jit caches
+        # thereafter), so its wall clock approximates compile time; a
+        # second-or-later signature on one entry point is a RECOMPILE —
+        # the classic silent TPU perf killer this surfaces in health
+        self._compiled_seen: set[tuple[str, Any]] = set()
+        self._recompiles = 0
+        self._recompile_ts: deque[float] = deque(maxlen=256)
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -925,8 +938,10 @@ class GenerationEngine:
             fn = self._draft_fns[bucket] = self._build_draft_fn(bucket)
         padded = np.full((bucket,), self._pad, np.int32)
         padded[:T] = ctx
+        t0 = time.perf_counter()
         out = np.asarray(fn(jnp.asarray(padded),
                             jnp.asarray(T, jnp.int32)))
+        self._note_compile("draft", bucket, time.perf_counter() - t0)
         return out[:cap]
 
     def _build_draft_fn(self, bucket: int):
@@ -963,10 +978,59 @@ class GenerationEngine:
             b *= 2
         return min(b, self.max_len)
 
+    # -- stream-lifecycle tracing + compile observability -------------------
+    def _gen_span(self, gen: Generation, name: str, **attrs):
+        """Span for per-generation work: linked under the generation's
+        stream trace id when it carries one (the cross-replica stream
+        timeline obs_dump merges), a plain engine-local span otherwise.
+        The shared no-op when tracing is off — the unflagged path pays
+        one module-attribute read."""
+        if _trace._ACTIVE is None:
+            return _trace._NOOP
+        if gen.trace_id is not None:
+            return _trace.server_span(name, gen.trace_id, None,
+                                      gen=gen.gen_id, **attrs)
+        return _trace.span(name, **attrs)
+
+    def _gen_event(self, gen: Generation, name: str, **attrs) -> None:
+        """Zero-duration stream-lifecycle event (admitted / retire /
+        decode sample) recorded under the stream trace id. No-op unless
+        tracing is on AND the generation carries a stream id."""
+        if _trace._ACTIVE is None or gen.trace_id is None:
+            return
+        with _trace.server_span(name, gen.trace_id, None,
+                                gen=gen.gen_id, **attrs):
+            pass
+
+    def _note_compile(self, entry: str, sig, dt: float) -> None:
+        """Bookkeep one compiled-entry-point call: the first call with a
+        new (entry, shape-signature) pair is the XLA compile (every
+        later call hits the jit cache), so ``dt`` — that call's wall
+        clock — lands in the ``gen/compile_s`` histogram. A second or
+        later signature on one entry point counts as a recompile; their
+        recent-window count is the recompile-storm gauge in
+        :meth:`stats`. After the first sight this is one set lookup."""
+        key = (entry, sig)
+        if key in self._compiled_seen:
+            return
+        with self._cond:
+            if key in self._compiled_seen:
+                return
+            first = not any(k[0] == entry for k in self._compiled_seen)
+            self._compiled_seen.add(key)
+            if not first:
+                self._recompiles += 1
+                self._recompile_ts.append(time.monotonic())
+        observe("gen/compile_s", dt)
+        stat_add("gen/compiles")
+        if not first:
+            stat_add("gen/recompiles")
+
     # -- public surface ----------------------------------------------------
     def start(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
               top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
-              seed: int = 0, rng_skip: int = 0) -> str:
+              seed: int = 0, rng_skip: int = 0,
+              trace_id: str | None = None) -> str:
         """Enqueue a generation; returns its id immediately. Raises
         :class:`EngineOverloaded` (retryable) when every slot is busy and
         the admit queue is at ``queue_max``, and the typed
@@ -975,7 +1039,9 @@ class GenerationEngine:
         sampling-key schedule by that many splits before the first
         token — how a resumed sampled stream replays its RNG position
         (see ``models.generation.advance_key``); greedy requests ignore
-        it."""
+        it. ``trace_id`` is the caller's stream trace id (wire header
+        ``st``): when tracing is on, the engine records this
+        generation's slot-lifecycle events under it."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -1012,6 +1078,8 @@ class GenerationEngine:
                          float(temperature), int(top_k), float(top_p),
                          None if eos is None else int(eos), int(seed))
         gen.rng_skip = rng_skip
+        if trace_id:
+            gen.trace_id = str(trace_id)
         with self._cond:
             if self._stopping:
                 raise RuntimeError("GenerationEngine is stopped")
@@ -1114,6 +1182,8 @@ class GenerationEngine:
                 except ValueError:
                     pass
                 stat_set("gen/queue_depth", len(self._queue))
+                self._gen_event(gen, "gen/retire", reason="cancelled",
+                                tokens=len(gen.tokens))
             self._cond.notify_all()
         return True
 
@@ -1147,6 +1217,16 @@ class GenerationEngine:
                    "tokens_per_step": (
                        self._emit_total / self._decode_iters
                        if self._decode_iters else 0.0),
+                   # XLA compile observability: total distinct compiled
+                   # (entry, shape) signatures, how many were re-compiles
+                   # of an already-compiled entry point, and the storm
+                   # gauge (recompiles in the last 60s — sustained churn
+                   # here means traffic shapes defeat the bucketing)
+                   "compiles": len(self._compiled_seen),
+                   "recompiles": self._recompiles,
+                   "recompile_storm": sum(
+                       1 for t in self._recompile_ts
+                       if time.monotonic() - t < 60.0),
                    "paged": self._paged}
             if self._spec_k > 0:
                 prop = self._spec_proposed
@@ -1232,6 +1312,8 @@ class GenerationEngine:
                     gen.done = True
                     gen.error = gen.error or "engine stopped"
                     gen.slot = None
+                    self._gen_event(gen, "gen/retire", reason="stopped",
+                                    tokens=len(gen.tokens))
                 gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
@@ -1333,6 +1415,8 @@ class GenerationEngine:
             if not g.done:
                 g.done = True
                 g.error = msg
+                self._gen_event(g, "gen/retire", reason="failed",
+                                tokens=len(g.tokens))
             g.slot = None
             g.prefilling = False
             g.pages = []
@@ -1401,6 +1485,8 @@ class GenerationEngine:
                     gen.done = True
                     gen.error = msg
                     gen.slot = None
+                    self._gen_event(gen, "gen/retire", reason="broken",
+                                    tokens=len(gen.tokens))
                 gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
@@ -1463,6 +1549,8 @@ class GenerationEngine:
                     g.done = True
                     g.error = (f"{EXPIRED_MARKER} poll TTL exceeded "
                                "(client gone?)")
+                    self._gen_event(g, "gen/retire", reason="expired",
+                                    tokens=len(g.tokens))
                     self._release_slot_locked(g, evicted=True)
                     try:
                         self._queue.remove(g)
@@ -1486,6 +1574,8 @@ class GenerationEngine:
                 gen.slot = slot
                 stat_set("gen/slots_active",
                          sum(g is not None for g in self._slot_gen))
+                self._gen_event(gen, "gen/admitted", slot=slot,
+                                prompt_len=int(gen.prompt.size))
             self._prefill(gen, slot)
 
     def _admit_paged(self) -> bool:
@@ -1546,6 +1636,9 @@ class GenerationEngine:
                 stat_set("gen/slots_active",
                          sum(g is not None for g in self._slot_gen))
                 stat_set("gen/queue_depth", len(self._queue))
+                self._gen_event(gen, "gen/admitted", slot=slot,
+                                prompt_len=int(gen.prompt.size),
+                                pages=len(gen.pages), shared=gen.shared)
                 progressed = True
 
     def _prefill_tick(self) -> bool:
@@ -1582,8 +1675,8 @@ class GenerationEngine:
                 key = advance_key(key, gen.rng_skip)
             t0 = time.perf_counter()
             try:
-                with _trace.span("gen/prefill_chunk", slot=slot, index=a,
-                                 tokens=b - a, final=final):
+                with self._gen_span(gen, "gen/prefill_chunk", slot=slot,
+                                    index=a, tokens=b - a, final=final):
                     _fault.inject("engine.prefill")
                     self._state, tok0 = self._prefill_fn(
                         self._state, jnp.asarray(pt),
@@ -1597,7 +1690,9 @@ class GenerationEngine:
             except Exception as e:       # a prefill trap implicates
                 self._note_trap([gen], e)     # exactly this request
                 raise
-            observe("gen/prefill_chunk_s", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            observe("gen/prefill_chunk_s", dt)
+            self._note_compile("paged_prefill", bucket, dt)
             self._last_beat = time.monotonic()
             self._consec_traps = 0       # real device work succeeded
             if self._epoch != epoch0:
@@ -1625,6 +1720,8 @@ class GenerationEngine:
                      and tok0 == gen.eos_token_id)
                         or len(gen.tokens) >= gen.max_new_tokens):
                     gen.done = True
+                    self._gen_event(gen, "gen/retire", reason="complete",
+                                    tokens=len(gen.tokens))
                     self._release_slot_locked(gen)
                 self._cond.notify_all()
         return ticked
@@ -1644,8 +1741,8 @@ class GenerationEngine:
         epoch0 = self._epoch
         t0 = time.perf_counter()
         try:
-            with _trace.span("gen/prefill", slot=slot, prompt_len=T0,
-                             bucket=bucket):
+            with self._gen_span(gen, "gen/prefill", slot=slot,
+                                prompt_len=T0, bucket=bucket):
                 _fault.inject("engine.prefill")
                 self._state, tok0 = self._prefill_fn(
                     self._state, jnp.asarray(slot, jnp.int32),
@@ -1657,7 +1754,9 @@ class GenerationEngine:
         except Exception as e:           # a prefill trap implicates
             self._note_trap([gen], e)         # exactly this request
             raise
-        observe("gen/prefill_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        observe("gen/prefill_s", dt)
+        self._note_compile("prefill", bucket, dt)
         self._last_beat = time.monotonic()
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
@@ -1672,6 +1771,8 @@ class GenerationEngine:
                  and tok0 == gen.eos_token_id)
                     or len(gen.tokens) >= gen.max_new_tokens):
                 gen.done = True
+                self._gen_event(gen, "gen/retire", reason="complete",
+                                tokens=len(gen.tokens))
                 self._release_slot_locked(gen)
             self._cond.notify_all()
 
@@ -1753,11 +1854,19 @@ class GenerationEngine:
         observe("gen/decode_step_s", dt)
         if use_spec:
             observe("gen/spec_verify_s", dt)
+        self._note_compile(
+            "spec_step" if use_spec
+            else ("paged_step" if self._paged else "step"), 0, dt)
         self._last_beat = time.monotonic()
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
             raise _EpochChanged("decode step outlived the watchdog "
                                 "deadline")
+        # per-iteration stream sampling (FLAGS_trace_sample, hard-off):
+        # every Nth emitted token of an id-carrying stream records a
+        # gen/decode_sample event — affordable per-iteration visibility
+        sample_n = (int(flag("trace_sample"))
+                    if _trace._ACTIVE is not None else 0)
         with self._cond:
             emitted = 0
             for s, gen in stepped:
@@ -1777,11 +1886,18 @@ class GenerationEngine:
                         stat_add("gen/spec_accepted", acc)
                         stat_add("gen/spec_rejected", dlen - acc)
                         observe("gen/spec_accept_len", float(acc))
+                        if sample_n > 0:
+                            self._gen_event(gen, "gen/spec_accept",
+                                            slot=s, proposed=dlen,
+                                            accepted=acc)
                 else:
                     new = [int(toks[s])]
                 for tok in new:
                     gen.tokens.append(tok)
                     emitted += 1
+                    if sample_n > 0 and len(gen.tokens) % sample_n == 0:
+                        self._gen_event(gen, "gen/decode_sample", slot=s,
+                                        token_index=len(gen.tokens))
                     if ((gen.eos_token_id is not None
                          and tok == gen.eos_token_id)
                             or len(gen.tokens) >= gen.max_new_tokens):
@@ -1789,6 +1905,9 @@ class GenerationEngine:
                         # host; the device state past this point is
                         # garbage but the slot is released right here
                         gen.done = True
+                        self._gen_event(gen, "gen/retire",
+                                        reason="complete",
+                                        tokens=len(gen.tokens))
                         self._release_slot_locked(gen)
                         break
             if use_spec:

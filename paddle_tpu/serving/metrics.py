@@ -1,0 +1,225 @@
+"""Fleet metrics hub: a windowed in-memory TSDB for control loops.
+
+Reference role: the fleet half of ``paddle/fluid/platform/monitor.h`` —
+the reference exported its global ``StatRegistry`` per process and left
+cross-host aggregation to external scrapers; here the
+:class:`ServingController` IS the scraper, so the aggregation layer
+lives in-process. Each controller tick feeds every replica's ``health``
+snapshot into the hub; the hub turns cumulative counters and histogram
+totals into **per-tick deltas** (reset-aware: a restarted replica's
+counters going backwards clamp to zero instead of producing a giant
+negative spike) and answers windowed queries over them:
+
+- ``window_histogram(name, ticks)`` — exact merged distribution of the
+  last N ticks' observations across the whole fleet (possible because
+  every process shares ``monitor._BUCKET_BOUNDS``),
+- ``rate(name, ticks)`` — fleet-wide counter rate per second,
+- ``burn_rates(name, threshold)`` — multi-window SLO **burn rate**: the
+  fraction of windowed observations violating ``threshold``, divided by
+  the error budget.  Burn 1.0 means the budget is being consumed exactly
+  as fast as allowed; the controller requires BOTH a fast (acute) and a
+  slow (sustained) window above ``FLAGS_control_burn_threshold`` before
+  declaring TTFT pressure — the standard multi-window burn-rate alert,
+  replacing the old single-tick raw-p99 breach check that chased noise.
+
+Membership churn is survivable by construction: an endpoint's first
+snapshot is a baseline (no delta), an endpoint that disappears simply
+stops contributing new deltas, and its state is pruned after a full
+slow window of absence.  Gauge-like per-model engine stats
+(``health()["generators"]``) are kept as labeled (endpoint, model)
+last-value series.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from paddle_tpu.core.monitor import hist_fraction_above, merge_histograms
+
+__all__ = ["MetricsHub", "hist_delta"]
+
+
+def hist_delta(prev: dict | None, cur: dict | None) -> dict | None:
+    """Per-window histogram delta from two cumulative raw snapshots
+    (``export_histograms(raw=True)`` docs): what was observed *between*
+    them.  None when there is nothing to diff — no current snapshot, no
+    raw buckets, no previous snapshot (first sight is a baseline), or an
+    empty window.  Negative bucket deltas (endpoint restarted, counters
+    reset) clamp to zero, so a replica bounce reads as an empty window
+    instead of poisoning the merge."""
+    if not cur or not cur.get("buckets"):
+        return None
+    if not prev or not prev.get("buckets"):
+        return None                      # first sight: baseline only
+    buckets = [max(int(c) - int(p), 0)
+               for c, p in zip(cur["buckets"], prev["buckets"])]
+    count = sum(buckets)
+    if count == 0:
+        return None                      # nothing happened this window
+    return {
+        "buckets": buckets,
+        "count": count,
+        "sum": max(float(cur.get("sum", 0.0))
+                   - float(prev.get("sum", 0.0)), 0.0),
+        # min/max are cumulative (not diffable); the current snapshot's
+        # values are the best available bounds for quantile clamping
+        "min": float(cur.get("min", 0.0)),
+        "max": float(cur.get("max", 0.0)),
+    }
+
+
+class _EndpointSeries:
+    """Per-endpoint state: last cumulative snapshots (the delta
+    baselines), a ring of per-tick deltas, and latest per-model gauges.
+    Mutated only under the owning hub's lock."""
+
+    __slots__ = ("prev_hists", "prev_stats", "ticks", "gauges",
+                 "last_tick")
+
+    def __init__(self, slow_ticks: int):
+        self.prev_hists: dict[str, dict] = {}
+        self.prev_stats: dict[str, float] = {}
+        # (tick, ts, hist_deltas, stat_deltas) — slow window bounds it
+        self.ticks: deque[tuple[int, float, dict, dict]] = deque(
+            maxlen=max(slow_ticks, 1))
+        self.gauges: dict[str, dict[str, Any]] = {}
+        self.last_tick = 0
+
+    def ingest(self, tick: int, ts: float, doc: dict) -> None:
+        self.last_tick = tick
+        hists = doc.get("histograms") or {}
+        h_deltas: dict[str, dict] = {}
+        for name, cur in hists.items():
+            d = hist_delta(self.prev_hists.get(name), cur)
+            if d is not None:
+                h_deltas[name] = d
+        self.prev_hists = {n: c for n, c in hists.items()
+                           if isinstance(c, dict)}
+        stats = doc.get("stats") or {}
+        s_deltas: dict[str, float] = {}
+        for name, cur in stats.items():
+            if not isinstance(cur, (int, float)):
+                continue
+            prev = self.prev_stats.get(name)
+            if prev is not None:         # first sight is a baseline
+                s_deltas[name] = max(float(cur) - float(prev), 0.0)
+        self.prev_stats = {n: float(v) for n, v in stats.items()
+                           if isinstance(v, (int, float))}
+        gens = doc.get("generators")
+        if isinstance(gens, dict):
+            self.gauges = {m: dict(g) for m, g in gens.items()
+                           if isinstance(g, dict)}
+        self.ticks.append((tick, ts, h_deltas, s_deltas))
+
+    def window(self, tick: int, ticks: int):
+        """Delta tuples within the last ``ticks`` hub ticks."""
+        lo = tick - max(int(ticks), 1)
+        return [t for t in self.ticks if t[0] > lo]
+
+
+class MetricsHub:
+    """Windowed fleet TSDB fed by controller health scrapes.
+
+    ``fast_ticks``/``slow_ticks`` are the two burn-rate windows (in hub
+    ingests, i.e. controller ticks).  Short histories are not an error:
+    every windowed query uses however many ticks actually exist, so the
+    hub gives sane answers from the second tick onward."""
+
+    def __init__(self, fast_ticks: int = 5, slow_ticks: int = 60):
+        self.fast_ticks = max(int(fast_ticks), 1)
+        self.slow_ticks = max(int(slow_ticks), self.fast_ticks)
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._series: dict[str, _EndpointSeries] = {}
+
+    # -- ingestion ---------------------------------------------------------
+    def ingest(self, healths: dict[str, dict]) -> int:
+        """One hub tick: feed ``{endpoint: health_doc}`` (unreachable or
+        malformed docs are skipped — the endpoint just misses the tick),
+        prune endpoints gone a full slow window, return the tick id."""
+        ts = time.monotonic()
+        with self._lock:
+            self._tick += 1
+            for ep, doc in healths.items():
+                if (not isinstance(doc, dict)
+                        or doc.get("status") == "unreachable"):
+                    continue
+                s = self._series.get(ep)
+                if s is None:
+                    s = self._series[ep] = _EndpointSeries(
+                        self.slow_ticks)
+                s.ingest(self._tick, ts, doc)
+            gone = [ep for ep, s in self._series.items()
+                    if self._tick - s.last_tick > self.slow_ticks]
+            for ep in gone:
+                del self._series[ep]
+            return self._tick
+
+    # -- queries -----------------------------------------------------------
+    def window_histogram(self, name: str,
+                         ticks: int | None = None) -> dict | None:
+        """Merged raw-bucket summary of ``name`` over the last N ticks
+        across every endpoint, or None when nothing was observed."""
+        with self._lock:
+            docs = [d[2][name]
+                    for s in self._series.values()
+                    for d in s.window(self._tick, ticks or self.fast_ticks)
+                    if name in d[2]]
+        if not docs:
+            return None
+        return merge_histograms(docs, raw=True)
+
+    def rate(self, name: str, ticks: int | None = None) -> float:
+        """Fleet-wide counter rate (units/second) of ``name`` over the
+        last N ticks; 0.0 without enough history to span time."""
+        with self._lock:
+            total = 0.0
+            t_lo, t_hi = None, None
+            for s in self._series.values():
+                for tick, ts, _h, sd in s.window(self._tick,
+                                                 ticks or self.fast_ticks):
+                    total += sd.get(name, 0.0)
+                    t_lo = ts if t_lo is None else min(t_lo, ts)
+                    t_hi = ts if t_hi is None else max(t_hi, ts)
+        if t_lo is None or t_hi is None or t_hi <= t_lo:
+            return 0.0
+        return total / (t_hi - t_lo)
+
+    def burn_rates(self, name: str, threshold: float,
+                   budget: float) -> tuple[float, float]:
+        """(fast, slow) SLO burn rates for histogram ``name`` against
+        ``threshold``: violating-fraction / ``budget`` per window.  No
+        observations in a window → 0.0 (no traffic burns no budget)."""
+        burns = []
+        for w in (self.fast_ticks, self.slow_ticks):
+            h = self.window_histogram(name, w)
+            frac = hist_fraction_above(h, threshold) if h else 0.0
+            burns.append(frac / budget if budget > 0 else 0.0)
+        return burns[0], burns[1]
+
+    def gauges(self) -> dict[str, dict[str, dict[str, Any]]]:
+        """Latest (endpoint → model → engine-stats) gauge series."""
+        with self._lock:
+            return {ep: {m: dict(g) for m, g in s.gauges.items()}
+                    for ep, s in self._series.items()}
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-safe introspection doc (tests, chaos checks, dumps)."""
+        with self._lock:
+            return {
+                "tick": self._tick,
+                "fast_ticks": self.fast_ticks,
+                "slow_ticks": self.slow_ticks,
+                "endpoints": {
+                    ep: {"last_tick": s.last_tick,
+                         "ticks": len(s.ticks),
+                         "models": sorted(s.gauges)}
+                    for ep, s in self._series.items()},
+            }

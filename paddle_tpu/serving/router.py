@@ -69,6 +69,7 @@ from typing import Callable
 
 import numpy as np
 
+from paddle_tpu.core import trace as _trace
 from paddle_tpu.core.flags import flag
 from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.core.wire import FrameClient, WireShedError
@@ -271,7 +272,7 @@ class RoutedClient:
         try:
             with FrameClient(endpoint, {}, service="probe",
                              timeout=timeout, retries=0) as c:
-                h = c.health(stats_prefix="\x00none")
+                h = c.health(stats=False)    # liveness only, no stats
             if h.get("status") != "ok":
                 return False, f"status={h.get('status')}"
             return True, None
@@ -452,7 +453,8 @@ class RoutedClient:
 
     def health(self, stats_prefix: str | None = None,
                histograms: bool = False,
-               deep: bool = False) -> dict[str, dict]:
+               deep: bool = False,
+               stats: bool = True) -> dict[str, dict]:
         """endpoint -> server health snapshot (unreachable replicas map
         to ``{"status": "unreachable", ...}``); covers cordoned members
         too — the control plane watches a draining victim's in-flight
@@ -461,7 +463,8 @@ class RoutedClient:
         fleet-wide via ``monitor.merge_histograms``); ``deep`` asks each
         replica to run a one-token canary decode per generator — engine
         liveness ("device healthy") as distinct from the wire liveness
-        ("port open") the shallow probe measures."""
+        ("port open") the shallow probe measures; ``stats=False`` asks
+        for liveness-only docs (no stats payload at all)."""
         out = {}
         for r in list(self._replicas):
             ok, err = self._probe_one(r.endpoint)
@@ -469,7 +472,7 @@ class RoutedClient:
                 try:
                     out[r.endpoint] = self._client(r).health(
                         stats_prefix=stats_prefix, histograms=histograms,
-                        deep=deep)
+                        deep=deep, stats=stats)
                     continue
                 except (ConnectionError, RuntimeError, OSError) as e:
                     err = f"{type(e).__name__}: {e}"
@@ -613,9 +616,14 @@ class StickySession:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         budget = (int(flag("gen_resume_budget")) if resume_budget is None
                   else int(resume_budget))
+        # One stream trace id per LOGICAL stream, minted here so every
+        # resume attempt replays the same id onto its replacement
+        # replica — obs_dump then merges the stream's whole life across
+        # replicas into one trace. Only minted with tracing on.
+        trace_id = _trace.new_id() if _trace.enabled() else None
         kw = dict(temperature=temperature, top_k=top_k, top_p=top_p,
                   eos_token_id=eos_token_id, seed=seed,
-                  poll_wait_s=poll_wait_s)
+                  poll_wait_s=poll_wait_s, trace_id=trace_id)
         if budget <= 0:
             return self._stream_once(model, prompt, max_new_tokens, **kw)
         return self._resuming_stream(model, prompt, max_new_tokens,
@@ -624,7 +632,8 @@ class StickySession:
     def _stream_once(self, model: str, prompt, max_new_tokens: int, *,
                      temperature: float, top_k: int, top_p: float,
                      eos_token_id: int | None, seed: int,
-                     poll_wait_s: float, rng_skip: int = 0):
+                     poll_wait_s: float, rng_skip: int = 0,
+                     trace_id: str | None = None):
         """One pinned stream attempt (the pre-resumption ``generate``
         body). Server-side failures that lost the slot state but left
         the replica up — the ``engine reset:`` marker — surface as
@@ -636,7 +645,7 @@ class StickySession:
             lambda: client.generate_start(
                 model, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
-                seed=seed, rng_skip=rng_skip),
+                seed=seed, rng_skip=rng_skip, trace_id=trace_id),
             during_generation=True)
         with self._lock:
             self._active += 1
@@ -685,7 +694,8 @@ class StickySession:
     def _resuming_stream(self, model: str, prompt, max_new_tokens: int,
                          *, temperature: float, top_k: int, top_p: float,
                          eos_token_id: int | None, seed: int,
-                         poll_wait_s: float, budget: int):
+                         poll_wait_s: float, budget: int,
+                         trace_id: str | None = None):
         """Drive :meth:`_stream_once` attempts, replaying
         ``prompt + delivered`` onto a freshly pinned replica after each
         mid-flight loss, until the stream completes or the budget is
@@ -706,7 +716,8 @@ class StickySession:
                         model, prompt, max_new_tokens,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
-                        seed=seed, poll_wait_s=poll_wait_s)
+                        seed=seed, poll_wait_s=poll_wait_s,
+                        trace_id=trace_id)
                 else:
                     replay = np.concatenate(
                         [prompt, np.asarray(delivered, np.int32)])
@@ -714,7 +725,8 @@ class StickySession:
                         model, replay, max_new_tokens - n0,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
-                        seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0)
+                        seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0,
+                        trace_id=trace_id)
                 for tok in inner:
                     delivered.append(int(tok))
                     yield int(tok)
@@ -743,6 +755,15 @@ class StickySession:
                     getattr(last, "endpoint", None) or "?",
                     attempts=attempts) from last
             stat_add("serving/router/stream_resumes")
+            if trace_id is not None and _trace.enabled():
+                # client-side marker in the SAME stream trace: the
+                # merged dump shows exactly where the replica switch
+                # happened between the dead engine's spans and the
+                # survivor's
+                with _trace.server_span("gen/stream_resume", trace_id,
+                                        None, attempt=attempts,
+                                        delivered=len(delivered)):
+                    pass
             with self._lock:
                 self._endpoint = None    # re-pin over current membership
             time.sleep(min(0.05 * attempts, 0.5))

@@ -28,7 +28,7 @@ from paddle_tpu.models.generation import generate
 from paddle_tpu.serving import (
     GenerationEngine, InProcSpawner, RoutedClient, ServingController,
 )
-from paddle_tpu.serving.control import _hist_delta
+from paddle_tpu.serving.metrics import hist_delta
 
 pytestmark = pytest.mark.control
 
@@ -595,16 +595,69 @@ def test_controller_spawn_preloads_registry(mlp_paths):
 
 
 def test_decisions_are_explainable():
-    d = _hist_delta(None, {"buckets": [1, 2], "count": 3, "sum": 1.0})
+    d = hist_delta(None, {"buckets": [1, 2], "count": 3, "sum": 1.0})
     assert d is None                      # no baseline yet
-    assert _hist_delta({"buckets": [1, 0]},
-                       {"buckets": [1, 0], "count": 1}) is None  # empty
-    d = _hist_delta(
+    assert hist_delta({"buckets": [1, 0]},
+                      {"buckets": [1, 0], "count": 1}) is None  # empty
+    d = hist_delta(
         {"buckets": [1, 2], "count": 3, "sum": 1.0},
         {"buckets": [2, 5], "count": 7, "sum": 4.0, "min": 0.1,
          "max": 0.9})
     assert d["buckets"] == [1, 3] and d["count"] == 4
     assert abs(d["sum"] - 3.0) < 1e-9
+
+
+def _cum_hist(values):
+    """A cumulative raw histogram snapshot, as ``health`` would ship."""
+    h = monitor._Histogram()
+    for v in values:
+        h.observe(v)
+    return h.summary(raw=True)
+
+
+def test_controller_burn_rate_pressure_signals():
+    """TTFT pressure is the multi-window burn rate, not a raw p99
+    breach: the first scrape is a baseline (burn 0), a violating window
+    trips BOTH windows past the threshold, and the resulting decision
+    carries the burn evidence in its signals."""
+    ctl = ServingController(InProcSpawner(_mlp_factory), interval_s=0,
+                            max_replicas=1, breach_ticks=1,
+                            cooldown_s=0.0, target_ttft_s=0.5,
+                            slo_budget=0.1, burn_fast_ticks=2,
+                            burn_slow_ticks=4, burn_threshold=1.0)
+    try:
+        def doc(values):
+            return {"ep": {"status": "ok", "inflight": 0,
+                           "generators": {}, "stats": {},
+                           "histograms": {"gen/ttft_s":
+                                          _cum_hist(values)}}}
+        fast = [0.01] * 5
+        s1 = ctl._signals(doc(fast))
+        assert s1["ttft_burn_fast"] == 0.0      # baseline tick: no delta
+        assert not ctl._pressure(s1)
+        # window 2: five observations at 1.0s — 100% violating, budget
+        # 0.1 -> burn 10x on both windows (one delta tick feeds both)
+        s2 = ctl._signals(doc(fast + [2.0] * 5))
+        assert s2["ttft_burn_fast"] == pytest.approx(10.0)
+        assert s2["ttft_burn_slow"] == pytest.approx(10.0)
+        assert s2["ttft_p99_s"] is not None and s2["ttft_p99_s"] > 0.5
+        reasons = ctl._pressure(s2)
+        assert any("burn rate" in r for r in reasons), reasons
+        d = ctl._decide(s2)                     # at max_replicas: holds,
+        assert d.action == "hold"               # but evidence is logged
+        assert d.signals["ttft_burn_fast"] == pytest.approx(10.0)
+        assert d.signals["ttft_burn_slow"] == pytest.approx(10.0)
+        # two clean ticks push the violation out of the fast window: the
+        # slow window still remembers it, but the PAGE condition needs
+        # both — acute pressure released, no flapping on stale history
+        s3 = ctl._signals(doc(fast + [2.0] * 5 + [0.01] * 20))
+        s4 = ctl._signals(doc(fast + [2.0] * 5 + [0.01] * 40))
+        assert s4["ttft_burn_fast"] == 0.0      # fast window is clean
+        assert s4["ttft_burn_slow"] > 1.0       # slow window remembers
+        assert not ctl._pressure(s4)
+        assert s3["ttft_burn_fast"] < 10.0
+    finally:
+        ctl.close()
 
 
 def test_controller_decision_log_schema(model):

@@ -1,0 +1,173 @@
+"""MetricsHub: the windowed in-memory fleet TSDB the controller scrapes
+into, and the SLO burn-rate math built on it.
+
+The load-bearing properties: deltas are reset-aware (a bounced replica's
+counters going backwards clamp to an empty window, never a negative
+spike), windowed queries use however many ticks exist (sane answers from
+tick 2), and the multi-window burn rate trips on an acute violation but
+releases as soon as the fast window is clean — the slow window alone
+never pages.
+"""
+
+import pytest
+
+from paddle_tpu.core import monitor
+from paddle_tpu.core.monitor import hist_fraction_above
+from paddle_tpu.serving.metrics import MetricsHub, hist_delta
+
+pytestmark = [pytest.mark.obs, pytest.mark.control]
+
+
+def _cum_hist(values):
+    """Cumulative raw histogram snapshot (what ``health`` ships)."""
+    h = monitor._Histogram()
+    for v in values:
+        h.observe(v)
+    return h.summary(raw=True)
+
+
+def _doc(ttft_values, stats=None):
+    return {"status": "ok", "inflight": 0, "generators": {},
+            "stats": dict(stats or {}),
+            "histograms": {"gen/ttft_s": _cum_hist(ttft_values)}}
+
+
+# ---------------------------------------------------------------------------
+# hist_fraction_above (the burn numerator)
+# ---------------------------------------------------------------------------
+
+def test_hist_fraction_above_counts_violating_buckets():
+    doc = _cum_hist([0.01] * 9 + [2.0])
+    assert hist_fraction_above(doc, 0.5) == pytest.approx(0.1)
+    assert hist_fraction_above(doc, 2.0) == 0.0
+    assert hist_fraction_above(doc, 1e-6) == pytest.approx(1.0)
+
+
+def test_hist_fraction_above_boundary_bucket_counts_as_below():
+    """A threshold strictly inside a bucket cannot tell how much of that
+    bucket violates — the fraction under-counts (conservative: never
+    pages on observations that might be fine)."""
+    doc = _cum_hist([0.5])           # lands in the bucket containing 0.5
+    # threshold inside/at the same bucket: its counts read as below
+    assert hist_fraction_above(doc, 0.5) == 0.0
+    # a threshold a full bucket lower sees it as violating
+    assert hist_fraction_above(doc, 0.05) == pytest.approx(1.0)
+
+
+def test_hist_fraction_above_empty_inputs():
+    assert hist_fraction_above({}, 0.5) == 0.0
+    assert hist_fraction_above({"count": 0, "buckets": []}, 0.5) == 0.0
+    assert hist_fraction_above(None, 0.5) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# per-tick deltas: baseline, clamping, reset-awareness
+# ---------------------------------------------------------------------------
+
+def test_hist_delta_reset_clamps_to_empty_window():
+    """A replica restart sends counters BACKWARDS; the delta must read
+    as an empty window, not a negative distribution."""
+    big = _cum_hist([0.1] * 10)
+    small = _cum_hist([0.1] * 3)     # "restarted" snapshot
+    assert hist_delta(big, small) is None
+    d = hist_delta(small, big)       # forward diff still works
+    assert d is not None and d["count"] == 7
+
+
+def test_stat_deltas_are_reset_aware():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=4)
+    hub.ingest({"ep": _doc([], stats={"gen/streams": 10.0})})
+    hub.ingest({"ep": _doc([], stats={"gen/streams": 14.0})})
+    assert hub.rate("gen/streams") > 0.0       # 4 events this window
+    # restart: counter falls back to 1 — clamps to zero, no negatives
+    hub.ingest({"ep": _doc([], stats={"gen/streams": 1.0})})
+    hub.ingest({"ep": _doc([], stats={"gen/streams": 1.0})})
+    assert hub.rate("gen/streams") == 0.0
+
+
+def test_first_sight_is_a_baseline_not_a_delta():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=4)
+    hub.ingest({"ep": _doc([0.1] * 100, stats={"gen/streams": 100.0})})
+    # a brand-new endpoint's lifetime totals must NOT count as one
+    # tick's worth of traffic
+    assert hub.window_histogram("gen/ttft_s") is None
+    assert hub.rate("gen/streams") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# burn-rate window math
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_fast_window_trip():
+    """An acute violation burns BOTH windows past threshold (the slow
+    window contains the fast one), so the page condition trips."""
+    hub = MetricsHub(fast_ticks=2, slow_ticks=6)
+    hub.ingest({"ep": _doc([0.01] * 5)})             # baseline
+    hub.ingest({"ep": _doc([0.01] * 5 + [2.0] * 5)})  # 100% violating
+    fast, slow = hub.burn_rates("gen/ttft_s", 0.5, budget=0.1)
+    assert fast == pytest.approx(10.0)
+    assert slow == pytest.approx(10.0)
+
+
+def test_burn_rate_slow_window_holds_memory_fast_releases():
+    """Clean ticks push the violation out of the fast window while the
+    slow window still remembers it — exactly the asymmetry that makes
+    the dual-window condition flap-proof."""
+    hub = MetricsHub(fast_ticks=2, slow_ticks=6)
+    cum = [0.01] * 5
+    hub.ingest({"ep": _doc(cum)})
+    cum = cum + [2.0] * 5
+    hub.ingest({"ep": _doc(cum)})
+    for _ in range(2):                   # two clean ticks
+        cum = cum + [0.01] * 20
+        hub.ingest({"ep": _doc(cum)})
+    fast, slow = hub.burn_rates("gen/ttft_s", 0.5, budget=0.1)
+    assert fast == 0.0                   # fast window: clean ticks only
+    assert 0.0 < slow < 10.0             # slow window: diluted memory
+
+
+def test_burn_rate_no_traffic_burns_nothing():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=4)
+    assert hub.burn_rates("gen/ttft_s", 0.5, budget=0.1) == (0.0, 0.0)
+    hub.ingest({"ep": _doc([0.01])})
+    assert hub.burn_rates("gen/ttft_s", 0.5, budget=0.1) == (0.0, 0.0)
+    # zero/negative budget can never page
+    hub.ingest({"ep": _doc([0.01, 1.0, 1.0])})
+    assert hub.burn_rates("gen/ttft_s", 0.5, budget=0.0) == (0.0, 0.0)
+
+
+def test_window_histogram_merges_across_endpoints():
+    hub = MetricsHub(fast_ticks=3, slow_ticks=6)
+    hub.ingest({"a": _doc([0.01]), "b": _doc([0.2] * 3)})
+    hub.ingest({"a": _doc([0.01] * 6), "b": _doc([0.2] * 3 + [0.4] * 5)})
+    win = hub.window_histogram("gen/ttft_s")
+    assert win is not None
+    assert win["count"] == 10            # 5 new on a + 5 new on b
+
+
+# ---------------------------------------------------------------------------
+# membership churn
+# ---------------------------------------------------------------------------
+
+def test_unreachable_docs_are_skipped_and_endpoints_pruned():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=3)
+    hub.ingest({"a": _doc([0.1]), "b": _doc([0.1])})
+    hub.ingest({"a": _doc([0.1] * 2),
+                "b": {"status": "unreachable", "error": "boom"}})
+    assert set(hub.endpoints()) == {"a", "b"}
+    # b misses a full slow window of ticks -> pruned, a keeps answering
+    for i in range(3, 7):
+        hub.ingest({"a": _doc([0.1] * i)})
+    assert hub.endpoints() == ["a"]
+    assert hub.window_histogram("gen/ttft_s") is not None
+    snap = hub.snapshot()
+    assert snap["tick"] == 6 and list(snap["endpoints"]) == ["a"]
+
+
+def test_gauges_track_latest_per_model_engine_stats():
+    hub = MetricsHub()
+    doc = _doc([])
+    doc["generators"] = {"llm": {"slots": 4, "active": 2, "queued": 1}}
+    hub.ingest({"ep": doc})
+    g = hub.gauges()
+    assert g["ep"]["llm"]["active"] == 2

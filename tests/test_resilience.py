@@ -15,8 +15,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu
-from paddle_tpu.core import fault
-from paddle_tpu.core.flags import flag
+from paddle_tpu.core import fault, trace
+from paddle_tpu.core.flags import flag, get_flags, set_flags
 from paddle_tpu.core.monitor import get_stat
 from paddle_tpu.io.serving import InferenceClient, InferenceServer
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -101,6 +101,73 @@ def test_resume_after_replica_kill_greedy_identical(model):
         router.close()
         for s in servers:
             s.stop()
+
+
+@pytest.mark.obs
+def test_failover_stream_is_one_trace_across_replicas(model):
+    """A traced stream that fails over keeps ONE trace id: the victim's
+    admission and the survivor's completion land under the same stream
+    trace id (what obs_dump merges into a single cross-replica
+    timeline), joined by the router's gen/stream_resume marker."""
+    saved = get_flags(["trace", "trace_buffer"])
+    set_flags({"trace_buffer": 4096, "trace": True})
+    trace.clear()
+    servers, engines = [], []
+    try:
+        for _ in range(2):
+            eng = GenerationEngine(model, slots=2, max_len=32,
+                                   step_wait_s=0.03)
+            srv = InferenceServer().start()
+            srv.add_generator("llm", eng)
+            servers.append(srv)
+            engines.append(eng)
+        router = RoutedClient([s.endpoint for s in servers],
+                              probe_interval_s=0)
+        try:
+            rs = np.random.RandomState(43)
+            prompt = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+            ref = np.asarray(generate(model, prompt[None], 10))[0, 5:]
+            sess = router.session("traced-victim")
+            it = sess.generate("llm", prompt, 10, poll_wait_s=0.05,
+                               resume_budget=2)
+            toks = [next(it), next(it)]
+            pinned = sess.endpoint
+            victim = next(s for s in servers if s.endpoint == pinned)
+            victim.stop()
+            toks += list(it)
+            np.testing.assert_array_equal(np.asarray(toks, np.int32),
+                                          ref)
+
+            spans = trace.get_spans()
+            # stream-lifecycle spans: per-generation events (they carry
+            # the gen id) plus the router's resume marker — NOT the
+            # engine-wide gen/decode_step spans, which mint their own
+            # trace ids
+            stream_ids = {sp["trace_id"] for sp in spans
+                          if sp["name"].startswith("gen/")
+                          and ("gen" in (sp.get("attrs") or {})
+                               or sp["name"] == "gen/stream_resume")}
+            assert len(stream_ids) == 1    # whole life under ONE id
+            sid, = stream_ids
+            mine = [sp for sp in spans if sp["trace_id"] == sid]
+            # both replicas admitted the stream: the in-proc servers
+            # share one process tracer, so the engine loop thread id is
+            # what tells the two replicas' spans apart
+            admits = [sp for sp in mine if sp["name"] == "gen/admitted"]
+            assert len(admits) == 2
+            assert len({sp["tid"] for sp in admits}) == 2
+            names = {sp["name"] for sp in mine}
+            assert "gen/stream_resume" in names
+            assert any((sp.get("attrs") or {}).get("reason")
+                       == "complete" for sp in mine
+                       if sp["name"] == "gen/retire")
+        finally:
+            router.close()
+    finally:
+        for s in servers:
+            s.stop()
+        set_flags(saved)
+        trace.clear()
 
 
 def test_resume_budget_exhaustion_surfaces_typed(model):

@@ -93,6 +93,27 @@ def test_ring_buffer_caps_memory():
     assert [s["name"] for s in spans] == [f"s{n}" for n in range(22, 30)]
 
 
+def test_live_resize_keeps_newest_spans():
+    """Regression: resizing the buffer on a LIVE tracer used to swap in
+    an empty ring, silently dropping every buffered span. A shrink must
+    keep the newest spans that still fit; a grow must keep everything."""
+    _tracing_on(capacity=16)
+    for n in range(10):
+        with trace.span(f"s{n}"):
+            pass
+    set_flags({"trace_buffer": 4})           # live shrink
+    spans = trace.get_spans()
+    assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"], \
+        "shrink keeps the newest tail, not an empty ring"
+    set_flags({"trace_buffer": 64})          # live grow
+    assert [s["name"] for s in trace.get_spans()] == \
+        ["s6", "s7", "s8", "s9"], "grow keeps every surviving span"
+    with trace.span("after"):
+        pass
+    assert trace.get_spans()[-1]["name"] == "after"
+    assert trace.snapshot()["capacity"] == 64
+
+
 def test_span_records_exception_type():
     _tracing_on()
     with pytest.raises(ValueError):

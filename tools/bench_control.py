@@ -16,7 +16,12 @@ Two scenarios, both CPU, both end-to-end over the real wire:
    ramp ends, the idle fleet scales back down through a sticky drain
    with a live pinned stream riding through it — zero lost tokens, zero
    GenerationFailed, drain clean.
-2. **multiplex**: one replica, warm-tier capacity 2, FOUR registered
+2. **burn**: the same ramp against a fleet whose ONLY scale-up signal
+   is the dual-window SLO burn rate (queue and occupancy pressure
+   disabled) — every scale-up must cite the burn in its reason and
+   record ``ttft_burn_fast``/``ttft_burn_slow`` evidence above the
+   threshold in its decision signals.
+3. **multiplex**: one replica, warm-tier capacity 2, FOUR registered
    models. Round-robin inference across all four: every model stays
    servable (cold faults ride ``load_model``; LRU eviction keeps
    residency <= 2), outputs exactly match per-model direct Predictor
@@ -60,6 +65,8 @@ WAVES_HIGH = 4
 TTFT_SLO_S = 0.55       # what the autoscaled fleet must meet at steady
 #                         state (static: ~2 full generations of queue
 #                         wait at HIGH_STREAMS over one replica's slots)
+BURN_TTFT_SLO_S = 0.3   # burn-only run: tight enough that the high
+#                         waves' queue wait lands in violating buckets
 MAX_REPLICAS = 3
 
 
@@ -125,22 +132,37 @@ def _wave(router: RoutedClient, prompts, n_streams: int,
     return [t for t in ttfts if t is not None]
 
 
-def run_fleet(model, controlled: bool) -> dict:
-    """The ramp against either a static 1-replica fleet or the
-    controlled fleet. Returns per-wave TTFT quantiles + fleet events."""
+def run_fleet(model, controlled: bool, burn: bool = False) -> dict:
+    """The ramp against a static 1-replica fleet, the controlled fleet,
+    or (``burn=True``) a fleet whose ONLY scale-up signal is the
+    multi-window SLO burn rate: queue + occupancy pressure are disabled
+    so every scale-up is attributable to the MetricsHub burn math, and
+    the decision log must carry the burn evidence. Returns per-wave
+    TTFT quantiles + fleet events."""
     spawner = InProcSpawner(_engine_factory(model))
+    kw: dict = {}
+    if burn:
+        # burn-only: queue_high=0 disables the queue signal, occupancy
+        # can never reach 2.0, and the tight target puts the high-wave
+        # queue wait squarely in the violating buckets
+        kw = dict(queue_high=0.0, occupancy_high=2.0,
+                  target_ttft_s=BURN_TTFT_SLO_S, slo_budget=0.1,
+                  burn_fast_ticks=3, burn_slow_ticks=12,
+                  burn_threshold=1.0)
     ctl = ServingController(
         spawner, interval_s=0.25 if controlled else 0,
         min_replicas=1, max_replicas=MAX_REPLICAS if controlled else 0,
         breach_ticks=1, idle_ticks=3, cooldown_s=1.0,
-        queue_high=0.5, target_ttft_s=TTFT_SLO_S, drain_s=20.0)
+        **(kw or dict(queue_high=0.5, target_ttft_s=TTFT_SLO_S)),
+        drain_s=20.0)
     ctl.start()
     errors: list = []
     rs = np.random.RandomState(3)
     prompts = [rs.randint(0, VOCAB, (6,)).astype(np.int32)
                for _ in range(4)]
     waves = []
-    result: dict = {"mode": "controlled" if controlled else "static"}
+    result: dict = {"mode": ("burn" if burn else
+                             "controlled" if controlled else "static")}
     try:
         # low phase: 2 streams — no pressure, fleet must NOT grow
         waves.append(("low", _quantiles(
@@ -244,6 +266,7 @@ def main() -> int:
         "config": {"slots_per_replica": SLOTS, "step_wait_s": STEP_WAIT_S,
                    "new_tokens": NEW_TOKENS, "high_streams": HIGH_STREAMS,
                    "waves_high": WAVES_HIGH, "ttft_slo_s": TTFT_SLO_S,
+                   "burn_ttft_slo_s": BURN_TTFT_SLO_S,
                    "max_replicas": MAX_REPLICAS},
     }
     print("== static fleet (1 replica) ==")
@@ -275,6 +298,32 @@ def main() -> int:
         and not static["errors"] and not controlled["errors"])
     results["autoscale_ok"] = autoscale_ok
 
+    print("== burn-rate-driven fleet (TTFT burn is the ONLY signal) ==")
+    burn = run_fleet(model, controlled=True, burn=True)
+    print(json.dumps(burn["waves"], indent=2))
+    results["burn"] = burn
+    burn_ups = [d for d in burn["decisions"]
+                if d["action"] == "scale_up"
+                and "burn rate" in d["reason"]]
+    results["burn_parsed"] = {
+        "metric": "scale-ups driven purely by the dual-window SLO burn "
+                  "rate (queue/occupancy pressure disabled), with the "
+                  "burn evidence recorded in each decision's signals",
+        "burn_scale_ups": len(burn_ups),
+        "evidence": [{"reason": d["reason"],
+                      "ttft_burn_fast": d["signals"]["ttft_burn_fast"],
+                      "ttft_burn_slow": d["signals"]["ttft_burn_slow"]}
+                     for d in burn_ups],
+    }
+    burn_ok = (
+        len(burn_ups) >= 1
+        and all(d["signals"].get("ttft_burn_fast", 0.0) > 1.0
+                and d["signals"].get("ttft_burn_slow", 0.0) > 1.0
+                for d in burn_ups)
+        and burn["replicas_at_peak"] >= 2
+        and not burn["errors"])
+    results["burn_ok"] = burn_ok
+
     print("== multiplex (4 models, warm capacity 2, 1 replica) ==")
     with tempfile.TemporaryDirectory(prefix="ptpu_bench_ctl_") as tmp:
         mux = run_multiplex(tmp)
@@ -292,7 +341,7 @@ def main() -> int:
         "value": results["autoscale_parsed"]["speedup"],
         "unit": "x",
     }
-    results["ok"] = bool(autoscale_ok and multiplex_ok)
+    results["ok"] = bool(autoscale_ok and burn_ok and multiplex_ok)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=2)
     print(json.dumps(results["parsed"], indent=2))

@@ -61,6 +61,15 @@ Scenarios (all CPU-only, single process):
     byte-identical to solo ``generate()`` (``stream_resumes>=1``), the
     survivor's page pool drains back to full despite speculative
     rollback traffic, and health ships the acceptance stats.
+12. **obs-fleet**: a TRACED stream (``FLAGS_trace`` inherited by the
+    subprocess replicas) is SIGKILLed mid-flight and resumes on the
+    survivor under the SAME stream trace id — the victim's span buffer,
+    scraped moments before the kill, merges with the survivor's
+    (scraped after completion) into one Chrome trace whose
+    cross-endpoint stream count is >= 1 and whose merged timeline ends
+    in the survivor's ``gen/retire reason=complete``; meanwhile a
+    MetricsHub fed from routed ``health`` keeps answering windowed
+    queries through the membership churn and prunes the dead replica.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
@@ -156,6 +165,15 @@ def check_defaults_off() -> None:
           and sk["gen_spec_ngram"] >= 1           # sane when opted in
           and 0.0 <= sk["gen_spec_shed_occupancy"] <= 1.0,
           str(sk))
+    ob = get_flags(["trace_sample", "control_slo_budget",
+                    "control_burn_fast_ticks", "control_burn_slow_ticks",
+                    "control_burn_threshold"])
+    check("defaults/obs_burn_off",
+          ob["trace_sample"] == 0                 # no per-token spans
+          and ob["control_slo_budget"] > 0        # sane when opted in
+          and 1 <= ob["control_burn_fast_ticks"]
+          <= ob["control_burn_slow_ticks"]
+          and ob["control_burn_threshold"] > 0, str(ob))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -989,6 +1007,123 @@ def scenario_gen_spec(tmp: str) -> None:
             spawner.kill(ep)
 
 
+def scenario_obs_fleet(tmp: str) -> None:
+    """SIGKILL a subprocess replica holding a live TRACED stream: the
+    victim's span buffer is scraped moments before the kill (a dead
+    replica can't be scraped), the stream resumes on the survivor under
+    the SAME stream trace id, and obs_dump merges the two scrapes —
+    taken at different times — into one Chrome trace with >= 1
+    cross-endpoint stream ending in the survivor's retire(complete).
+    A MetricsHub fed from routed health keeps answering through the
+    membership churn and prunes the dead replica."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+    from paddle_tpu.serving.metrics import MetricsHub
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_dump
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    saved = get_flags(["trace", "trace_buffer"])
+    # the replicas are subprocesses: they read tracing from the env they
+    # inherit, so export BEFORE spawning; the parent traces too (the
+    # router's gen/stream_resume marker lives in this process)
+    os.environ["FLAGS_trace"] = "1"
+    os.environ["FLAGS_trace_buffer"] = "4096"
+    set_flags({"trace_buffer": 4096, "trace": True})
+    trace.clear()
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05"))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    hub = MetricsHub(fast_ticks=2, slow_ticks=6)
+    try:
+        rs = np.random.RandomState(53)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 5:]
+        sess = router.session("traced-kill")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]          # the stream is live
+        victim = sess.endpoint
+        hub.ingest(router.health(stats_prefix="gen/", histograms=True))
+        # scrape the victim WHILE IT LIVES: its half of the stream's
+        # life has to come out of its buffer before the SIGKILL
+        pre = obs_dump.scrape(victim, clear=False, stats_prefix=None,
+                              timeout=5.0)
+        spawner.kill(victim)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes on the survivor
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("obsfleet/stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={len(toks)}")
+        survivor = next(ep for ep in eps if ep != victim)
+        post = obs_dump.scrape(survivor, clear=False, stats_prefix=None,
+                               timeout=5.0)
+        # two scrapes, two moments in time, ONE stream trace
+        doc = obs_dump.merge_chrome([pre, post])
+        parsed = json.loads(json.dumps(doc))
+        check("obsfleet/merged_chrome_trace_parses",
+              len(parsed.get("traceEvents", [])) > 0,
+              f"events={len(parsed.get('traceEvents', []))}")
+        report = obs_dump.build_report([pre, post], doc=doc)
+        crossed = report["cross_endpoint_streams"]
+        check("obsfleet/failover_stream_is_one_cross_replica_trace",
+              report["cross_endpoint_stream_ids"] >= 1
+              and any(d["retired"] == "complete"
+                      and len(d["endpoints"]) == 2
+                      and "gen/admitted" in d["names"]
+                      for d in crossed.values()),
+              json.dumps(crossed))
+        check("obsfleet/resume_marker_traced_in_router",
+              any(sp["name"] == "gen/stream_resume"
+                  for sp in trace.get_spans()), "")
+        # the hub keeps answering through the churn: the dead replica's
+        # doc goes unreachable, the survivor's deltas keep flowing, and
+        # a full slow window later the victim is pruned
+        hub.ingest(router.health(stats_prefix="gen/", histograms=True))
+        toks2 = list(router.generate("llm", prompt, 12,
+                                     poll_wait_s=0.05))
+        check("obsfleet/survivor_still_serves",
+              np.array_equal(np.asarray(toks2, np.int32), ref),
+              f"toks={len(toks2)}")
+        # six more ticks: the victim (last seen tick 1) falls a full
+        # slow window behind and is pruned at tick 8, while the
+        # survivor's post-kill traffic delta (tick 3) is still inside
+        # the slow window — churn must not blind the windowed series
+        for _ in range(6):
+            hub.ingest(router.health(stats_prefix="gen/",
+                                     histograms=True))
+        win = hub.window_histogram("gen/ttft_s", 6)
+        burn = hub.burn_rates("gen/ttft_s", 0.5, budget=0.1)
+        check("obsfleet/hub_series_survive_membership_churn",
+              hub.endpoints() == [survivor]
+              and win is not None and win["count"] >= 1
+              and all(b >= 0.0 for b in burn),
+              f"eps={hub.endpoints()} win={win and win['count']} "
+              f"burn={burn}")
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+        del os.environ["FLAGS_trace"]
+        del os.environ["FLAGS_trace_buffer"]
+        set_flags(saved)
+        trace.clear()
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -998,7 +1133,7 @@ def main() -> int:
                          scenario_obs, scenario_serving_routed,
                          scenario_gen_engine, scenario_gen_paged,
                          scenario_control_plane, scenario_gen_resilience,
-                         scenario_gen_spec):
+                         scenario_gen_spec, scenario_obs_fleet):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a

@@ -69,6 +69,43 @@ def merge_fleet_histograms(scrapes: list[dict]) -> dict[str, dict]:
             for name, docs in sorted(by_name.items())}
 
 
+def stream_traces(scrapes: list[dict]) -> dict[str, dict]:
+    """Group engine stream-lifecycle spans by stream trace id.
+
+    A *stream trace* is any trace id carrying ``gen/``-prefixed spans —
+    the per-stream lifecycle events the engine emits (admitted, prefill,
+    decode samples, retire-with-reason) plus the router's
+    ``gen/stream_resume`` markers. A stream that failed over mid-flight
+    keeps ONE id across replicas, so its entry here lists every endpoint
+    that carried part of its life — the dead replica's prefix (scraped
+    before the kill, or from its buffer if it survived) and the
+    survivor's completion merge into a single timeline."""
+    out: dict[str, dict] = {}
+    for s in scrapes:
+        for sp in s.get("spans", ()):
+            name = sp.get("name", "")
+            tid = sp.get("trace_id")
+            if not tid or not name.startswith("gen/"):
+                continue
+            # engine-wide spans (gen/decode_step, gen/spec_verify) mint
+            # their own trace ids per step — only spans tied to one
+            # generation (they carry its gen id) are stream lifecycle
+            if ("gen" not in (sp.get("attrs") or {})
+                    and name != "gen/stream_resume"):
+                continue
+            d = out.setdefault(tid, {"endpoints": set(), "spans": 0,
+                                     "names": set(), "retired": None})
+            d["endpoints"].add(s["endpoint"])
+            d["spans"] += 1
+            d["names"].add(name)
+            if name == "gen/retire":
+                d["retired"] = (sp.get("attrs") or {}).get("reason")
+    return {tid: {"endpoints": sorted(d["endpoints"]),
+                  "spans": d["spans"], "names": sorted(d["names"]),
+                  "retired": d["retired"]}
+            for tid, d in sorted(out.items())}
+
+
 def merge_chrome(scrapes: list[dict]) -> dict:
     """All endpoints' spans → one Chrome trace document, one pid per
     endpoint (named), events sorted by wall-clock so shared trace ids
@@ -81,6 +118,55 @@ def merge_chrome(scrapes: list[dict]) -> dict:
     # metadata events (ph: M) carry no ts; keep them first
     events.sort(key=lambda e: e.get("ts", -1.0))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def build_report(scrapes: list[dict], *, failed: list[dict] = (),
+                 out: str | None = None, doc: dict | None = None) -> dict:
+    """The fleet summary document from an arbitrary scrape list — the
+    scrapes need not be simultaneous: a replica scraped BEFORE it was
+    killed merges with survivors scraped after, which is exactly how
+    the chaos harness proves a failed-over stream is one trace."""
+    doc = doc if doc is not None else merge_chrome(scrapes)
+    traces: set[str] = set()
+    joined: set[str] = set()       # trace ids seen on >1 endpoint
+    for s in scrapes:
+        mine = {sp["trace_id"] for sp in s["spans"]}
+        joined |= traces & mine
+        traces |= mine
+    streams = stream_traces(scrapes)
+    cross_streams = {tid: d for tid, d in streams.items()
+                     if len(d["endpoints"]) > 1}
+    merged_hists = merge_fleet_histograms(scrapes)
+    report = {
+        "ok": True,
+        "out": out,
+        "endpoints": [{
+            "endpoint": s["endpoint"], "service": s["service"],
+            "tracing": s["tracing"], "spans": len(s["spans"]),
+            "status": s["health"]["status"],
+            "inflight": s["health"]["inflight"],
+        } for s in scrapes],
+        "failed": list(failed),
+        "trace_ids": len(traces),
+        "cross_endpoint_trace_ids": len(joined),
+        "stream_trace_ids": len(streams),
+        "cross_endpoint_stream_ids": len(cross_streams),
+        # full detail only for the interesting ones: streams whose life
+        # spans replicas (failover survivors)
+        "cross_endpoint_streams": cross_streams,
+        "events": len(doc["traceEvents"]),
+        "histograms": {
+            name: {k: round(float(h[k]), 6)
+                   for k in ("count", "p50", "p95", "p99")}
+            for name, h in merged_hists.items()},
+    }
+    # serving-batch amortization in one line: mean rows per predictor
+    # run across the fleet (1.0 == batching never coalesced anything)
+    bs = merged_hists.get("serving/batch_size")
+    if bs and bs["count"]:
+        report["mean_serving_batch_rows"] = round(
+            bs["sum"] / bs["count"], 2)
+    return report
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -114,38 +200,7 @@ def main(argv: list[str] | None = None) -> int:
     doc = merge_chrome(scrapes)
     with open(args.out, "w") as f:
         json.dump(doc, f)
-
-    traces: set[str] = set()
-    joined: set[str] = set()       # trace ids seen on >1 endpoint
-    for s in scrapes:
-        mine = {sp["trace_id"] for sp in s["spans"]}
-        joined |= traces & mine
-        traces |= mine
-    merged_hists = merge_fleet_histograms(scrapes)
-    report = {
-        "ok": True,
-        "out": args.out,
-        "endpoints": [{
-            "endpoint": s["endpoint"], "service": s["service"],
-            "tracing": s["tracing"], "spans": len(s["spans"]),
-            "status": s["health"]["status"],
-            "inflight": s["health"]["inflight"],
-        } for s in scrapes],
-        "failed": failed,
-        "trace_ids": len(traces),
-        "cross_endpoint_trace_ids": len(joined),
-        "events": len(doc["traceEvents"]),
-        "histograms": {
-            name: {k: round(float(h[k]), 6)
-                   for k in ("count", "p50", "p95", "p99")}
-            for name, h in merged_hists.items()},
-    }
-    # serving-batch amortization in one line: mean rows per predictor
-    # run across the fleet (1.0 == batching never coalesced anything)
-    bs = merged_hists.get("serving/batch_size")
-    if bs and bs["count"]:
-        report["mean_serving_batch_rows"] = round(
-            bs["sum"] / bs["count"], 2)
+    report = build_report(scrapes, failed=failed, out=args.out, doc=doc)
     print(json.dumps(report, indent=2))
     if args.prom:
         from paddle_tpu.core.monitor import export_prometheus
