@@ -283,6 +283,21 @@ define_flag("gen_spec_shed_occupancy", 0.5,
             "decode already fills the MXU under load, so speculative "
             "extra FLOPs would only steal from co-tenants. Speculation "
             "resumes as occupancy falls. Ignored while gen_spec_k=0")
+# --- sharded serving: tensor-parallel engine mesh (serving/layout.py) ---
+define_flag("gen_mesh_tp", 0,
+            "Tensor-parallel degree of the GenerationEngine device mesh: "
+            "the engine is built over the first N local devices on a "
+            "'tp' mesh axis, model params column/row-split on the "
+            "attention/MLP projections (Megatron-LM) and the KV "
+            "cache/page pool sharded on the KV-head axis, with every "
+            "compiled entry point given explicit in/out shardings so "
+            "XLA's SPMD partitioner inserts the collectives. A "
+            "mesh-backed engine is ONE logical replica (one endpoint); "
+            "token streams are byte-identical to the unsharded engine. "
+            "0 — the default — builds no mesh at all: the single-device "
+            "path is byte-identical to the pre-sharding build and the "
+            "flag is read only at engine construction, never on the "
+            "decode hot path")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

@@ -237,6 +237,11 @@ class InferenceServer(FrameService):
         proposed/accepted/rejected counts and ``accept_rate``, so the
         control plane can see speculation efficiency next to slot
         occupancy and tell a speculation win from a batching win.
+        Every generator further ships a ``device`` block (platform,
+        device count, mesh axis sizes, total + per-device KV bytes):
+        a mesh-backed tensor-parallel engine (``FLAGS_gen_mesh_tp``)
+        is ONE replica behind one endpoint, and this block is how its
+        topology stays visible to placement decisions.
         ``stats_prefix`` keeps filtering the monitor-stats snapshot
         only — the ``models``/``generators`` sections always ship (they
         are the decision inputs a control loop polls for). ``deep``

@@ -32,7 +32,23 @@ import numpy as np
 
 __all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
            "paged_gather", "paged_scatter", "advance_key", "ngram_propose",
-           "speculative_generate"]
+           "speculative_generate", "STACKED_KV_SPEC", "POOL_KV_SPEC"]
+
+# --- sharded-KV spec map (the serving DeviceLayout contract) ----------
+# Tensor-parallel serving shards the KV cache on the KV-head axis (Pope
+# et al., "Efficiently Scaling Transformer Inference", 2022) — the axis
+# the column-split wk/wv projections already produce sharded, so cache
+# writes and attention reads need no resharding collective. Where that
+# axis sits depends on the engine layout:
+#   stacked contiguous leaves  [slots, L, 1, Hkv, S, *rest]  -> axis 3
+#   paged pool leaves [num_pages + 1, L, Hkv, page_tokens, *rest] -> 2
+# Both are PREFIX specs (shorter than the leaf rank), so the int8
+# quantized layout's scale leaves — one trailing dim shorter than their
+# data leaves — shard identically on the same Hkv axis.
+from jax.sharding import PartitionSpec as _P
+
+STACKED_KV_SPEC = _P(None, None, None, "tp")
+POOL_KV_SPEC = _P(None, None, "tp")
 
 
 def advance_key(key, steps):

@@ -353,6 +353,28 @@ class LlamaForCausalLM(Module):
         from paddle_tpu.models.generation import generate
         return generate(self, input_ids, max_new_tokens, **kwargs)
 
+    def shard_for_inference(self, mesh):
+        """Place parameters under ``NamedSharding`` on ``mesh`` using
+        the per-module spec map (table in the module docstring) — the
+        Megatron column/row split applied at inference time. A serving
+        mesh has degree 1 on every non-tp axis, so only the tp split is
+        material there; the same call works on a training fsdp×tp mesh.
+        Validates the head counts against the mesh's tp degree up front
+        (an indivisible KV-head axis would silently pad-shard the KV
+        cache) and returns the sharded model."""
+        from paddle_tpu.core.module import partition_specs
+        from paddle_tpu.parallel.mesh import sharding_tree
+        tp = int(dict(mesh.shape).get("tp", 1))
+        cfg = self.config
+        if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_heads={cfg.num_heads} and "
+                f"num_kv_heads={cfg.num_kv_heads} (attention projections "
+                "column-split per head; the KV cache shards on the "
+                "KV-head axis)")
+        return jax.device_put(self, sharding_tree(mesh,
+                                                  partition_specs(self)))
+
     def loss(self, input_ids, labels, ignore_index: int = -100,
              training: bool = True):
         """Next-token cross entropy (labels = input shifted by caller or

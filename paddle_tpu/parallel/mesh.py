@@ -61,6 +61,24 @@ def mesh_from_strategy(strategy: DistributedStrategy,
     return create_mesh(strategy.parallel_degrees(), devices)
 
 
+def serving_mesh(tp: int, devices: Sequence | None = None) -> Mesh:
+    """Inference-time tensor-parallel mesh: exactly the first ``tp``
+    local devices on the canonical axis order, every non-tp axis degree
+    1. ``create_mesh`` folds a leftover device factor into "dp" — right
+    for training, wrong for a serving replica that wants exactly ``tp``
+    chips and no data parallelism — so the device list is truncated
+    here before the mesh is built."""
+    if tp < 1:
+        raise ValueError(f"serving mesh needs tp >= 1, got {tp}")
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"serving mesh needs {tp} devices, have {len(devices)} "
+            "(on CPU, force more with XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N)")
+    return create_mesh({"tp": tp}, devices=devices[:tp])
+
+
 def create_hybrid_mesh(ici_degrees: dict[str, int],
                        dcn_degrees: dict[str, int] | None = None) -> Mesh:
     """Multi-slice mesh: ``dcn_degrees`` axes span slices over the data-

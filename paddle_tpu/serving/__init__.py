@@ -30,6 +30,7 @@ from paddle_tpu.serving.engine import (
     EngineOverloaded, Generation, GenerationEngine, GenerationExpired,
     RequestQuarantined,
 )
+from paddle_tpu.serving.layout import DeviceLayout
 from paddle_tpu.serving.metrics import MetricsHub, hist_delta
 from paddle_tpu.serving.router import (
     GenerationFailed, ReplicaState, RoutedClient, StickySession,
@@ -41,4 +42,5 @@ __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "StickySession", "GenerationFailed", "ServingController",
            "ControlDecision", "ReplicaSpawner", "InProcSpawner",
            "SubprocessSpawner", "RequestQuarantined", "GenerationExpired",
-           "StreamResumeExhausted", "MetricsHub", "hist_delta"]
+           "StreamResumeExhausted", "MetricsHub", "hist_delta",
+           "DeviceLayout"]

@@ -435,6 +435,16 @@ class GenerationEngine:
     ``generate()`` in both modes, under any co-tenant mix, page reuse,
     and chunked prefill.
 
+    ``mesh_tp`` defaults to ``FLAGS_gen_mesh_tp`` (0 = no mesh: the
+    single-device path, byte-identical to the pre-sharding build). A
+    positive degree builds the engine over a tensor-parallel device
+    mesh — params column/row-split, KV cache/page pool sharded on the
+    KV-head axis, every compiled entry point given explicit in/out
+    shardings (``serving/layout.py``). Token streams stay
+    byte-identical across layouts, so failover/resume compose with any
+    mix of sharded and unsharded replicas; ``stats()['device']`` ships
+    the topology.
+
     ``quarantine_after``/``rebuilds``/``watchdog_s`` default to the
     ``gen_quarantine_after``/``gen_engine_rebuilds``/``gen_watchdog_s``
     flags (all 0 = the pre-resilience behavior: no quarantine books, the
@@ -460,7 +470,8 @@ class GenerationEngine:
                  watchdog_s: float | None = None,
                  spec_k: int | None = None, spec_mode: str | None = None,
                  draft_model=None, spec_ngram: int | None = None,
-                 spec_shed_occupancy: float | None = None):
+                 spec_shed_occupancy: float | None = None,
+                 mesh_tp: int | None = None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -524,6 +535,21 @@ class GenerationEngine:
                     "with the init_cache/forward_with_cache contract)")
         else:
             self._spec_mode = "off"
+        # tensor-parallel device layout (hard-off by default:
+        # gen_mesh_tp=0 builds no mesh — DeviceLayout is the identity,
+        # every compiled entry point is the plain single-device jit,
+        # byte-identical to the pre-sharding build. The flag is read
+        # HERE only, never on the decode hot path). Sharded params are
+        # committed before any cache/entry-point construction so the
+        # partitioner sees one consistent layout.
+        from paddle_tpu.serving.layout import DeviceLayout
+        self._layout = DeviceLayout(int(flag("gen_mesh_tp")
+                                        if mesh_tp is None else mesh_tp))
+        if self._layout.sharded:
+            self._model = model = self._layout.shard_model(model)
+            if self._draft_model is not None:
+                self._draft_model = self._layout.shard_model(
+                    self._draft_model)
         # per-bucket compiled draft-model proposers (mode=draft only)
         self._draft_fns: dict[int, Any] = {}
         # tokens_per_step books: decode-step emitted tokens over decode
@@ -568,6 +594,12 @@ class GenerationEngine:
             self._prefix = None
             self._pt = None
         self._state: dict[str, Any] = self._init_state()
+        # topology for stats()/health: static for the engine's lifetime
+        # (the cache pool never resizes), so computed once here
+        import jax
+        kv_bytes = sum(int(x.nbytes) for x in
+                       jax.tree_util.tree_leaves(self._state["cache"]))
+        self._device_info = self._layout.describe(kv_bytes)
         if self._paged:
             self._step = self._build_paged_step()
             self._prefill_fn = self._build_paged_prefill()
@@ -627,7 +659,7 @@ class GenerationEngine:
             cache = jax.tree_util.tree_map(
                 lambda x: jnp.zeros((self.slots,) + x.shape, x.dtype),
                 proto)
-        return {
+        state = {
             "cache": cache,
             "tok": jnp.zeros((self.slots,), jnp.int32),
             "pos": jnp.zeros((self.slots,), jnp.int32),
@@ -636,6 +668,10 @@ class GenerationEngine:
             "top_k": jnp.zeros((self.slots,), jnp.int32),
             "top_p": jnp.ones((self.slots,), jnp.float32),
         }
+        # commit to the device layout (identity at gen_mesh_tp=0): KV
+        # leaves land sharded on the KV-head axis, scalars replicated,
+        # matching the explicit shardings every entry point compiles with
+        return self._layout.place_state(state, paged=self._paged)
 
     # -- compiled pieces ---------------------------------------------------
     def _build_step(self):
@@ -665,7 +701,8 @@ class GenerationEngine:
             return dict(state, cache=cache, tok=tok, pos=pos,
                         keys=keys), tok
 
-        return jax.jit(step, donate_argnums=(0,))
+        return self._layout.jit_entry(step, self._state,
+                                      paged=False, n_in=1, n_out=1)
 
     def _build_prefill(self):
         """Prefill one slot from a right-padded prompt bucket (compiled
@@ -697,7 +734,8 @@ class GenerationEngine:
                 top_p=state["top_p"].at[slot].set(top_p),
             ), tok0
 
-        return jax.jit(prefill, donate_argnums=(0,))
+        return self._layout.jit_entry(prefill, self._state,
+                                      paged=False, n_in=7, n_out=1)
 
     def _build_paged_step(self):
         """ONE fused decode for all slots in paged mode: each slot
@@ -743,7 +781,8 @@ class GenerationEngine:
             return dict(state, cache=pool, tok=tok, pos=pos,
                         keys=keys), tok
 
-        return jax.jit(step, donate_argnums=(0,))
+        return self._layout.jit_entry(step, self._state,
+                                      paged=True, n_in=2, n_out=1)
 
     def _build_paged_prefill(self):
         """Prefill ONE chunk of one slot's prompt (compiled per padded
@@ -788,7 +827,8 @@ class GenerationEngine:
                 top_p=state["top_p"].at[slot].set(top_p),
             ), tok0
 
-        return jax.jit(prefill, donate_argnums=(0,))
+        return self._layout.jit_entry(prefill, self._state,
+                                      paged=True, n_in=9, n_out=1)
 
     def _spec_pick_accept(self, jax, jnp, logits, key, temp, top_k, top_p,
                           draft, dlen):
@@ -858,7 +898,8 @@ class GenerationEngine:
             return dict(state, cache=cache, tok=tok, pos=pos,
                         keys=keys), out, emit
 
-        return jax.jit(step, donate_argnums=(0,))
+        return self._layout.jit_entry(step, self._state,
+                                      paged=False, n_in=3, n_out=2)
 
     def _build_paged_spec_step(self):
         """Speculative verify in paged mode: gather each slot's pages,
@@ -916,7 +957,8 @@ class GenerationEngine:
             return dict(state, cache=pool, tok=tok, pos=pos1,
                         keys=keys), out, emit
 
-        return jax.jit(step, donate_argnums=(0,))
+        return self._layout.jit_entry(step, self._state,
+                                      paged=True, n_in=4, n_out=2)
 
     # -- drafters (host side) ----------------------------------------------
     def _propose(self, ctx: np.ndarray, cap: int) -> np.ndarray:
@@ -948,9 +990,13 @@ class GenerationEngine:
         """Compiled greedy K-token lookahead of the draft model over a
         right-padded context bucket (one compile per pow-2 bucket, the
         prefill discipline): prefill the context, then argmax-decode K
-        tokens against the draft's own scratch cache. The draft cache is
-        call-local — the draft never holds persistent per-slot state, so
-        engine rebuilds and slot churn cannot desynchronize it."""
+        tokens against the draft's own scratch cache. The decode tail is
+        a ``lax.fori_loop`` — one traced body regardless of K, so draft
+        compile time (the ``gen/compile_s`` histogram) no longer grows
+        with ``spec_k`` the way the former K−1-times-unrolled graph did.
+        The draft cache is call-local — the draft never holds persistent
+        per-slot state, so engine rebuilds and slot churn cannot
+        desynchronize it."""
         import jax
         import jax.numpy as jnp
 
@@ -960,17 +1006,21 @@ class GenerationEngine:
             cache = draft.init_cache(1, bucket + K, dtype=dtype)
             logits, cache = draft.forward_with_cache(padded[None], cache,
                                                      index=0)
-            tok = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
-            out = [tok]
+            tok0 = jnp.argmax(logits[0, true_len - 1]).astype(jnp.int32)
             idx = jnp.asarray(true_len, jnp.int32)
-            for i in range(K - 1):
-                logits, cache = draft.forward_with_cache(
-                    tok[None, None], cache, index=idx + i)
-                tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
-                out.append(tok)
-            return jnp.stack(out)
 
-        return jax.jit(fn)
+            def body(i, carry):
+                out, cache = carry
+                logits, cache = draft.forward_with_cache(
+                    out[i - 1][None, None], cache, index=idx + i - 1)
+                nxt = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+                return out.at[i].set(nxt), cache
+
+            out0 = jnp.zeros((K,), jnp.int32).at[0].set(tok0)
+            out, _ = jax.lax.fori_loop(1, K, body, (out0, cache))
+            return out
+
+        return self._layout.jit_aux(fn, n_in=2)
 
     def _bucket(self, n: int) -> int:
         b = self._min_bucket
@@ -1227,6 +1277,13 @@ class GenerationEngine:
                    "recompile_storm": sum(
                        1 for t in self._recompile_ts
                        if time.monotonic() - t < 60.0),
+                   # device topology (static per engine): platform,
+                   # device count, mesh axis sizes (None mesh =
+                   # unsharded), total + per-device KV bytes — the
+                   # placement inputs a control plane reads next to
+                   # occupancy. A mesh-backed engine is ONE replica;
+                   # this block is how its N devices stay visible.
+                   "device": dict(self._device_info),
                    "paged": self._paged}
             if self._spec_k > 0:
                 prop = self._spec_proposed

@@ -22,6 +22,12 @@ and resumable — ACROSS replicas without shipping an artifact. This is
 the chaos/test path for killing a subprocess replica that holds a live
 stream (``tools/chaos_check.py gen-resilience``); real deployments
 register generators in their own entry point.
+
+``--mesh-tp N`` builds that engine over an N-device tensor-parallel
+mesh (``serving/layout.py``) while the replica stays one endpoint —
+streams remain byte-identical to unsharded replicas, so a router can
+fail a stream over between sharded and unsharded members freely
+(``tools/chaos_check.py gen-sharded``).
 """
 
 from __future__ import annotations
@@ -65,7 +71,27 @@ def main(argv: list[str] | None = None) -> int:
                     choices=("ngram", "draft"),
                     help="drafter for --gen-spec-k>0; 'draft' builds a "
                          "1-layer draft Llama from the same --gen-seed")
+    ap.add_argument("--mesh-tp", type=int, default=0,
+                    help="tensor-parallel degree of the --gen engine's "
+                         "device mesh (FLAGS_gen_mesh_tp per replica; "
+                         "0 = unsharded). The replica stays ONE "
+                         "endpoint; token streams are byte-identical "
+                         "to unsharded replicas")
     args = ap.parse_args(argv)
+
+    if args.mesh_tp > 0:
+        # a subprocess replica does not inherit a test harness's forced
+        # host device count, and XLA reads the flag once at backend
+        # init — set it BEFORE anything imports jax so a tp>1 mesh has
+        # devices to stand on even on a plain CPU host. Respect an
+        # explicit parent setting (real TPU fleets pass topology via
+        # the environment).
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            n = max(args.mesh_tp, 8)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={n}").strip()
 
     from paddle_tpu.core.flags import flag
     from paddle_tpu.io.serving import InferenceServer
@@ -103,7 +129,8 @@ def main(argv: list[str] | None = None) -> int:
                           page_tokens=args.gen_page_tokens,
                           spec_k=args.gen_spec_k,
                           spec_mode=args.gen_spec_mode,
-                          draft_model=draft)
+                          draft_model=draft,
+                          mesh_tp=args.mesh_tp)
     srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
 

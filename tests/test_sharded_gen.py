@@ -1,0 +1,266 @@
+"""Sharded serving: tensor-parallel GenerationEngine byte-identity.
+
+The load-bearing property is the same one every serving PR leans on,
+now across device layouts: a generation through a mesh-backed engine —
+params Megatron-split, KV cache/page pool sharded on the KV-head axis,
+every compiled entry point carrying explicit in/out shardings — must be
+BYTE-identical to the unsharded engine (and to solo ``generate``), for
+greedy and sampled decode, contiguous and paged caches, speculation on
+and off. That identity is what lets the router fail a stream over
+between sharded and unsharded replicas with ``rng_skip`` resumption.
+
+Runs on the conftest-forced 8-virtual-device CPU host
+(``--xla_force_host_platform_device_count=8``): all sharding and
+collective paths compile and execute for real in one process.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import (
+    POOL_KV_SPEC, STACKED_KV_SPEC, _draft_model_propose, generate,
+    init_paged_cache, paged_gather, paged_scatter,
+)
+from paddle_tpu.serving import DeviceLayout, GenerationEngine
+
+pytestmark = pytest.mark.sharded
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    # 4 heads / 4 KV heads so tp=4 divides the head axes (the gen-suite
+    # default of 2 KV heads only admits tp<=2)
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=4, num_kv_heads=4, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    paddle_tpu.seed(3)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=16, num_layers=1,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _prompt(seed=0, n=9):
+    return np.random.RandomState(seed).randint(0, VOCAB, (n,)).astype(
+        np.int32)
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            assert doc["error"] is None, doc["error"]
+            return toks
+
+
+def _streams(engine, prompt):
+    """One greedy + one sampled stream — the pair every identity
+    assertion compares across layouts."""
+    greedy = _drain(engine, engine.start(prompt, 10))
+    sampled = _drain(engine, engine.start(prompt, 10, temperature=0.8,
+                                          top_k=20, seed=3))
+    return greedy, sampled
+
+
+@pytest.fixture(scope="module")
+def unsharded(model):
+    """tp=0 reference streams + device block, per cache mode."""
+    out = {}
+    for paged in (False, True):
+        with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                              paged=paged, page_tokens=8) as eng:
+            out[paged] = (_streams(eng, _prompt()), eng.stats()["device"])
+    return out
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["contiguous", "paged"])
+def test_tp_byte_identity(model, unsharded, tp, paged):
+    """Greedy AND sampled streams byte-identical to the unsharded
+    engine at every tp degree, both cache modes — and the solo
+    ``generate`` anchor holds transitively."""
+    with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                          paged=paged, page_tokens=8, mesh_tp=tp) as eng:
+        assert _streams(eng, _prompt()) == unsharded[paged][0]
+    ref = np.asarray(generate(model, _prompt()[None], 10))[0, 9:]
+    assert unsharded[paged][0][0] == [int(t) for t in ref]
+
+
+@pytest.mark.parametrize("mode", ["ngram", "draft"])
+def test_tp_spec_identity(model, draft, unsharded, mode):
+    """Speculation composes with sharding unchanged: a tp=2 speculating
+    engine (both drafters) emits the same streams as the plain tp=0
+    engine — acceptance only changes step count, never tokens."""
+    prompt = np.tile(_prompt(1, 4), 3)
+    ref = None
+    for tp in (0, 2):
+        kw = {"draft_model": draft} if mode == "draft" else {}
+        with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                              spec_k=4, spec_mode=mode, mesh_tp=tp,
+                              **kw) as eng:
+            got = _streams(eng, prompt)
+        ref = got if ref is None else ref
+        assert got == ref
+    with GenerationEngine(model, slots=2, max_len=64,
+                          queue_max=8) as plain:
+        assert _streams(plain, prompt) == ref
+
+
+def test_rng_skip_resumes_across_layouts(model):
+    """The failover contract across layouts: a sampled stream started
+    on a tp=2 engine resumes byte-identically on an UNSHARDED engine
+    via prompt-replay + ``rng_skip`` (what RoutedClient does when a
+    sharded replica dies mid-stream), and vice versa."""
+    prompt = _prompt(2)
+    kw = dict(temperature=0.9, top_k=24, seed=11)
+    with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                          mesh_tp=2) as eng:
+        full = _drain(eng, eng.start(prompt, 10, **kw))
+    with GenerationEngine(model, slots=2, max_len=64, queue_max=8) as eng:
+        resumed = _drain(eng, eng.start(
+            np.concatenate([prompt, np.asarray(full[:4], np.int32)]),
+            6, rng_skip=4, **kw))
+    assert resumed == full[4:]
+
+
+def test_paged_ops_under_named_sharding(model, devices8):
+    """``paged_gather``/``paged_scatter`` bit-exact when the pool lives
+    under ``NamedSharding`` on the KV-head axis (the engine's paged
+    layout), vs the same ops on the unsharded pool."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    layout = DeviceLayout(2)
+    proto = model.init_cache(1, 32)
+    pool = init_paged_cache(proto, num_pages=6, page_tokens=8)
+    table = jnp.asarray([3, 1, 5, 0], jnp.int32)
+    chunk = tuple(
+        jax.random.normal(jax.random.PRNGKey(i), c.shape[:3] + (8,)
+                          + c.shape[4:], c.dtype)
+        for i, c in enumerate(proto))
+    ref_pool = paged_scatter(pool, table, chunk, 8, 8, length=5)
+    ref_view = paged_gather(ref_pool, table)
+
+    sh = NamedSharding(layout.mesh, POOL_KV_SPEC)
+    spool = tuple(jax.device_put(p, sh) for p in pool)
+    got_pool = paged_scatter(spool, table, chunk, 8, 8, length=5)
+    got_view = paged_gather(got_pool, table)
+    for r, g in zip(ref_pool + ref_view, got_pool + got_view):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_state_sharding_specs(model):
+    """The layout's spec map matches the documented KV contract: the
+    stacked contiguous leaf shards axis 3, the paged pool leaf axis 2,
+    scalars replicate — and placed engine state reports per-device
+    shards of 1/tp the KV bytes."""
+    layout = DeviceLayout(2)
+    assert STACKED_KV_SPEC[3] == "tp" and POOL_KV_SPEC[2] == "tp"
+    with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                          mesh_tp=2) as eng:
+        leaf = eng._state["cache"][0]
+        assert leaf.sharding.spec == STACKED_KV_SPEC
+        # Hkv axis actually split: each device holds half the heads
+        shard = leaf.addressable_shards[0].data
+        assert shard.shape[3] * 2 == leaf.shape[3]
+        assert eng._state["tok"].sharding.is_fully_replicated
+    with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                          paged=True, page_tokens=8, mesh_tp=2) as eng:
+        leaf = eng._state["cache"][0]
+        assert leaf.sharding.spec == POOL_KV_SPEC
+        assert leaf.addressable_shards[0].data.shape[2] * 2 == \
+            leaf.shape[2]
+    assert layout.describe(1000)["kv_bytes_per_device"] == 500
+
+
+def test_device_stats_block(model, unsharded):
+    """stats()/health ship the topology: platform, device count, mesh
+    axis sizes, and per-device KV bytes ~= 1/tp of the unsharded
+    pool."""
+    for paged in (False, True):
+        ref = unsharded[paged][1]
+        assert ref["devices"] == 1 and ref["mesh"] is None
+        assert ref["kv_bytes_per_device"] == ref["kv_bytes"]
+        with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                              paged=paged, page_tokens=8,
+                              mesh_tp=2) as eng:
+            dev = eng.stats()["device"]
+        assert dev["platform"] == "cpu"
+        assert dev["devices"] == 2 and dev["mesh"] == {"tp": 2}
+        assert dev["kv_bytes"] == ref["kv_bytes"]
+        assert dev["kv_bytes_per_device"] * 2 == ref["kv_bytes"]
+
+
+def test_defaults_off_no_mesh_no_hot_path_flag_read(model, monkeypatch):
+    """Hard-off discipline: the default engine builds NO mesh (layout is
+    the identity), and ``gen_mesh_tp`` is never read on the decode hot
+    path — only at construction."""
+    import paddle_tpu.serving.engine as engine_mod
+
+    reads: list[str] = []
+    real_flag = engine_mod.flag
+
+    def spy(name):
+        reads.append(name)
+        return real_flag(name)
+
+    monkeypatch.setattr(engine_mod, "flag", spy)
+    with GenerationEngine(model, slots=2, max_len=64,
+                          queue_max=8) as eng:
+        assert eng._layout.mesh is None and not eng._layout.sharded
+        assert "gen_mesh_tp" in reads          # construction-time only
+        reads.clear()
+        _drain(eng, eng.start(_prompt(), 6))   # prefill + decode steps
+        assert "gen_mesh_tp" not in reads
+
+
+def test_draft_fn_constant_graph_and_bit_identity(model, draft):
+    """Satellite: the draft lookahead's decode tail is a fori_loop —
+    ONE traced body, so the jaxpr no longer grows with spec_k (the old
+    unrolled build compiled K-1 forwards per bucket) — and its output
+    is bit-identical to the eager reference drafter."""
+    import jax
+    import jax.numpy as jnp
+
+    ctx = np.tile(_prompt(1, 4), 3)
+    sizes = {}
+    for K in (2, 8):
+        with GenerationEngine(model, slots=2, max_len=64, queue_max=8,
+                              spec_k=K, spec_mode="draft",
+                              draft_model=draft) as eng:
+            got = eng._draft_propose(ctx, K)
+            ref = np.asarray(_draft_model_propose(draft, ctx, K))
+            np.testing.assert_array_equal(got, ref[:K])
+            # compile observability plumbing recorded the draft compile
+            assert any(e == "draft" for e, _ in eng._compiled_seen)
+            bucket = eng._bucket(ctx.size)
+            fn = eng._build_draft_fn(bucket)
+            jaxpr = jax.make_jaxpr(lambda p, t: fn(p, t))(
+                jnp.zeros((bucket,), jnp.int32),
+                jnp.asarray(ctx.size, jnp.int32))
+            sizes[K] = len(jaxpr.jaxpr.eqns)
+    assert sizes[2] == sizes[8], sizes
+
+
+def test_mesh_tp_validates_head_divisibility(model):
+    """tp must divide the head axes — caught loudly at construction,
+    not as a silently pad-sharded cache."""
+    paddle_tpu.seed(9)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    odd = LlamaForCausalLM(cfg)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        GenerationEngine(odd, slots=2, max_len=64, queue_max=8, mesh_tp=4)

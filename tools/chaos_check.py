@@ -61,7 +61,15 @@ Scenarios (all CPU-only, single process):
     byte-identical to solo ``generate()`` (``stream_resumes>=1``), the
     survivor's page pool drains back to full despite speculative
     rollback traffic, and health ships the acceptance stats.
-12. **obs-fleet**: a TRACED stream (``FLAGS_trace`` inherited by the
+12. **gen-sharded**: the tp=2 MESH-SHARDED subprocess replica
+    (``--mesh-tp 2``: params Megatron-split, KV pool sharded on the
+    KV-head axis over 2 virtual devices) holding a live stream is
+    SIGKILLed under routed load — the stream resumes byte-identical on
+    an UNSHARDED survivor (cross-layout determinism: the wire carries
+    tokens + RNG position, never device layout), and the sharded
+    replica's health shipped the ``device`` block (mesh {'tp': 2},
+    per-device KV bytes half the unsharded pool).
+13. **obs-fleet**: a TRACED stream (``FLAGS_trace`` inherited by the
     subprocess replicas) is SIGKILLed mid-flight and resumes on the
     survivor under the SAME stream trace id — the victim's span buffer,
     scraped moments before the kill, merges with the survivor's
@@ -74,7 +82,9 @@ Scenarios (all CPU-only, single process):
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
 speculation is opt-in; the unflagged decode path is byte-identical to
-the pre-speculation build).
+the pre-speculation build — and ``gen_mesh_tp``: no mesh is built by
+default, the engine's device layout is the identity and every compiled
+entry point is the plain single-device jit).
 
 Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py``. Exits nonzero
 (with a JSON report on stdout) if any recovery path or stat fails — a
@@ -165,6 +175,10 @@ def check_defaults_off() -> None:
           and sk["gen_spec_ngram"] >= 1           # sane when opted in
           and 0.0 <= sk["gen_spec_shed_occupancy"] <= 1.0,
           str(sk))
+    mt = get_flags(["gen_mesh_tp"])
+    check("defaults/gen_mesh_off",
+          mt["gen_mesh_tp"] == 0,                 # no mesh, identity
+          str(mt))                                # layout, plain jit
     ob = get_flags(["trace_sample", "control_slo_budget",
                     "control_burn_fast_ticks", "control_burn_slow_ticks",
                     "control_burn_threshold"])
@@ -1007,6 +1021,95 @@ def scenario_gen_spec(tmp: str) -> None:
             spawner.kill(ep)
 
 
+def scenario_gen_sharded(tmp: str) -> None:
+    """SIGKILL the tp=2 MESH-SHARDED subprocess replica holding a live
+    stream under routed load: the stream resumes byte-identical on an
+    UNSHARDED survivor. Cross-layout failover is the tentpole contract
+    — the wire carries tokens + RNG position, never device layout, and
+    sharded decode is bit-exact with unsharded decode — so a router may
+    mix tp degrees freely in one fleet. The sharded replica's health
+    (scraped before the kill) must ship the ``device`` block: mesh
+    {'tp': 2}, 2 devices, per-device KV bytes exactly half the
+    unsharded survivor's pool."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    monitor.reset_stats("serving/router/")
+    base = ("--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+            "--gen-max-len", "32", "--gen-step-wait-s", "0.05")
+    # one spawner per layout (replica_main forces the virtual host
+    # device count itself when --mesh-tp > 0; startup pays the larger
+    # 8-device backend init, hence the longer timeout)
+    sharded = SubprocessSpawner(extra_args=base + ("--mesh-tp", "2"),
+                                startup_timeout_s=120.0)
+    plain = SubprocessSpawner(extra_args=base)
+    ep_tp = sharded.spawn()
+    ep_plain = plain.spawn()
+    router = RoutedClient([ep_tp, ep_plain], probe_interval_s=0)
+    try:
+        devs = {}
+        for ep in (ep_tp, ep_plain):
+            with io.InferenceClient(ep, timeout=10.0) as c:
+                devs[ep] = c.health()["generators"]["llm"]["device"]
+        check("gensharded/device_block_topology",
+              devs[ep_tp].get("mesh") == {"tp": 2}
+              and devs[ep_tp].get("devices") == 2
+              and devs[ep_plain].get("mesh") is None
+              and devs[ep_plain].get("devices") == 1, str(devs))
+        check("gensharded/per_device_kv_half_of_pool",
+              devs[ep_tp]["kv_bytes"] == devs[ep_plain]["kv_bytes"]
+              and devs[ep_tp]["kv_bytes_per_device"] * 2
+              == devs[ep_plain]["kv_bytes"], str(devs))
+
+        rs = np.random.RandomState(53)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 5:]
+        # pin the victim stream to the SHARDED replica deterministically
+        # (cordon beats least-inflight tie-breaking races), then restore
+        # the unsharded survivor to membership before the kill
+        router.cordon(ep_plain)
+        sess = router.session("kill-sharded")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]          # stream live on the mesh
+        router.uncordon(ep_plain)
+        check("gensharded/victim_is_sharded", sess.endpoint == ep_tp,
+              f"pinned={sess.endpoint}")
+        rider = router.session("rider")      # concurrent routed load
+        it2 = rider.generate("llm", prompt, 12, poll_wait_s=0.05,
+                             resume_budget=2)
+        toks2 = [next(it2)]
+        sharded.kill(ep_tp)                  # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes on the unsharded
+            toks2 += list(it2)               # survivor, byte-identical
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("gensharded/cross_layout_resume_byte_identical",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref)
+              and np.array_equal(np.asarray(toks2, np.int32), ref),
+              f"err={err} toks={toks} ref={ref.tolist()}")
+        check("gensharded/resume_counted_no_failure_surfaced",
+              err is None
+              and monitor.get_stat("serving/router/stream_resumes") >= 1
+              and monitor.get_stat("serving/router/resume_exhausted")
+              == 0,
+              str(monitor.export_stats("serving/router/")))
+    finally:
+        router.close()
+        for sp in (sharded, plain):
+            for ep in list(sp.procs):
+                sp.kill(ep)
+
+
 def scenario_obs_fleet(tmp: str) -> None:
     """SIGKILL a subprocess replica holding a live TRACED stream: the
     victim's span buffer is scraped moments before the kill (a dead
@@ -1133,7 +1236,8 @@ def main() -> int:
                          scenario_obs, scenario_serving_routed,
                          scenario_gen_engine, scenario_gen_paged,
                          scenario_control_plane, scenario_gen_resilience,
-                         scenario_gen_spec, scenario_obs_fleet):
+                         scenario_gen_spec, scenario_gen_sharded,
+                         scenario_obs_fleet):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
