@@ -298,6 +298,25 @@ define_flag("gen_mesh_tp", 0,
             "path is byte-identical to the pre-sharding build and the "
             "flag is read only at engine construction, never on the "
             "decode hot path")
+# --- performance attribution (serving/ledger.py) ---
+define_flag("gen_ledger", False,
+            "Per-request latency ledger + engine goodput accounting + "
+            "per-tenant attribution (serving/ledger.py): every "
+            "generation gets a finalized phase record (admit-wait / "
+            "prefill / decode / deliver, partitioning its end-to-end "
+            "latency), the engine loop's wall-clock is classified into "
+            "a 7-bucket taxonomy summing to 100% (goodput = useful-"
+            "token time / total), and tokens/chip-seconds/queue-wait "
+            "are booked per tenant (wire header 'tn'). Records ride "
+            "stats()/health and the ledger_dump wire op. Hard-off "
+            "default: the engine builds no books, the serving path is "
+            "byte-identical, and the flag is read only at "
+            "construction — hot-path gates are is-None attribute "
+            "checks (the FLAGS_trace pattern)")
+define_flag("gen_ledger_records", 256,
+            "Ring capacity of finalized per-request ledger records "
+            "kept per engine (oldest evicted first). Read only at "
+            "engine construction, and only while gen_ledger is on")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

@@ -229,23 +229,40 @@ def merge_histograms(docs: list[dict[str, Any]],
     return merged.summary(raw)
 
 
-def hist_fraction_above(doc: dict[str, Any], threshold: float) -> float:
+def hist_fraction_above(doc: dict[str, Any], threshold: float,
+                        conservative: bool = False) -> float:
     """Fraction of a raw histogram snapshot's observations at or above
-    ``threshold``: the mass in every bucket whose lower bound is >=
-    threshold (observations below it in the threshold's own bucket
-    can't be separated, so the boundary bucket counts as below — a
-    conservative under-count). This is the SLO-violation numerator for
-    burn-rate math (``serving/metrics.py``); 0.0 when the snapshot is
-    empty or carries no buckets."""
+    ``threshold`` — the SLO-violation numerator for burn-rate math
+    (``serving/metrics.py``). Buckets whose lower bound is >= threshold
+    count in full; the bucket the threshold itself lands in contributes
+    the linearly interpolated share of its mass above the threshold
+    (individual observations inside a bucket are unrecoverable, so the
+    uniform-spread assumption of Prometheus' ``histogram_quantile`` is
+    applied). ``conservative=True`` restores the pre-interpolation
+    behavior — the whole boundary bucket counts as below — which
+    systematically under-counts violations whenever the threshold falls
+    inside a populated bucket: with these 3-per-decade bounds a bucket
+    spans ~2.15x in value, so an SLO threshold mid-bucket could hide up
+    to that bucket's entire mass from the burn rate. 0.0 when the
+    snapshot is empty or carries no buckets."""
     buckets = doc.get("buckets") if doc else None
     total = int(doc.get("count", 0)) if doc else 0
     if not buckets or total <= 0:
         return 0.0
-    # first bucket whose LOWER bound >= threshold: bucket i holds values
-    # v with bisect_left(bounds, v) == i, i.e. (bounds[i-1], bounds[i]],
-    # so the first all-violating bucket is one past threshold's own
-    i = bisect.bisect_left(_BUCKET_BOUNDS, threshold) + 1
-    violating = sum(int(c) for c in buckets[i:])
+    # bucket j holds values v with bisect_left(bounds, v) == j, i.e.
+    # (bounds[j-1], bounds[j]]; every bucket past j is all-violating
+    j = bisect.bisect_left(_BUCKET_BOUNDS, threshold)
+    violating = float(sum(int(c) for c in buckets[j + 1:]))
+    boundary = int(buckets[j]) if j < len(buckets) else 0
+    if boundary and not conservative:
+        lo = _BUCKET_BOUNDS[j - 1] if j > 0 else 0.0
+        # the overflow bucket has no upper bound; the snapshot's
+        # observed max is the best available one
+        hi = (_BUCKET_BOUNDS[j] if j < len(_BUCKET_BOUNDS)
+              else float(doc.get("max", lo)))
+        if hi > lo:
+            frac = min(max((hi - threshold) / (hi - lo), 0.0), 1.0)
+            violating += boundary * frac
     return min(violating / total, 1.0)
 
 
@@ -264,21 +281,37 @@ def _prom_name(name: str) -> str:
 def export_prometheus(prefix: str | None = None) -> str:
     """Prometheus text exposition of the registry: counters/gauges as
     ``gauge`` lines, histograms as ``summary`` families (p50/p95/p99
-    ``quantile`` labels + ``_sum``/``_count``) — scrape-ready for the
-    fleet-wide dashboards the reference exported through monitor.h's
-    Python bindings."""
+    ``quantile`` labels + ``_sum``/``_count``) plus a sibling
+    ``<name>_hist`` **histogram** family carrying the real cumulative
+    le-labeled bucket counts — what ``histogram_quantile()`` and
+    recording rules consume; the pre-computed quantiles in the summary
+    can't be re-aggregated across instances, the buckets can. (Two
+    families because one metric name can't carry two TYPEs.)
+    Scrape-ready for the fleet-wide dashboards the reference exported
+    through monitor.h's Python bindings."""
     lines: list[str] = []
     for name, value in sorted(stats.export(prefix).items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {value:g}")
-    for name, h in sorted(stats.export_histograms(prefix).items()):
+    for name, h in sorted(stats.export_histograms(prefix,
+                                                  raw=True).items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} summary")
         for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
             lines.append(f'{pn}{{quantile="{q}"}} {h[key]:g}')
         lines.append(f"{pn}_sum {h['sum']:g}")
         lines.append(f"{pn}_count {h['count']:g}")
+        hn = pn + "_hist"
+        lines.append(f"# TYPE {hn} histogram")
+        cum = 0
+        for bound, c in zip(_BUCKET_BOUNDS, h["buckets"]):
+            cum += int(c)
+            lines.append(f'{hn}_bucket{{le="{bound:g}"}} {cum}')
+        cum += int(h["buckets"][-1])     # overflow bucket
+        lines.append(f'{hn}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{hn}_sum {h['sum']:g}")
+        lines.append(f"{hn}_count {h['count']:g}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

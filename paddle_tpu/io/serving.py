@@ -51,7 +51,7 @@ __all__ = ["InferenceServer", "InferenceClient", "ModelBusyError"]
 
 SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4,
                "generate_start": 5, "generate_poll": 6,
-               "generate_cancel": 7, "unload_model": 8}
+               "generate_cancel": 7, "unload_model": 8, "ledger_dump": 9}
 _OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
 
 # Marker prefix for the typed busy error as it crosses the wire (the
@@ -124,9 +124,18 @@ class InferenceServer(FrameService):
         self._model_stats: dict[str, dict[str, float]] = {}
         self._generators: dict[str, Any] = {}
         self._lock = threading.Lock()
+        # per-tenant infer attribution (FLAGS_gen_ledger, read at
+        # construction only — the hard-off default builds no book and
+        # the infer path's only cost is one is-None check). Engine-side
+        # generation attribution lives in each engine's RequestLedger.
+        if flag("gen_ledger"):
+            from paddle_tpu.serving.ledger import TenantBook
+            self._ledger_infer = TenantBook()
+        else:
+            self._ledger_infer = None
         # per-server coalescer; consulted only when FLAGS_serving_batch_max
         # enables batching (one flag read per infer otherwise)
-        self._batcher = DynamicBatcher()
+        self._batcher = DynamicBatcher(tenant_book=self._ledger_infer)
         for name, m in (models or {}).items():
             self.add_model(name, m)
         if admin_ops is None:
@@ -326,7 +335,11 @@ class InferenceServer(FrameService):
                         # generate_start of the logical stream, replayed
                         # by failover resume — joins this replica's slot
                         # events into the stream's fleet-wide trace
-                        trace_id=header.get("st"))
+                        trace_id=header.get("st"),
+                        # tenant ("tn"): the ledger's attribution
+                        # identity, replayed by failover resume so
+                        # per-tenant counters survive a replica death
+                        tenant=header.get("tn"))
                 except EngineOverloaded as e:
                     # full engine: shed, not error — the status is
                     # retryable for every client (the start never ran)
@@ -350,6 +363,26 @@ class InferenceServer(FrameService):
                 send_frame(sock, 0,
                            {"cancelled": engine.cancel(header["gen_id"])})
                 return True
+            if name == "ledger_dump":
+                # performance-attribution dump (FLAGS_gen_ledger): each
+                # engine's finalized phase records + tenant book +
+                # goodput snapshot, plus the server-side infer tenant
+                # book. Engines with the ledger off are omitted.
+                limit = header.get("limit")
+                with self._lock:
+                    engines = dict(self._generators)
+                gens = {}
+                for n, e in engines.items():
+                    d = e.ledger_dump(
+                        None if limit is None else int(limit))
+                    if d is not None:
+                        gens[n] = d
+                send_frame(sock, 0, {
+                    "generators": gens,
+                    "infer_tenants": (
+                        None if self._ledger_infer is None
+                        else self._ledger_infer.snapshot())})
+                return True
             if name != "infer":
                 send_frame(sock, 1, {"error": f"bad op {op}"})
                 return True
@@ -369,12 +402,19 @@ class InferenceServer(FrameService):
             # requests into one bucketed Predictor.run.
             if (int(flag("serving_batch_max")) > 1
                     and self._batcher.can_batch(pred)):
-                outs = self._batcher.submit(header["model"], pred, inputs)
+                outs = self._batcher.submit(header["model"], pred, inputs,
+                                            tenant=header.get("tn"))
             else:
                 # nested under the wire server span: a traced request
                 # shows model time separate from framing/dispatch time
+                if self._ledger_infer is not None:
+                    t0 = time.perf_counter()
                 with _trace.span("serving/predict", model=header["model"]):
                     outs = pred.run(*inputs)
+                if self._ledger_infer is not None:
+                    self._ledger_infer.add(
+                        header.get("tn"), requests=1,
+                        chip_s=time.perf_counter() - t0)
             if not isinstance(outs, (tuple, list)):
                 outs = (outs,)
             specs, body = _pack_arrays(np.asarray(o) for o in outs)
@@ -404,13 +444,18 @@ class InferenceClient(FrameClient):
                          timeout=timeout, retries=retries,
                          idempotent=("infer", "list_models", "load_model",
                                      "unload_model", "generate_poll",
-                                     "generate_cancel"))
+                                     "generate_cancel", "ledger_dump"))
 
-    def infer(self, model: str, *inputs) -> list[np.ndarray]:
+    def infer(self, model: str, *inputs,
+              tenant: str | None = None) -> list[np.ndarray]:
         specs, payload = _pack_arrays(inputs)
-        rheader, rpayload = self._request(
-            "infer", {"model": model, "inputs": specs,
-                      "nbytes": len(payload)}, payload)
+        header = {"model": model, "inputs": specs, "nbytes": len(payload)}
+        if tenant:
+            # attribution identity (header "tn"): the server's ledger
+            # books this request's chip-seconds under it when
+            # FLAGS_gen_ledger is on; ignored otherwise
+            header["tn"] = str(tenant)
+        rheader, rpayload = self._request("infer", header, payload)
         # copy out of the frombuffer views: results a caller may mutate
         # must not be read-only aliases of the reply buffer (server-side
         # unpack stays zero-copy — Predictor only reads)
@@ -425,7 +470,8 @@ class InferenceClient(FrameClient):
                        temperature: float = 0.0, top_k: int = 0,
                        top_p: float = 1.0, eos_token_id: int | None = None,
                        seed: int = 0, rng_skip: int = 0,
-                       trace_id: str | None = None) -> str:
+                       trace_id: str | None = None,
+                       tenant: str | None = None) -> str:
         """Admit a generation into ``model``'s engine; returns its id.
         A full engine surfaces as the retryable shed status (the client
         backs off per ``retry_after_s`` and retries within its budget,
@@ -437,7 +483,9 @@ class InferenceClient(FrameClient):
         is the stream's fleet-unique trace id (header ``st``): with
         tracing on one is minted here when not given; a resuming caller
         passes the ORIGINAL stream's id so the replacement replica's
-        slot events join the same trace."""
+        slot events join the same trace. ``tenant`` (header ``tn``) is
+        the attribution identity the engine's request ledger books this
+        stream's tokens/chip-seconds under (``FLAGS_gen_ledger``)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         header = {"model": model, "prompt": prompt.tolist(),
                   "max_new_tokens": int(max_new_tokens),
@@ -451,6 +499,8 @@ class InferenceClient(FrameClient):
             trace_id = _trace.new_id()
         if trace_id:
             header["st"] = str(trace_id)
+        if tenant:
+            header["tn"] = str(tenant)
         try:
             return self._request("generate_start", header)[0]["gen_id"]
         except RuntimeError as e:
@@ -489,7 +539,8 @@ class InferenceClient(FrameClient):
     def generate(self, model: str, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: int | None = None,
-                 seed: int = 0, poll_wait_s: float = 0.25):
+                 seed: int = 0, poll_wait_s: float = 0.25,
+                 tenant: str | None = None):
         """Streaming generation: admits the prompt (raises immediately on
         a full engine) and returns an iterator yielding token ids as the
         engine emits them. Closing the iterator early (``break`` /
@@ -498,7 +549,7 @@ class InferenceClient(FrameClient):
         gen_id = self.generate_start(
             model, prompt, max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
-            seed=seed)
+            seed=seed, tenant=tenant)
 
         def stream():
             n, finished = 0, False
@@ -524,6 +575,17 @@ class InferenceClient(FrameClient):
                         pass
 
         return stream()
+
+    def ledger_dump(self, limit: int | None = None) -> dict:
+        """Performance-attribution dump (``FLAGS_gen_ledger``):
+        ``{"generators": {name: {records, tenants, goodput}},
+        "infer_tenants": {...}|None}``. Engines (or servers) running
+        with the ledger off simply contribute nothing — the op always
+        succeeds. ``limit`` caps the per-engine record count."""
+        header: dict[str, Any] = {}
+        if limit is not None:
+            header["limit"] = int(limit)
+        return self._request("ledger_dump", header)[0]
 
     def load_model(self, name: str, path: str) -> None:
         self._request("load_model", {"name": name, "path": path})

@@ -31,6 +31,7 @@ from paddle_tpu.serving.engine import (
     RequestQuarantined,
 )
 from paddle_tpu.serving.layout import DeviceLayout
+from paddle_tpu.serving.ledger import GoodputMeter, RequestLedger, TenantBook
 from paddle_tpu.serving.metrics import MetricsHub, hist_delta
 from paddle_tpu.serving.router import (
     GenerationFailed, ReplicaState, RoutedClient, StickySession,
@@ -43,4 +44,4 @@ __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "ControlDecision", "ReplicaSpawner", "InProcSpawner",
            "SubprocessSpawner", "RequestQuarantined", "GenerationExpired",
            "StreamResumeExhausted", "MetricsHub", "hist_delta",
-           "DeviceLayout"]
+           "DeviceLayout", "RequestLedger", "GoodputMeter", "TenantBook"]

@@ -75,14 +75,16 @@ def _bucket_rows(rows: int, max_rows: int) -> int:
 class _Pending:
     """One queued request: inputs in, outputs/error out."""
 
-    __slots__ = ("inputs", "rows", "outputs", "error", "t0")
+    __slots__ = ("inputs", "rows", "outputs", "error", "t0", "tenant")
 
-    def __init__(self, inputs: list[np.ndarray], rows: int):
+    def __init__(self, inputs: list[np.ndarray], rows: int,
+                 tenant: str | None = None):
         self.inputs = inputs
         self.rows = rows
         self.outputs: list[np.ndarray] | None = None
         self.error: BaseException | None = None
         self.t0 = time.perf_counter()
+        self.tenant = tenant
 
 
 class _ModelQueue:
@@ -104,9 +106,14 @@ class DynamicBatcher:
     completes, and raises whatever the combined execution raised.
     """
 
-    def __init__(self):
+    def __init__(self, tenant_book=None):
         self._lock = threading.Lock()
         self._queues: dict[str, _ModelQueue] = {}
+        # per-tenant infer attribution (serving/ledger.py TenantBook,
+        # passed by the server when FLAGS_gen_ledger is on): a coalesced
+        # run's wall clock splits evenly across its riders. None — the
+        # default — books nothing and costs one is-None check per run.
+        self._book = tenant_book
 
     @staticmethod
     def can_batch(pred) -> bool:
@@ -115,8 +122,8 @@ class DynamicBatcher:
         ordinary unbatched path."""
         return bool(getattr(pred, "supports_batching", False))
 
-    def submit(self, model: str, pred, inputs: list[np.ndarray]
-               ) -> list[np.ndarray]:
+    def submit(self, model: str, pred, inputs: list[np.ndarray],
+               tenant: str | None = None) -> list[np.ndarray]:
         # Validate against the specs BEFORE enqueueing: a malformed
         # request must fail alone, never poison the batch it would have
         # ridden in (its peers' runs share one exported call).
@@ -135,8 +142,14 @@ class DynamicBatcher:
         try:
             if solo:
                 stat_add("serving/batch_bypass")
-                return self._run(pred, model, inputs, batched=False)
-            p = _Pending(inputs, rows)
+                if self._book is None:
+                    return self._run(pred, model, inputs, batched=False)
+                t0 = time.perf_counter()
+                outs = self._run(pred, model, inputs, batched=False)
+                self._book.add(tenant, requests=1,
+                               chip_s=time.perf_counter() - t0)
+                return outs
+            p = _Pending(inputs, rows, tenant)
             if _trace._ACTIVE is not None:
                 with _trace.span("serving/batch_wait", model=model,
                                  rows=rows):
@@ -278,6 +291,11 @@ class DynamicBatcher:
             stat_add("serving/batched_requests", len(take))
             observe("serving/batch_size", total_rows)
             observe("serving/batch_requests", len(take))
+            if self._book is not None:
+                # one run served every rider: split its wall evenly
+                share = (time.perf_counter() - t_exec) / len(take)
+                for it in take:
+                    self._book.add(it.tenant, requests=1, chip_s=share)
         except BaseException as e:  # every caller gets the failure
             for it in take:
                 it.error = e

@@ -203,7 +203,9 @@ class Generation:
                  "done", "error", "slot", "created", "last_poll",
                  "cancelled", "pages", "shared", "prefilling",
                  "prefill_pos", "prefill_t0", "delivered", "fingerprint",
-                 "rng_skip", "spec_proposed", "spec_accepted", "trace_id")
+                 "rng_skip", "spec_proposed", "spec_accepted", "trace_id",
+                 "tenant", "admitted_ts", "first_tok_ts", "done_ts",
+                 "chip_s", "ledgered")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -250,6 +252,16 @@ class Generation:
         # generation proposed / had accepted; stays 0 with spec off)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # latency-ledger books (wire header "tn" + monotonic phase
+        # stamps + attributed device seconds); stamps stay 0.0 and
+        # ledgered stays False for the engine's whole life when
+        # FLAGS_gen_ledger is off
+        self.tenant: str | None = None
+        self.admitted_ts = 0.0
+        self.first_tok_ts = 0.0
+        self.done_ts = 0.0
+        self.chip_s = 0.0
+        self.ledgered = False
 
 
 class _PagePool:
@@ -471,7 +483,7 @@ class GenerationEngine:
                  spec_k: int | None = None, spec_mode: str | None = None,
                  draft_model=None, spec_ngram: int | None = None,
                  spec_shed_occupancy: float | None = None,
-                 mesh_tp: int | None = None):
+                 mesh_tp: int | None = None, ledger=None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -568,6 +580,21 @@ class GenerationEngine:
         self._compiled_seen: set[tuple[str, Any]] = set()
         self._recompiles = 0
         self._recompile_ts: deque[float] = deque(maxlen=256)
+        # performance-attribution books (hard-off by default:
+        # gen_ledger=False builds neither, and every hot-path gate is a
+        # single is-None attribute check — the FLAGS_trace pattern.
+        # Flags are read HERE only, never per token). ledger= accepts
+        # True/False to force, or a RequestLedger to share one.
+        led = flag("gen_ledger") if ledger is None else ledger
+        if led:
+            from paddle_tpu.serving.ledger import GoodputMeter, RequestLedger
+            self._ledger = (led if isinstance(led, RequestLedger)
+                            else RequestLedger(int(flag(
+                                "gen_ledger_records"))))
+            self._goodput = GoodputMeter()
+        else:
+            self._ledger = None
+            self._goodput = None
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -1052,20 +1079,24 @@ class GenerationEngine:
                                 gen=gen.gen_id, **attrs):
             pass
 
-    def _note_compile(self, entry: str, sig, dt: float) -> None:
+    def _note_compile(self, entry: str, sig, dt: float) -> bool:
         """Bookkeep one compiled-entry-point call: the first call with a
         new (entry, shape-signature) pair is the XLA compile (every
         later call hits the jit cache), so ``dt`` — that call's wall
         clock — lands in the ``gen/compile_s`` histogram. A second or
         later signature on one entry point counts as a recompile; their
         recent-window count is the recompile-storm gauge in
-        :meth:`stats`. After the first sight this is one set lookup."""
+        :meth:`stats`. After the first sight this is one set lookup.
+
+        Returns True when THIS call compiled (first sight of the pair):
+        its wall clock was compile-dominated, which the goodput meter
+        attributes to the ``recompile`` bucket instead of device work."""
         key = (entry, sig)
         if key in self._compiled_seen:
-            return
+            return False
         with self._cond:
             if key in self._compiled_seen:
-                return
+                return False
             first = not any(k[0] == entry for k in self._compiled_seen)
             self._compiled_seen.add(key)
             if not first:
@@ -1075,12 +1106,28 @@ class GenerationEngine:
         stat_add("gen/compiles")
         if not first:
             stat_add("gen/recompiles")
+        return True
+
+    def _ledger_finalize(self, gen: Generation, outcome: str) -> None:
+        """Finalize the generation's ledger record exactly once (caller
+        holds the lock; every retire path calls this). The gated
+        ``gen/ledger`` event makes the finalize visible in the stream
+        trace, so obs_dump joins phase records to the same stream id a
+        failover resume carries across replicas."""
+        if self._ledger is None or gen.ledgered:
+            return
+        gen.ledgered = True
+        rec = self._ledger.finalize(gen, outcome)
+        self._gen_event(gen, "gen/ledger", outcome=outcome,
+                        e2e_s=round(rec["e2e_s"], 6),
+                        resumed=int(gen.rng_skip > 0))
 
     # -- public surface ----------------------------------------------------
     def start(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
               top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
               seed: int = 0, rng_skip: int = 0,
-              trace_id: str | None = None) -> str:
+              trace_id: str | None = None,
+              tenant: str | None = None) -> str:
         """Enqueue a generation; returns its id immediately. Raises
         :class:`EngineOverloaded` (retryable) when every slot is busy and
         the admit queue is at ``queue_max``, and the typed
@@ -1091,7 +1138,10 @@ class GenerationEngine:
         (see ``models.generation.advance_key``); greedy requests ignore
         it. ``trace_id`` is the caller's stream trace id (wire header
         ``st``): when tracing is on, the engine records this
-        generation's slot-lifecycle events under it."""
+        generation's slot-lifecycle events under it. ``tenant`` (wire
+        header ``tn``) is the caller's attribution identity — the
+        ledger books this generation's tokens/chip-seconds/queue-wait
+        under it when ``FLAGS_gen_ledger`` is on."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -1130,6 +1180,8 @@ class GenerationEngine:
         gen.rng_skip = rng_skip
         if trace_id:
             gen.trace_id = str(trace_id)
+        if tenant:
+            gen.tenant = str(tenant)
         with self._cond:
             if self._stopping:
                 raise RuntimeError("GenerationEngine is stopped")
@@ -1210,6 +1262,8 @@ class GenerationEngine:
                 # delivered (the condition a sticky drain waits on
                 # before a replica may stop)
                 gen.delivered = True
+                self._ledger_finalize(
+                    gen, "complete" if gen.error is None else "failed")
             return {"tokens": list(gen.tokens[start:]), "done": gen.done,
                     "error": gen.error,
                     "queued": gen.slot is None and not gen.done}
@@ -1234,6 +1288,9 @@ class GenerationEngine:
                 stat_set("gen/queue_depth", len(self._queue))
                 self._gen_event(gen, "gen/retire", reason="cancelled",
                                 tokens=len(gen.tokens))
+            # covers the done-but-undelivered case too: a cancel is the
+            # last event this engine will ever see for the generation
+            self._ledger_finalize(gen, "cancelled")
             self._cond.notify_all()
         return True
 
@@ -1305,7 +1362,27 @@ class GenerationEngine:
                     pages_free=self._pool.free_count,
                     prefix_entries=(0 if self._prefix is None
                                     else len(self._prefix)))
+            # performance attribution (FLAGS_gen_ledger only): the loop
+            # goodput taxonomy and per-tenant books ride health's
+            # generators block, so MetricsHub rolls them up fleet-wide
+            # with no extra wire surface
+            if self._goodput is not None:
+                doc["goodput"] = self._goodput.snapshot()
+            if self._ledger is not None:
+                doc["tenants"] = self._ledger.tenants()
             return doc
+
+    def ledger_dump(self, limit: int | None = None) -> dict | None:
+        """Finalized per-request phase records + tenant book + goodput
+        snapshot (the ``ledger_dump`` wire op's per-engine payload), or
+        None while ``FLAGS_gen_ledger`` is off."""
+        if self._ledger is None:
+            return None
+        doc = {"records": self._ledger.records(limit),
+               "tenants": self._ledger.tenants()}
+        if self._goodput is not None:
+            doc["goodput"] = self._goodput.snapshot()
+        return doc
 
     def clear_prefix_cache(self) -> int:
         """Drop every prefix-cache entry no live generation references
@@ -1371,6 +1448,7 @@ class GenerationEngine:
                     gen.slot = None
                     self._gen_event(gen, "gen/retire", reason="stopped",
                                     tokens=len(gen.tokens))
+                self._ledger_finalize(gen, "stopped")
                 gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
@@ -1398,7 +1476,12 @@ class GenerationEngine:
                         and not any(g is not None for g in self._slot_gen)):
                     # idle: wake on new work, and periodically anyway so
                     # TTL reaping runs while nothing is streaming
+                    t_idle = (time.perf_counter()
+                              if self._goodput is not None else 0.0)
                     self._cond.wait(timeout=0.25)
+                    if self._goodput is not None:
+                        self._goodput.note("admission_idle",
+                                           time.perf_counter() - t_idle)
                     if self._stopping:
                         return
             try:
@@ -1417,12 +1500,24 @@ class GenerationEngine:
                         # queue blocked on pages and nothing to step:
                         # wait for a cancel/TTL/poll to free capacity
                         # instead of spinning
+                        t_idle = (time.perf_counter()
+                                  if self._goodput is not None else 0.0)
                         with self._cond:
                             if not self._stopping:
                                 self._cond.wait(timeout=0.05)
+                        if self._goodput is not None:
+                            self._goodput.note(
+                                "admission_idle",
+                                time.perf_counter() - t_idle)
                 else:
                     self._admit()
                     self._decode_step(jnp)
+                if self._goodput is not None:
+                    # close this iteration's taxonomy: the un-noted
+                    # remainder is host-side gather/bookkeeping (or the
+                    # stuck latch, while the watchdog has it marked)
+                    self._goodput.tick("watchdog_stuck" if self._stuck
+                                       else "host_gather")
             except Exception as e:   # device-side failure: fail loudly
                 with self._cond:
                     self._consec_traps += 1
@@ -1474,6 +1569,7 @@ class GenerationEngine:
                 g.error = msg
                 self._gen_event(g, "gen/retire", reason="failed",
                                 tokens=len(g.tokens))
+                self._ledger_finalize(g, "failed")
             g.slot = None
             g.prefilling = False
             g.pages = []
@@ -1544,6 +1640,7 @@ class GenerationEngine:
                     gen.slot = None
                     self._gen_event(gen, "gen/retire", reason="broken",
                                     tokens=len(gen.tokens))
+                self._ledger_finalize(gen, "broken")
                 gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
@@ -1613,6 +1710,9 @@ class GenerationEngine:
                         self._queue.remove(g)
                     except ValueError:
                         pass
+                # done-but-never-delivered generations retire here too:
+                # the reap is the last event this engine sees for them
+                self._ledger_finalize(g, "expired")
                 self._cond.notify_all()
 
     def _admit(self) -> None:
@@ -1629,6 +1729,8 @@ class GenerationEngine:
                 slot = free[0]
                 self._slot_gen[slot] = gen
                 gen.slot = slot
+                if self._ledger is not None:
+                    gen.admitted_ts = time.monotonic()
                 stat_set("gen/slots_active",
                          sum(g is not None for g in self._slot_gen))
                 self._gen_event(gen, "gen/admitted", slot=slot,
@@ -1681,6 +1783,8 @@ class GenerationEngine:
                 slot = free[0]
                 self._slot_gen[slot] = gen
                 gen.slot = slot
+                if self._ledger is not None:
+                    gen.admitted_ts = time.monotonic()
                 gen.prefilling = True
                 gen.prefill_pos = len(matched) * P
                 gen.prefill_t0 = time.perf_counter()
@@ -1749,7 +1853,12 @@ class GenerationEngine:
                 raise
             dt = time.perf_counter() - t0
             observe("gen/prefill_chunk_s", dt)
-            self._note_compile("paged_prefill", bucket, dt)
+            compiled = self._note_compile("paged_prefill", bucket, dt)
+            if self._goodput is not None:
+                self._goodput.note("recompile" if compiled else "prefill",
+                                   dt)
+            if self._ledger is not None:
+                gen.chip_s += dt
             self._last_beat = time.monotonic()
             self._consec_traps = 0       # real device work succeeded
             if self._epoch != epoch0:
@@ -1768,6 +1877,8 @@ class GenerationEngine:
                 if self._prefix is not None:
                     self._prefix.insert(gen.prompt, gen.pages, self._pool)
                 gen.tokens.append(tok0)
+                if self._ledger is not None:
+                    gen.first_tok_ts = time.monotonic()
                 # TTFT = enqueue -> first token (queue wait included):
                 # the latency an interactive SLO is actually about, and
                 # the signal the serving control plane autoscales on
@@ -1777,6 +1888,8 @@ class GenerationEngine:
                      and tok0 == gen.eos_token_id)
                         or len(gen.tokens) >= gen.max_new_tokens):
                     gen.done = True
+                    if self._ledger is not None:
+                        gen.done_ts = time.monotonic()
                     self._gen_event(gen, "gen/retire", reason="complete",
                                     tokens=len(gen.tokens))
                     self._release_slot_locked(gen)
@@ -1813,7 +1926,11 @@ class GenerationEngine:
             raise
         dt = time.perf_counter() - t0
         observe("gen/prefill_s", dt)
-        self._note_compile("prefill", bucket, dt)
+        compiled = self._note_compile("prefill", bucket, dt)
+        if self._goodput is not None:
+            self._goodput.note("recompile" if compiled else "prefill", dt)
+        if self._ledger is not None:
+            gen.chip_s += dt
         self._last_beat = time.monotonic()
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
@@ -1822,12 +1939,16 @@ class GenerationEngine:
             if self._slot_gen[slot] is not gen:   # cancelled mid-prefill
                 return
             gen.tokens.append(tok0)
+            if self._ledger is not None:
+                gen.first_tok_ts = time.monotonic()
             observe("gen/ttft_s", time.monotonic() - gen.created)
             stat_add("gen/tokens")
             if ((gen.eos_token_id is not None
                  and tok0 == gen.eos_token_id)
                     or len(gen.tokens) >= gen.max_new_tokens):
                 gen.done = True
+                if self._ledger is not None:
+                    gen.done_ts = time.monotonic()
                 self._gen_event(gen, "gen/retire", reason="complete",
                                 tokens=len(gen.tokens))
                 self._release_slot_locked(gen)
@@ -1911,9 +2032,17 @@ class GenerationEngine:
         observe("gen/decode_step_s", dt)
         if use_spec:
             observe("gen/spec_verify_s", dt)
-        self._note_compile(
+        compiled = self._note_compile(
             "spec_step" if use_spec
             else ("paged_step" if self._paged else "step"), 0, dt)
+        if self._goodput is not None:
+            self._goodput.note(
+                "recompile" if compiled
+                else ("spec_verify" if use_spec else "decode"), dt)
+        # chip-second attribution: one fused step serves every stepped
+        # slot — split its device wall evenly across them
+        chip_share = (dt / len(stepped)
+                      if self._ledger is not None else 0.0)
         self._last_beat = time.monotonic()
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
@@ -1929,6 +2058,8 @@ class GenerationEngine:
             for s, gen in stepped:
                 if self._slot_gen[s] is not gen:   # cancelled mid-step
                     continue
+                if self._ledger is not None:
+                    gen.chip_s += chip_share
                 if use_spec:
                     n = int(emit[s])
                     new = [int(t) for t in out[s, :n]]
@@ -1962,6 +2093,8 @@ class GenerationEngine:
                         # host; the device state past this point is
                         # garbage but the slot is released right here
                         gen.done = True
+                        if self._ledger is not None:
+                            gen.done_ts = time.monotonic()
                         self._gen_event(gen, "gen/retire",
                                         reason="complete",
                                         tokens=len(gen.tokens))
@@ -1976,4 +2109,7 @@ class GenerationEngine:
             self._cond.notify_all()
         if self.step_wait_s > 0:
             time.sleep(self.step_wait_s)
+            if self._goodput is not None:
+                # deliberate pacing gap: idle by configuration, not work
+                self._goodput.note("admission_idle", self.step_wait_s)
         return True

@@ -16,11 +16,24 @@ negative spike) and answers windowed queries over them:
 - ``rate(name, ticks)`` — fleet-wide counter rate per second,
 - ``burn_rates(name, threshold)`` — multi-window SLO **burn rate**: the
   fraction of windowed observations violating ``threshold``, divided by
-  the error budget.  Burn 1.0 means the budget is being consumed exactly
-  as fast as allowed; the controller requires BOTH a fast (acute) and a
-  slow (sustained) window above ``FLAGS_control_burn_threshold`` before
-  declaring TTFT pressure — the standard multi-window burn-rate alert,
-  replacing the old single-tick raw-p99 breach check that chased noise.
+  the error budget.  The violating fraction linearly interpolates the
+  mass of the bucket the threshold lands in
+  (:func:`~paddle_tpu.core.monitor.hist_fraction_above`), so an SLO
+  threshold falling mid-bucket no longer hides up to that bucket's
+  whole mass from the burn — the old all-below rounding is available as
+  ``conservative=True``.  Burn 1.0 means the budget is being consumed
+  exactly as fast as allowed; the controller requires BOTH a fast
+  (acute) and a slow (sustained) window above
+  ``FLAGS_control_burn_threshold`` before declaring TTFT pressure — the
+  standard multi-window burn-rate alert, replacing the old single-tick
+  raw-p99 breach check that chased noise.
+
+With ``FLAGS_gen_ledger`` on, engine health docs additionally carry the
+request-ledger signals (``serving/ledger.py``) and the hub rolls them
+up fleet-wide: ``phase_percentiles()`` merges the per-phase latency
+histograms every finalized generation observes, ``tenants()`` sums the
+per-tenant consumption gauges, and ``fleet_goodput()`` combines the
+engines' loop-time taxonomies into one fleet goodput fraction.
 
 Membership churn is survivable by construction: an endpoint's first
 snapshot is a baseline (no delta), an endpoint that disappears simply
@@ -205,6 +218,83 @@ class MetricsHub:
         with self._lock:
             return {ep: {m: dict(g) for m, g in s.gauges.items()}
                     for ep, s in self._series.items()}
+
+    # -- request-ledger rollups (FLAGS_gen_ledger) -------------------------
+    #: histograms the request ledger observes per finalized generation;
+    #: windowed merges of these are the fleet latency decomposition
+    PHASE_HISTOGRAMS = ("gen/e2e_s", "gen/phase/admit_wait_s",
+                        "gen/phase/prefill_s", "gen/phase/decode_s",
+                        "gen/phase/deliver_s")
+
+    def phase_percentiles(self, ticks: int | None = None
+                          ) -> dict[str, dict[str, float]]:
+        """Fleet-merged per-phase latency percentiles over the last N
+        ticks (default: slow window): the request ledger's phase
+        histograms combined across every endpoint.  Phases nothing
+        observed are omitted; {} with the ledger off fleet-wide."""
+        out: dict[str, dict[str, float]] = {}
+        for name in self.PHASE_HISTOGRAMS:
+            h = self.window_histogram(name, ticks or self.slow_ticks)
+            if h is not None:
+                out[name] = {k: h[k] for k in
+                             ("count", "sum", "p50", "p95", "p99")}
+        return out
+
+    def tenants(self) -> dict[str, dict[str, float]]:
+        """Fleet-wide per-tenant consumption: every (endpoint, model)
+        engine's latest ``tenants`` gauge block summed per tenant.  The
+        gauges are cumulative over each engine's lifetime, so the sums
+        are too — a replica restart zeroes that replica's contribution,
+        like any gauge series."""
+        out: dict[str, dict[str, float]] = {}
+        with self._lock:
+            for s in self._series.values():
+                for g in s.gauges.values():
+                    tens = g.get("tenants")
+                    if not isinstance(tens, dict):
+                        continue
+                    for tenant, counters in tens.items():
+                        if not isinstance(counters, dict):
+                            continue
+                        agg = out.setdefault(str(tenant), {})
+                        for k, v in counters.items():
+                            if isinstance(v, (int, float)):
+                                agg[k] = agg.get(k, 0.0) + float(v)
+        return out
+
+    def fleet_goodput(self) -> dict[str, Any] | None:
+        """Fleet goodput rollup: every (endpoint, model) engine's
+        ``goodput`` gauge block merged by summing per-bucket seconds —
+        equivalent to weighting each engine's fractions by the wall
+        clock it accounted.  None when no engine reports one (ledger
+        off fleet-wide)."""
+        from paddle_tpu.serving.ledger import GOODPUT_USEFUL
+        buckets: dict[str, float] = {}
+        total = 0.0
+        ticks = 0
+        engines = 0
+        with self._lock:
+            for s in self._series.values():
+                for g in s.gauges.values():
+                    gp = g.get("goodput")
+                    if not isinstance(gp, dict):
+                        continue
+                    engines += 1
+                    total += float(gp.get("total_s", 0.0))
+                    ticks += int(gp.get("ticks", 0))
+                    for b, v in (gp.get("buckets") or {}).items():
+                        if isinstance(v, (int, float)):
+                            buckets[b] = buckets.get(b, 0.0) + float(v)
+        if engines == 0:
+            return None
+        useful = sum(buckets.get(b, 0.0) for b in GOODPUT_USEFUL)
+        return {
+            "engines": engines, "total_s": total, "ticks": ticks,
+            "buckets": buckets,
+            "fractions": {b: (v / total if total > 0 else 0.0)
+                          for b, v in buckets.items()},
+            "goodput": useful / total if total > 0 else 0.0,
+        }
 
     def endpoints(self) -> list[str]:
         with self._lock:

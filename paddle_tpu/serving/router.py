@@ -398,8 +398,10 @@ class RoutedClient:
                           if r.healthy and not r.cordoned)
 
     # -- the routed serving surface ---------------------------------------
-    def infer(self, model: str, *inputs) -> list[np.ndarray]:
-        return self._routed(lambda c: c.infer(model, *inputs))
+    def infer(self, model: str, *inputs,
+              tenant: str | None = None) -> list[np.ndarray]:
+        return self._routed(
+            lambda c: c.infer(model, *inputs, tenant=tenant))
 
     def list_models(self) -> dict:
         return self._routed(lambda c: c.list_models())
@@ -473,6 +475,26 @@ class RoutedClient:
                     out[r.endpoint] = self._client(r).health(
                         stats_prefix=stats_prefix, histograms=histograms,
                         deep=deep, stats=stats)
+                    continue
+                except (ConnectionError, RuntimeError, OSError) as e:
+                    err = f"{type(e).__name__}: {e}"
+            out[r.endpoint] = {"status": "unreachable", "error": err}
+        return out
+
+    def ledger_dump(self, limit: int | None = None) -> dict[str, dict]:
+        """endpoint -> performance-attribution dump (the per-replica
+        ``ledger_dump`` op: finalized phase records, per-tenant books,
+        goodput snapshots — see ``serving/ledger.py``). Unreachable
+        replicas map to ``{"status": "unreachable", ...}`` like
+        :meth:`health`; replicas running with ``FLAGS_gen_ledger`` off
+        contribute empty dumps. ``tools/perf_report.py`` turns this +
+        :meth:`health` into the fleet attribution report."""
+        out: dict[str, dict] = {}
+        for r in list(self._replicas):
+            ok, err = self._probe_one(r.endpoint)
+            if ok:
+                try:
+                    out[r.endpoint] = self._client(r).ledger_dump(limit)
                     continue
                 except (ConnectionError, RuntimeError, OSError) as e:
                     err = f"{type(e).__name__}: {e}"
@@ -580,12 +602,14 @@ class StickySession:
                     "restart the generation", ep or "?") from e
             raise
 
-    def infer(self, model: str, *inputs) -> list[np.ndarray]:
+    def infer(self, model: str, *inputs,
+              tenant: str | None = None) -> list[np.ndarray]:
         """Sticky infer (cache/session affinity). Errors surface; the
         next call re-pins if the member was lost."""
         client = self._client()
-        return self._wrap(lambda: client.infer(model, *inputs),
-                          during_generation=False)
+        return self._wrap(
+            lambda: client.infer(model, *inputs, tenant=tenant),
+            during_generation=False)
 
     def health(self) -> dict:
         client = self._client()
@@ -596,7 +620,8 @@ class StickySession:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: int | None = None,
                  seed: int = 0, poll_wait_s: float = 0.25,
-                 resume_budget: int | None = None):
+                 resume_budget: int | None = None,
+                 tenant: str | None = None):
         """Streaming generation pinned to the session's replica: start,
         every poll, and the close-time cancel all hit the replica
         holding the slot. Returns an iterator of token ids.
@@ -621,9 +646,13 @@ class StickySession:
         # replica — obs_dump then merges the stream's whole life across
         # replicas into one trace. Only minted with tracing on.
         trace_id = _trace.new_id() if _trace.enabled() else None
+        # The tenant identity likewise rides every resume attempt, so
+        # per-tenant ledger counters keep accruing to the same tenant
+        # on whichever replica inherits the stream.
         kw = dict(temperature=temperature, top_k=top_k, top_p=top_p,
                   eos_token_id=eos_token_id, seed=seed,
-                  poll_wait_s=poll_wait_s, trace_id=trace_id)
+                  poll_wait_s=poll_wait_s, trace_id=trace_id,
+                  tenant=tenant)
         if budget <= 0:
             return self._stream_once(model, prompt, max_new_tokens, **kw)
         return self._resuming_stream(model, prompt, max_new_tokens,
@@ -633,7 +662,8 @@ class StickySession:
                      temperature: float, top_k: int, top_p: float,
                      eos_token_id: int | None, seed: int,
                      poll_wait_s: float, rng_skip: int = 0,
-                     trace_id: str | None = None):
+                     trace_id: str | None = None,
+                     tenant: str | None = None):
         """One pinned stream attempt (the pre-resumption ``generate``
         body). Server-side failures that lost the slot state but left
         the replica up — the ``engine reset:`` marker — surface as
@@ -645,7 +675,8 @@ class StickySession:
             lambda: client.generate_start(
                 model, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
-                seed=seed, rng_skip=rng_skip, trace_id=trace_id),
+                seed=seed, rng_skip=rng_skip, trace_id=trace_id,
+                tenant=tenant),
             during_generation=True)
         with self._lock:
             self._active += 1
@@ -695,7 +726,8 @@ class StickySession:
                          *, temperature: float, top_k: int, top_p: float,
                          eos_token_id: int | None, seed: int,
                          poll_wait_s: float, budget: int,
-                         trace_id: str | None = None):
+                         trace_id: str | None = None,
+                         tenant: str | None = None):
         """Drive :meth:`_stream_once` attempts, replaying
         ``prompt + delivered`` onto a freshly pinned replica after each
         mid-flight loss, until the stream completes or the budget is
@@ -717,7 +749,7 @@ class StickySession:
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
                         seed=seed, poll_wait_s=poll_wait_s,
-                        trace_id=trace_id)
+                        trace_id=trace_id, tenant=tenant)
                 else:
                     replay = np.concatenate(
                         [prompt, np.asarray(delivered, np.int32)])
@@ -726,7 +758,7 @@ class StickySession:
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
                         seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0,
-                        trace_id=trace_id)
+                        trace_id=trace_id, tenant=tenant)
                 for tok in inner:
                     delivered.append(int(tok))
                     yield int(tok)

@@ -1,0 +1,294 @@
+"""Performance attribution (``FLAGS_gen_ledger``): the per-request
+latency ledger, the engine goodput taxonomy, and per-tenant books.
+
+The two load-bearing properties pinned here:
+
+- **Partition invariant** — a finalized record's phase durations
+  (admit_wait → prefill → decode → deliver) sum EXACTLY to its
+  end-to-end latency, because boundaries telescope with clamping rather
+  than being independent timers; likewise the goodput buckets account
+  100% of the loop wall clock.
+- **Hard-off discipline** — with the flag off (the default) the engine
+  builds no books, reads no ledger flag on the decode hot path, ships
+  no extra stats keys, and produces byte-identical token streams.
+"""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.io.serving import InferenceClient, InferenceServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.serving import GenerationEngine
+from paddle_tpu.serving.ledger import (
+    DEFAULT_TENANT, GOODPUT_BUCKETS, GOODPUT_USEFUL, PHASES, GoodputMeter,
+    RequestLedger, TenantBook,
+)
+
+pytestmark = pytest.mark.gen
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _prompt(seed=3, n=5):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, VOCAB, (n,)).astype(np.int32)
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            return toks, doc["error"]
+
+
+# ---------------------------------------------------------------- units
+
+def _fake_gen(created, admitted, first_tok, done, *, tenant=None,
+              tokens=6, rng_skip=0, spec=(0, 0)):
+    return SimpleNamespace(
+        gen_id="g0", tenant=tenant, created=created, admitted_ts=admitted,
+        first_tok_ts=first_tok, done_ts=done,
+        prompt=np.zeros((5,), np.int32), tokens=list(range(tokens)),
+        chip_s=0.25, rng_skip=rng_skip,
+        spec_proposed=spec[0], spec_accepted=spec[1])
+
+
+def test_finalize_phases_partition_e2e_exactly():
+    """The invariant: telescoping clamped boundaries make the four
+    phase durations sum to ``e2e_s`` with no float drift beyond
+    associativity (< 1e-9 for sub-minute requests)."""
+    led = RequestLedger()
+    t0 = time.monotonic()
+    rec = led.finalize(_fake_gen(t0, t0 + 0.010, t0 + 0.030, t0 + 0.090,
+                                 tenant="acme"), "complete",
+                       now=t0 + 0.100)
+    assert tuple(rec["phases"]) == PHASES
+    assert abs(sum(rec["phases"].values()) - rec["e2e_s"]) < 1e-9
+    assert rec["phases"]["admit_wait_s"] == pytest.approx(0.010)
+    assert rec["phases"]["prefill_s"] == pytest.approx(0.020)
+    assert rec["phases"]["decode_s"] == pytest.approx(0.060)
+    assert rec["phases"]["deliver_s"] == pytest.approx(0.010)
+    assert rec["outcome"] == "complete" and rec["tenant"] == "acme"
+
+
+def test_finalize_missing_stamps_collapse_and_clamp():
+    """Stamps that never ran (0.0) collapse to the end boundary, and
+    out-of-order stamps clamp monotone — phases stay non-negative and
+    the partition still holds."""
+    led = RequestLedger()
+    t0 = time.monotonic()
+    # never admitted (queue death): everything is admit_wait
+    rec = led.finalize(_fake_gen(t0, 0.0, 0.0, 0.0), "expired",
+                       now=t0 + 0.050)
+    assert rec["phases"]["admit_wait_s"] == pytest.approx(0.050)
+    assert sum(abs(v) for v in rec["phases"].values()) == pytest.approx(
+        rec["e2e_s"])
+    # clock jitter: done stamped BEFORE first token still telescopes
+    rec2 = led.finalize(_fake_gen(t0, t0 + 0.010, t0 + 0.040, t0 + 0.020),
+                        "complete", now=t0 + 0.060)
+    assert all(v >= 0.0 for v in rec2["phases"].values())
+    assert abs(sum(rec2["phases"].values()) - rec2["e2e_s"]) < 1e-9
+
+
+def test_finalize_resume_and_spec_subblocks():
+    led = RequestLedger(records=2)
+    t0 = time.monotonic()
+    rec = led.finalize(_fake_gen(t0, t0, t0, t0, rng_skip=4, spec=(9, 5)),
+                       "complete", now=t0 + 0.01)
+    assert rec["resume"] == {"rng_skip": 4}
+    assert rec["spec"] == {"proposed": 9, "accepted": 5}
+    # ring buffer: maxlen trims oldest, records(limit) trims newest-last
+    for _ in range(3):
+        led.finalize(_fake_gen(t0, t0, t0, t0), "complete", now=t0 + 0.01)
+    assert len(led) == 2
+    assert len(led.records(1)) == 1 and "resume" not in led.records()[-1]
+
+
+def test_tenant_book_default_key_and_accumulation():
+    book = TenantBook()
+    book.add(None, tokens=3, requests=1)
+    book.add("", tokens=2, requests=1)            # falsy → default key
+    book.add("acme", tokens=5, chip_s=0.5, queue_wait_s=0.1, requests=1)
+    book.add("acme", tokens=5, chip_s=0.5, requests=1)
+    snap = book.snapshot()
+    assert snap[DEFAULT_TENANT]["tokens"] == 5
+    assert snap["acme"] == {"tokens": 10, "chip_seconds": 1.0,
+                            "queue_wait_s": pytest.approx(0.1),
+                            "requests": 2}
+
+
+def test_goodput_meter_sums_to_one_and_classifies():
+    """Every loop second lands in exactly one of the seven buckets and
+    the fractions sum to 1.0 by construction (tick sweeps the un-noted
+    remainder into the hint bucket)."""
+    meter = GoodputMeter()
+    time.sleep(0.010)                             # real elapsed wall clock
+    meter.note("prefill", 0.001)
+    meter.note("decode", 0.003)
+    meter.note("decode", -1.0)                    # ignored, not negative
+    meter.tick()                                  # remainder → host_gather
+    time.sleep(0.005)
+    meter.note("admission_idle", 0.001)
+    meter.tick(hint="watchdog_stuck")
+    snap = meter.snapshot()
+    assert set(snap["buckets"]) == set(GOODPUT_BUCKETS)
+    assert snap["ticks"] == 2 and snap["total_s"] > 0.0
+    assert sum(snap["fractions"].values()) == pytest.approx(1.0)
+    assert snap["buckets"]["host_gather"] > 0.0
+    assert snap["buckets"]["watchdog_stuck"] > 0.0
+    useful = sum(snap["buckets"][b] for b in GOODPUT_USEFUL)
+    assert snap["goodput"] == pytest.approx(useful / snap["total_s"])
+
+
+# --------------------------------------------------------- engine books
+
+def test_engine_ledger_records_partition_and_streams_identically(model):
+    """Ledger on vs off: token streams are byte-identical, and every
+    finalized record obeys the partition invariant with real engine
+    timestamps."""
+    prompt = _prompt(11)
+    ref = np.asarray(generate(model, prompt[None], 10))[0, 5:]
+    with GenerationEngine(model, slots=2, max_len=32, queue_max=4,
+                          ledger=True) as eng:
+        toks, err = _drain(eng, eng.start(prompt, 10, tenant="acme"))
+        assert err is None and np.array_equal(np.asarray(toks, np.int32),
+                                              ref)
+        dump = eng.ledger_dump()
+    assert [r["outcome"] for r in dump["records"]] == ["complete"]
+    rec = dump["records"][0]
+    assert tuple(rec["phases"]) == PHASES
+    assert abs(sum(rec["phases"].values()) - rec["e2e_s"]) < 1e-9
+    assert rec["tokens"] == 10 and rec["prompt_len"] == 5
+    assert rec["tenant"] == "acme" and rec["chip_s"] > 0.0
+    # decode dominates a 10-token greedy run; delivery was prompt
+    assert rec["phases"]["decode_s"] > 0.0
+
+
+def test_engine_goodput_and_tenant_blocks_in_stats(model):
+    with GenerationEngine(model, slots=2, max_len=32, queue_max=4,
+                          ledger=True) as eng:
+        _drain(eng, eng.start(_prompt(12), 8))            # untenanted
+        _drain(eng, eng.start(_prompt(13), 8, tenant="acme"))
+        st = eng.stats()
+        dump = eng.ledger_dump(limit=1)
+    gp = st["goodput"]
+    assert set(gp["buckets"]) == set(GOODPUT_BUCKETS)
+    assert gp["ticks"] > 0 and gp["total_s"] > 0.0
+    assert sum(gp["fractions"].values()) == pytest.approx(1.0)
+    assert gp["buckets"]["decode"] > 0.0 and 0.0 < gp["goodput"] <= 1.0
+    tens = st["tenants"]
+    assert tens["acme"]["tokens"] == 8 and tens["acme"]["requests"] == 1
+    assert tens[DEFAULT_TENANT]["tokens"] == 8
+    assert tens["acme"]["chip_seconds"] > 0.0
+    assert len(dump["records"]) == 1                      # limit honoured
+
+
+def test_engine_ledger_cancel_outcome(model):
+    with GenerationEngine(model, slots=1, max_len=48, queue_max=4,
+                          step_wait_s=0.05, ledger=True) as eng:
+        gid = eng.start(_prompt(14), 30)
+        eng.poll(gid, wait_s=1.0)                 # at least one token out
+        assert eng.cancel(gid)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            recs = eng.ledger_dump()["records"]
+            if recs:
+                break
+            time.sleep(0.02)
+    assert recs and recs[-1]["outcome"] == "cancelled"
+    assert abs(sum(recs[-1]["phases"].values()) - recs[-1]["e2e_s"]) < 1e-9
+
+
+def test_defaults_off_no_books_no_hot_path_flag_read(model, monkeypatch):
+    """Hard-off discipline: the default engine holds no ledger and no
+    meter, ships no goodput/tenants stats keys, returns None from
+    ledger_dump, and never reads a ledger flag on the decode hot path —
+    construction only (the FLAGS_trace pattern)."""
+    import paddle_tpu.serving.engine as engine_mod
+
+    assert not get_flags(["gen_ledger"])["gen_ledger"]
+    reads: list[str] = []
+    real_flag = engine_mod.flag
+
+    def spy(name):
+        reads.append(name)
+        return real_flag(name)
+
+    monkeypatch.setattr(engine_mod, "flag", spy)
+    with GenerationEngine(model, slots=2, max_len=32, queue_max=4) as eng:
+        assert eng._ledger is None and eng._goodput is None
+        assert "gen_ledger" in reads               # construction-time only
+        reads.clear()
+        toks, err = _drain(eng, eng.start(_prompt(11), 10, tenant="acme"))
+        assert err is None and len(toks) == 10
+        assert not any(n.startswith("gen_ledger") for n in reads)
+        st = eng.stats()
+        assert "goodput" not in st and "tenants" not in st
+        assert eng.ledger_dump() is None
+
+
+# ----------------------------------------------------------------- wire
+
+def test_ledger_dump_wire_roundtrip_and_infer_attribution(model, tmp_path):
+    """The ``ledger_dump`` op ships engine records + tenant books +
+    goodput over the wire, and the server's infer path books the ``tn``
+    header into its own tenant book."""
+    import paddle_tpu.io as io
+    from paddle_tpu import nn
+
+    saved = get_flags(["gen_ledger"])
+    set_flags({"gen_ledger": True})       # server reads it at construction
+    try:
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+        mpath = str(tmp_path / "mlp")
+        io.save_inference_model(mpath, net,
+                                [np.zeros((2, 4), np.float32)])
+        srv = InferenceServer({"m": mpath}).start()
+        try:
+            with GenerationEngine(model, slots=2, max_len=32,
+                                  queue_max=4) as eng:   # flag-driven on
+                srv.add_generator("llm", eng)
+                with InferenceClient(srv.endpoint) as client:
+                    toks = list(client.generate(
+                        "llm", _prompt(21), 8, poll_wait_s=0.2,
+                        tenant="acme"))
+                    assert len(toks) == 8
+                    client.infer("m", np.ones((2, 4), np.float32),
+                                 tenant="acme")
+                    client.infer("m", np.ones((2, 4), np.float32))
+                    dump = client.ledger_dump()
+                    one = client.ledger_dump(limit=1)
+        finally:
+            srv.stop()
+    finally:
+        set_flags(saved)
+    eng_dump = dump["generators"]["llm"]
+    assert [r["tenant"] for r in eng_dump["records"]] == ["acme"]
+    rec = eng_dump["records"][0]
+    assert abs(sum(rec["phases"].values()) - rec["e2e_s"]) < 1e-6
+    assert eng_dump["tenants"]["acme"]["tokens"] == 8
+    assert sum(eng_dump["goodput"]["fractions"].values()) == \
+        pytest.approx(1.0)
+    # infer-side book: the "tn" header lands per tenant, untagged
+    # traffic books under the default key so fleet totals still add up
+    inf = dump["infer_tenants"]
+    assert inf["acme"]["requests"] == 1 and inf["acme"]["chip_seconds"] > 0
+    assert inf[DEFAULT_TENANT]["requests"] == 1
+    assert len(one["generators"]["llm"]["records"]) == 1

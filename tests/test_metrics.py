@@ -9,6 +9,8 @@ releases as soon as the fast window is clean — the slow window alone
 never pages.
 """
 
+import bisect
+
 import pytest
 
 from paddle_tpu.core import monitor
@@ -38,20 +40,51 @@ def _doc(ttft_values, stats=None):
 
 def test_hist_fraction_above_counts_violating_buckets():
     doc = _cum_hist([0.01] * 9 + [2.0])
+    # 0.5 sits in an EMPTY bucket: interpolation has nothing to share
+    # out, so the answer is exact either way
     assert hist_fraction_above(doc, 0.5) == pytest.approx(0.1)
-    assert hist_fraction_above(doc, 2.0) == 0.0
+    assert hist_fraction_above(doc, 0.5, conservative=True) == \
+        pytest.approx(0.1)
     assert hist_fraction_above(doc, 1e-6) == pytest.approx(1.0)
+    # threshold at a bucket's exact upper bound: that bucket's counts
+    # are all <= threshold, nothing interpolates
+    lo = max(b for b in monitor._BUCKET_BOUNDS if b < 2.0)
+    assert hist_fraction_above(doc, lo) > 0.0
+    assert hist_fraction_above(_cum_hist([lo]), lo) == 0.0
 
 
-def test_hist_fraction_above_boundary_bucket_counts_as_below():
-    """A threshold strictly inside a bucket cannot tell how much of that
-    bucket violates — the fraction under-counts (conservative: never
-    pages on observations that might be fine)."""
-    doc = _cum_hist([0.5])           # lands in the bucket containing 0.5
-    # threshold inside/at the same bucket: its counts read as below
-    assert hist_fraction_above(doc, 0.5) == 0.0
-    # a threshold a full bucket lower sees it as violating
+def test_hist_fraction_above_interpolates_boundary_bucket():
+    """A threshold strictly inside a populated bucket: the old behavior
+    read ALL of that bucket as below (under-counting by up to a whole
+    ~2.15x bucket span); the default now spreads the bucket's counts
+    uniformly and attributes the share above the threshold.
+    ``conservative=True`` restores the floor."""
+    doc = _cum_hist([0.5])           # lands in the bucket (0.464, 1.0]
+    i = bisect.bisect_left(monitor._BUCKET_BOUNDS, 0.5)
+    lo, hi = monitor._BUCKET_BOUNDS[i - 1], monitor._BUCKET_BOUNDS[i]
+    assert lo < 0.5 < hi
+    expect = (hi - 0.5) / (hi - lo)  # uniform-spread share above 0.5
+    assert hist_fraction_above(doc, 0.5) == pytest.approx(expect)
+    assert hist_fraction_above(doc, 0.5, conservative=True) == 0.0
+    # a threshold a full bucket lower sees it as violating either way
     assert hist_fraction_above(doc, 0.05) == pytest.approx(1.0)
+    assert hist_fraction_above(doc, 0.05, conservative=True) == \
+        pytest.approx(1.0)
+    # interpolation never exceeds the whole-bucket ceiling
+    assert hist_fraction_above(doc, lo * 1.0001) <= 1.0
+
+
+def test_hist_fraction_above_overflow_bucket_uses_observed_max():
+    """Observations beyond the last bound land in the overflow bucket,
+    whose upper edge is unknowable from bounds alone — interpolation
+    uses the histogram's observed ``max`` instead."""
+    top = monitor._BUCKET_BOUNDS[-1]
+    doc = _cum_hist([top * 2.0, top * 4.0])
+    assert doc["max"] == pytest.approx(top * 4.0)
+    frac = hist_fraction_above(doc, top * 3.0)
+    # uniform spread over (top, max]: share above 3*top out of (1..4]*top
+    assert frac == pytest.approx((4.0 - 3.0) / (4.0 - 1.0))
+    assert hist_fraction_above(doc, top * 3.0, conservative=True) == 0.0
 
 
 def test_hist_fraction_above_empty_inputs():
@@ -171,3 +204,71 @@ def test_gauges_track_latest_per_model_engine_stats():
     hub.ingest({"ep": doc})
     g = hub.gauges()
     assert g["ep"]["llm"]["active"] == 2
+
+
+# ---------------------------------------------------------------------------
+# ledger rollups (FLAGS_gen_ledger fleet views)
+# ---------------------------------------------------------------------------
+
+def _gp(prefill, decode, host, ticks=10):
+    total = prefill + decode + host
+    return {"total_s": total, "ticks": ticks,
+            "buckets": {"prefill": prefill, "decode": decode,
+                        "spec_verify": 0.0, "host_gather": host,
+                        "admission_idle": 0.0, "recompile": 0.0,
+                        "watchdog_stuck": 0.0},
+            "goodput": (prefill + decode) / total if total else 0.0}
+
+
+def test_fleet_goodput_sums_bucket_seconds_across_engines():
+    """The fleet rollup weights each engine by the wall clock it
+    accounted (bucket-second sums), not a naive fraction average."""
+    hub = MetricsHub()
+    assert hub.fleet_goodput() is None           # ledger off fleet-wide
+    a = _doc([])
+    a["generators"] = {"llm": {"goodput": _gp(1.0, 8.0, 1.0)}}
+    b = _doc([])
+    b["generators"] = {"llm": {"goodput": _gp(0.0, 1.0, 9.0, ticks=5)}}
+    hub.ingest({"a": a, "b": b})
+    gp = hub.fleet_goodput()
+    assert gp["engines"] == 2 and gp["ticks"] == 15
+    assert gp["total_s"] == pytest.approx(20.0)
+    # (1+8 + 0+1) useful seconds out of 20 — NOT mean(0.9, 0.1)
+    assert gp["goodput"] == pytest.approx(0.5)
+    assert gp["fractions"]["decode"] == pytest.approx(9.0 / 20.0)
+    assert sum(gp["fractions"].values()) == pytest.approx(1.0)
+
+
+def test_tenants_rollup_sums_across_endpoints():
+    hub = MetricsHub()
+    assert hub.tenants() == {}
+    a = _doc([])
+    a["generators"] = {"llm": {"tenants": {
+        "acme": {"tokens": 10, "chip_seconds": 1.0, "requests": 2}}}}
+    b = _doc([])
+    b["generators"] = {"llm": {"tenants": {
+        "acme": {"tokens": 5, "chip_seconds": 0.5, "requests": 1},
+        "-": {"tokens": 3, "chip_seconds": 0.1, "requests": 1}}}}
+    hub.ingest({"a": a, "b": b})
+    tens = hub.tenants()
+    assert tens["acme"] == {"tokens": 15.0, "chip_seconds": 1.5,
+                            "requests": 3.0}
+    assert tens["-"]["tokens"] == 3.0
+
+
+def test_phase_percentiles_merge_ledger_histograms():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=6)
+    def doc(vals):
+        d = _doc([])
+        d["histograms"]["gen/phase/decode_s"] = _cum_hist(vals)
+        d["histograms"]["gen/e2e_s"] = _cum_hist(vals)
+        return d
+    hub.ingest({"a": doc([0.1]), "b": doc([0.3] * 2)})
+    hub.ingest({"a": doc([0.1] * 4), "b": doc([0.3] * 6)})
+    pct = hub.phase_percentiles()
+    # tick 1 is each endpoint's baseline; the window holds tick 2's
+    # deltas: 3 new on a + 4 new on b
+    assert pct["gen/phase/decode_s"]["count"] == 7
+    assert pct["gen/e2e_s"]["p50"] > 0.0
+    # phases never observed are omitted, not zero-filled
+    assert "gen/phase/admit_wait_s" not in pct

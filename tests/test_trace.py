@@ -260,9 +260,7 @@ def test_export_chrome_is_valid_json(tmp_path):
     assert parent["args"]["step"] == 1
 
 
-def test_obs_dump_merges_endpoints(tmp_path):
-    """tools/obs_dump.py probes two live services and writes one merged
-    Chrome trace with per-endpoint pids."""
+def _load_obs_dump():
     import importlib.util
     import os
 
@@ -271,6 +269,13 @@ def test_obs_dump_merges_endpoints(tmp_path):
                                  "obs_dump.py"))
     obs_dump = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(obs_dump)
+    return obs_dump
+
+
+def test_obs_dump_merges_endpoints(tmp_path):
+    """tools/obs_dump.py probes two live services and writes one merged
+    Chrome trace with per-endpoint pids."""
+    obs_dump = _load_obs_dump()
     _tracing_on()
     a, b = _Echo().start(), _Echo().start()
     with FrameClient(a.endpoint, {"echo": 1}, timeout=5.0) as c:
@@ -365,3 +370,156 @@ def test_step_timer_concurrent_ticks():
     assert monitor.get_stat("race/steps") == 2000
     assert len(t._ticks) == t.window + 1, "window must not over/undergrow"
     assert monitor.get_stat("race/steps_per_sec") > 0
+
+
+# ---------------------------------------------------------------------------
+# satellites: histogram exposition, stream_traces under speculation +
+# ledger failover joins
+# ---------------------------------------------------------------------------
+
+def test_export_prometheus_histogram_exposition():
+    """Golden format: alongside the summary family, each histogram
+    exports a real le-labeled cumulative ``_bucket`` family (sibling
+    ``_hist`` name — one metric name cannot carry two TYPEs) that
+    Prometheus' histogram_quantile() can consume: le values strictly
+    increasing, counts cumulative, ``+Inf`` == ``_count``."""
+    import re
+
+    monitor.reset_stats("t/")
+    monitor.observe("t/lat_s", 0.5)
+    monitor.observe("t/lat_s", 0.5)
+    monitor.observe("t/lat_s", 2.0)
+    text = monitor.export_prometheus("t/")
+    assert "# TYPE t_lat_s summary" in text
+    assert "# TYPE t_lat_s_hist histogram" in text
+    rows = re.findall(r't_lat_s_hist_bucket\{le="([^"]+)"\} (\d+)',
+                      text)
+    assert rows and rows[-1][0] == "+Inf"
+    les = [float(le) for le, _ in rows[:-1]]
+    counts = [int(c) for _, c in rows]
+    assert les == sorted(les) and len(set(les)) == len(les)
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 3 and "t_lat_s_hist_count 3" in text
+    m = re.search(r"t_lat_s_hist_sum ([0-9.e+-]+)", text)
+    assert m and float(m.group(1)) == pytest.approx(3.0)
+    # the two 0.5s are cumulative at the first bound >= 0.5; 2.0 only
+    # joins at the first bound >= 2.0
+    at = {float(le): int(c) for le, c in rows[:-1]}
+    lo = min(b for b in les if b >= 0.5)
+    hi = min(b for b in les if b >= 2.0)
+    assert at[lo] == 2 and at[hi] == 3
+    monitor.reset_stats("t/")
+
+
+@pytest.fixture(scope="module")
+def _gen_model():
+    import paddle_tpu
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _drain_gen(eng, gid):
+    toks, n = [], 0
+    while True:
+        doc = eng.poll(gid, start=n, wait_s=0.5)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            return toks, doc["error"]
+
+
+def test_stream_traces_spec_accept_under_stream_id(_gen_model):
+    """A speculating engine's per-generation ``gen/spec_accept`` spans
+    (emitted when drafts are accepted and per-token sampling is on)
+    group under the SAME stream trace id as the lifecycle spans, so
+    stream_traces() shows speculation inside the request timeline."""
+    import numpy as np
+
+    from paddle_tpu.serving import GenerationEngine
+
+    obs_dump = _load_obs_dump()
+    saved = get_flags(["trace_sample"])
+    _tracing_on(8192)
+    set_flags({"trace_sample": 1})       # spec/sample spans are per-token
+    try:
+        rs = np.random.RandomState(1)
+        prompts = [rs.randint(1, 96, size=rs.randint(4, 10))
+                   .astype(np.int32) for _ in range(6)]
+        with GenerationEngine(_gen_model, slots=3, max_len=40,
+                              queue_max=8, spec_k=4, spec_mode="ngram",
+                              spec_shed_occupancy=1.0) as eng:
+            gids = [eng.start(p, 12, trace_id=f"t-spec-{i}")
+                    for i, p in enumerate(prompts)]
+            for g in gids:
+                _, err = _drain_gen(eng, g)
+                assert err is None
+            assert eng.stats()["spec"]["accepted"] > 0
+    finally:
+        set_flags(saved)
+    scrape = {"endpoint": "a", "service": "gen",
+              "spans": trace.get_spans()}
+    streams = obs_dump.stream_traces([scrape])
+    accepted = [tid for tid, d in streams.items()
+                if "gen/spec_accept" in d["names"]]
+    assert accepted and all(tid.startswith("t-spec-") for tid in accepted)
+    for tid in accepted:
+        assert streams[tid]["retired"] == "complete"
+        assert "gen/admitted" in streams[tid]["names"]
+
+
+def test_stream_traces_ledger_spans_join_failover_resume(_gen_model):
+    """The ``gen/ledger`` finalize events ride the stream's trace id, so
+    a failed-over stream — cancelled on replica A, replayed with
+    ``rng_skip`` on replica B — shows BOTH replicas' ledger finalizes in
+    ONE stream_traces() entry, scraped at different times."""
+    import numpy as np
+
+    from paddle_tpu.serving import GenerationEngine
+
+    obs_dump = _load_obs_dump()
+    _tracing_on(8192)
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(1, 96, size=(6,)).astype(np.int32)
+    tid = "t-failover"
+    # replica A: the stream dies mid-flight (cancel stands in for the
+    # replica loss); its spans are scraped from its buffer
+    with GenerationEngine(_gen_model, slots=2, max_len=32, queue_max=4,
+                          step_wait_s=0.05, ledger=True) as a:
+        gid = a.start(prompt, 12, trace_id=tid, tenant="acme")
+        while len(a.poll(gid, wait_s=1.0)["tokens"]) < 2:
+            pass
+        a.cancel(gid)
+        deadline_recs = None
+        import time as _time
+        t_end = _time.monotonic() + 5.0
+        while _time.monotonic() < t_end:
+            deadline_recs = a.ledger_dump()["records"]
+            if deadline_recs:
+                break
+            _time.sleep(0.02)
+        assert deadline_recs and deadline_recs[-1]["outcome"] == "cancelled"
+    scrape_a = {"endpoint": "a", "service": "gen",
+                "spans": trace.get_spans()}
+    trace.clear()
+    # replica B: the router's replay — same trace id, rng_skip past the
+    # tokens already delivered
+    with GenerationEngine(_gen_model, slots=2, max_len=32,
+                          queue_max=4, ledger=True) as b:
+        gid2 = b.start(prompt, 12, trace_id=tid, rng_skip=2,
+                       tenant="acme")
+        _, err = _drain_gen(b, gid2)
+        assert err is None
+        rec = b.ledger_dump()["records"][-1]
+    assert rec["outcome"] == "complete" and rec["tenant"] == "acme"
+    assert rec["resume"] == {"rng_skip": 2}
+    scrape_b = {"endpoint": "b", "service": "gen",
+                "spans": trace.get_spans()}
+    streams = obs_dump.stream_traces([scrape_a, scrape_b])
+    d = streams[tid]
+    assert d["endpoints"] == ["a", "b"]
+    assert "gen/ledger" in d["names"]
+    assert d["retired"] == "complete"    # B's completion wins the join

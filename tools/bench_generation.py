@@ -52,11 +52,19 @@ pool). CPU-proxy caveat in the JSON: virtual devices share one host's
 FLOPs, so wall-clock cannot improve here; identity and KV split are
 the hardware-independent results.
 
+A request-ledger attribution scenario rides along
+(:func:`bench_goodput`, ``FLAGS_gen_ledger`` engines): conc-1 vs
+conc-8 goodput taxonomy + per-phase latency decomposition, and the
+ledger's own measured throughput overhead vs an identical ledger-off
+engine — written to ``BENCH_goodput.json`` (ceiling 3%;
+``--goodput-only`` runs just this scenario).
+
 Writes ``BENCH_generation.json`` (repo root by default); the headline
 metric is the concurrency-8 tokens/s speedup — acceptance floor 1.5x —
 plus ``paged_capacity_x`` (floor 2x), ``prefix_prefill_savings``
-(floor 0.9), ``spec_conc1_speedup`` (floor 1.5x), and
-``spec_conc8_ratio`` (floor 0.95x).
+(floor 0.9), ``spec_conc1_speedup`` (floor 1.5x),
+``spec_conc8_ratio`` (floor 0.95x), and ``ledger_overhead``
+(ceiling 0.03).
 
 Usage: ``JAX_PLATFORMS=cpu python tools/bench_generation.py [-o OUT]``
 """
@@ -443,6 +451,106 @@ def bench_sharded(model, prompts) -> dict:
     return out
 
 
+def bench_goodput(model, all_prompts, reps: int = 3) -> dict:
+    """Request-ledger attribution cells + the ledger's own overhead.
+
+    Two engines with identical geometry, ledger off vs on, each warmed
+    then run at concurrency 1 and 8. The ledger-on cells report the
+    goodput taxonomy (per-cell bucket deltas of the cumulative meter)
+    and the per-phase latency decomposition of that cell's finalized
+    request records — conc-1 vs conc-8 is the point: under load the
+    decode bucket and goodput fraction rise as the fused step
+    amortizes, while per-request decode_s stretches. The headline is
+    ``overhead``: 1 - (instrumented tokens/s / uninstrumented
+    tokens/s) at each concurrency, measured on ONE engine with the
+    ledger hooks detached/attached between alternating best-of runs.
+    Two separately constructed engines differ by ~2 percent from
+    XLA-compile/allocation lottery alone (measured on identical
+    ledger-off pairs), which would swamp the instrumentation's actual
+    cost — a handful of ``perf_counter`` calls per step; detaching the
+    hooks on the same engine isolates exactly the cost the ceiling
+    bounds, and a detached engine's hot path is the ledger-off path
+    byte-for-byte (every gate is an ``is not None`` attribute check).
+    Acceptance ceiling: 3 percent."""
+    from tools.perf_report import goodput_rollup, phase_decomposition
+
+    out: dict = {
+        "slots": SLOTS, "max_new_tokens": MAX_NEW,
+        "prompt_len": PROMPT_LEN, "reps": reps,
+        "note": ("overhead = 1 - on/off tokens/s, each side aggregated "
+                 "over ~100 alternating runs with the ledger hooks "
+                 "detached/attached on ONE warmed engine (separate "
+                 "engines differ ~2% from compile lottery alone); "
+                 "goodput cells are per-cell deltas of the cumulative "
+                 "meter"),
+    }
+    on = GenerationEngine(model, slots=SLOTS, max_len=MAX_LEN,
+                          queue_max=32, ledger=True)
+    _drain_engine(on, on.start(all_prompts[0], MAX_NEW))         # warm
+    cells: dict[str, dict] = {}
+    for n in (1, 8):
+        base = on.ledger_dump()
+        gp0, rec0 = base["goodput"], len(base["records"])
+        runs = [bench_engine(on, list(all_prompts[:n]))
+                for _ in range(reps)]
+        dump = on.ledger_dump()
+        gp1 = dump["goodput"]
+        cells[str(n)] = {
+            "tokens_per_s": round(max(r["tokens_per_s"] for r in runs),
+                                  1),
+            "goodput": goodput_rollup([{
+                "total_s": gp1["total_s"] - gp0["total_s"],
+                "ticks": gp1["ticks"] - gp0["ticks"],
+                "buckets": {b: v - gp0["buckets"][b]
+                            for b, v in gp1["buckets"].items()},
+            }]),
+            "phases": phase_decomposition(dump["records"][rec0:]),
+        }
+    out["ledger_on"] = cells
+    # Overhead pairs run detached/attached back-to-back on the SAME
+    # engine (flips happen between runs, no active generations, under
+    # the engine condvar), order alternating pair to pair. Adjacent
+    # runs share whatever scheduling/frequency state the host is in —
+    # CFS core placement is sticky over seconds and alone produces
+    # multi-percent swings on a 0.2 s conc-1 run — so the PER-PAIR
+    # ratio cancels it; the median ratio across pairs is the estimate.
+    led, meter = on._ledger, on._goodput
+
+    def _run_side(which, prompts):
+        if which == "off":
+            with on._cond:
+                on._ledger = on._goodput = None
+        r = bench_engine(on, prompts)
+        with on._cond:
+            on._ledger, on._goodput = led, meter
+        return r["tokens"], r["wall_s"]
+
+    out["ledger_off"] = {}
+    overhead: dict[str, float] = {}
+    for n in (1, 8):
+        prompts = list(all_prompts[:n])
+        agg = {"off": [0.0, 0.0], "on": [0.0, 0.0]}
+        # a single 0.2-0.5 s run carries +-8% scheduler noise here, so
+        # the estimate aggregates many short runs per side; adjacent
+        # alternation keeps slow drift (thermal, co-tenant load)
+        # hitting both sides equally
+        for i in range(max(16 * reps, 48)):
+            order = ("off", "on") if i % 2 == 0 else ("on", "off")
+            for w in order:
+                tok, wall = _run_side(w, prompts)
+                agg[w][0] += tok
+                agg[w][1] += wall
+        tps_off = agg["off"][0] / agg["off"][1]
+        tps_on = agg["on"][0] / agg["on"][1]
+        out["ledger_off"][str(n)] = {"tokens_per_s": round(tps_off, 1)}
+        overhead[str(n)] = round(max(0.0, 1.0 - tps_on / tps_off), 4)
+    out["overhead"] = overhead
+    out["overhead_max"] = max(overhead.values())
+    out["overhead_ceiling"] = 0.03
+    on.close()
+    return out
+
+
 def summarize(runs: list[dict]) -> dict:
     ttft = runs[0]["ttft"]    # per-request spread from the first run
     return {
@@ -463,6 +571,12 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--concurrency", type=int, nargs="*",
                     default=[1, 4, 8])
+    ap.add_argument("--goodput-out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_goodput.json"))
+    ap.add_argument("--goodput-only", action="store_true",
+                    help="run only the ledger attribution/overhead "
+                         "scenario and write BENCH_goodput.json")
     args = ap.parse_args()
 
     import jax
@@ -473,8 +587,25 @@ def main() -> int:
                            num_kv_heads=HEADS, max_seq_len=MAX_LEN)
     model = LlamaForCausalLM(cfg)
     rs = np.random.RandomState(0)
-    all_prompts = rs.randint(0, VOCAB, (max(args.concurrency),
+    all_prompts = rs.randint(0, VOCAB, (max(args.concurrency + [8]),
                                         PROMPT_LEN)).astype(np.int32)
+
+    if args.goodput_only:
+        gp = bench_goodput(model, all_prompts, reps=args.reps)
+        gp["bench"] = "goodput"
+        gp["platform"] = "cpu"
+        ok = gp["overhead_max"] < gp["overhead_ceiling"]
+        gp["ok"] = ok
+        with open(args.goodput_out, "w") as f:
+            json.dump(gp, f, indent=2)
+            f.write("\n")
+        on8 = gp["ledger_on"]["8"]
+        print(f"goodput: conc-1 {gp['ledger_on']['1']['goodput']['goodput']:.1%} "
+              f"| conc-8 {on8['goodput']['goodput']:.1%} useful; ledger "
+              f"overhead conc-1 {gp['overhead']['1']:.2%}, conc-8 "
+              f"{gp['overhead']['8']:.2%} (ceiling 3%); "
+              f"wrote {args.goodput_out}; ok={ok}")
+        return 0 if ok else 1
 
     solo = jax.jit(lambda ids: generate(model, ids, MAX_NEW))
     engine = GenerationEngine(model, slots=SLOTS, max_len=MAX_LEN,
@@ -553,6 +684,19 @@ def main() -> int:
           f"floor 1.5x) | conc-8 sheds to "
           f"{spd['conc8_ratio']:.2f}x (floor 0.95x)")
 
+    gp = bench_goodput(model, all_prompts, reps=args.reps)
+    gp["bench"] = "goodput"
+    gp["platform"] = "cpu"
+    gp["ok"] = gp["overhead_max"] < gp["overhead_ceiling"]
+    with open(args.goodput_out, "w") as f:
+        json.dump(gp, f, indent=2)
+        f.write("\n")
+    print(f"goodput: conc-1 "
+          f"{gp['ledger_on']['1']['goodput']['goodput']:.1%} | conc-8 "
+          f"{gp['ledger_on']['8']['goodput']['goodput']:.1%} useful; "
+          f"ledger overhead max {gp['overhead_max']:.2%} (ceiling 3%); "
+          f"wrote {args.goodput_out}")
+
     top = str(max(args.concurrency))
     headline = report["concurrency"][top]["speedup_tokens_per_s"]
     report["headline"] = {
@@ -564,11 +708,14 @@ def main() -> int:
         "spec_conc1_floor": 1.5,
         "spec_conc8_ratio": spd["conc8_ratio"],
         "spec_conc8_floor": 0.95,
+        "ledger_overhead": gp["overhead_max"],
+        "ledger_overhead_ceiling": 0.03,
     }
     ok = (headline >= 1.5 and cap["capacity_x"] >= 2.0
           and sp["prefill_savings"] >= 0.9
           and spd["conc1_speedup"] >= 1.5
-          and spd["conc8_ratio"] >= 0.95)
+          and spd["conc8_ratio"] >= 0.95
+          and gp["ok"])
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")

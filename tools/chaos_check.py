@@ -188,6 +188,11 @@ def check_defaults_off() -> None:
           and 1 <= ob["control_burn_fast_ticks"]
           <= ob["control_burn_slow_ticks"]
           and ob["control_burn_threshold"] > 0, str(ob))
+    led = get_flags(["gen_ledger", "gen_ledger_records"])
+    check("defaults/gen_ledger_off",
+          not led["gen_ledger"]                   # no ledger, no meter
+          and led["gen_ledger_records"] > 0,      # sane when opted in
+          str(led))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -1227,6 +1232,92 @@ def scenario_obs_fleet(tmp: str) -> None:
         trace.clear()
 
 
+def scenario_ledger(tmp: str) -> None:
+    """SIGKILL a replica holding a live TENANTED stream with the request
+    ledger on: the stream resumes byte-identically on the survivor, and
+    the survivor's ledger_dump shows a finalized record that (a) carries
+    the resume sub-phase (this generation was a failover replay), (b)
+    still belongs to the original tenant — the router re-sends the
+    tenant header on every resume attempt, so attribution survives the
+    kill — and (c) obeys the partition invariant: the phase seconds sum
+    to the record's end-to-end latency exactly. The survivor's goodput
+    taxonomy must likewise account 100% of its loop wall clock."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    saved = get_flags(["gen_ledger"])
+    # subprocess replicas read the flag from the env they inherit, so
+    # export BEFORE spawning; the parent flips it too for symmetry
+    os.environ["FLAGS_gen_ledger"] = "1"
+    set_flags({"gen_ledger": True})
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05"))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(59)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 5:]
+        sess = router.session("ledger-kill")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2, tenant="acme")
+        toks = [next(it), next(it)]          # the stream is live
+        victim = sess.endpoint
+        spawner.kill(victim)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes on the survivor
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("ledger/stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={len(toks)}")
+        survivor = next(ep for ep in eps if ep != victim)
+        with io.InferenceClient(survivor, timeout=5.0) as cl:
+            dump = cl.ledger_dump()
+        eng = (dump.get("generators") or {}).get("llm") or {}
+        recs = eng.get("records") or []
+        resumed = [r for r in recs if r.get("resume")]
+        check("ledger/survivor_finalized_resume_record",
+              any(r["outcome"] == "complete"
+                  and r["resume"].get("rng_skip", 0) >= 1
+                  for r in resumed),
+              json.dumps(resumed))
+        check("ledger/tenant_attribution_survives_failover",
+              all(r.get("tenant") == "acme" for r in resumed)
+              and resumed != []
+              and eng.get("tenants", {}).get("acme", {})
+              .get("tokens", 0) >= len(ref) - 2,
+              json.dumps(eng.get("tenants")))
+        # partition invariant on the wire: phases sum to e2e exactly
+        # (clamped telescoping boundaries, not independent timers)
+        check("ledger/phases_partition_e2e",
+              recs != []
+              and all(abs(sum(r["phases"].values()) - r["e2e_s"]) < 1e-6
+                      for r in recs),
+              json.dumps(recs[:1]))
+        gp = eng.get("goodput") or {}
+        fr = gp.get("fractions") or {}
+        check("ledger/goodput_accounts_all_wall_clock",
+              gp.get("total_s", 0.0) > 0.0
+              and abs(sum(fr.values()) - 1.0) < 1e-6,
+              json.dumps(gp))
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+        del os.environ["FLAGS_gen_ledger"]
+        set_flags(saved)
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -1237,7 +1328,7 @@ def main() -> int:
                          scenario_gen_engine, scenario_gen_paged,
                          scenario_control_plane, scenario_gen_resilience,
                          scenario_gen_spec, scenario_gen_sharded,
-                         scenario_obs_fleet):
+                         scenario_obs_fleet, scenario_ledger):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a

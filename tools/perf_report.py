@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Fleet performance-attribution report from the request ledger.
+
+Probes N serving endpoints, pulls their ``health`` snapshots and ledger
+dumps (the ``ledger_dump`` wire op, served when ``FLAGS_gen_ledger`` is
+on), and answers the three capacity questions in one document:
+
+- **Where does the engine's wall clock go?** The goodput taxonomy —
+  prefill / decode / spec_verify vs host_gather / admission_idle /
+  recompile / watchdog_stuck — rolled up across every engine, with the
+  headline ``goodput`` fraction (useful-token time / total).
+- **Where does a request's latency go?** Per-phase decomposition
+  (admit_wait → prefill → decode → deliver) of the finalized request
+  records, plus the fleet-merged phase percentile histograms from
+  health.
+- **Who consumed the fleet?** Per-tenant tokens / chip-seconds /
+  queue-wait, merged across engines and the infer-side book.
+
+This is the live, fleet-wide successor to the reference's
+``tools/timeline.py`` post-hoc profile merge: attribution is computed
+from always-on counters scraped over the wire, no profile files.
+
+Usage::
+
+    python tools/perf_report.py HOST:PORT [HOST:PORT ...] \
+        [--json] [--limit N] [--timeout S]
+
+Human-readable by default; ``--json`` emits the raw report document.
+Exits nonzero if every endpoint is unreachable, or none has the ledger
+on. ``tools/bench_generation.py`` imports the rollup helpers to build
+``BENCH_goodput.json`` from in-process engines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.serving.ledger import (  # noqa: E402
+    GOODPUT_BUCKETS, GOODPUT_USEFUL, PHASES,
+)
+
+#: health histograms the ledger observes; merged fleet-wide for the
+#: latency-decomposition percentiles
+PHASE_HISTOGRAMS = ("gen/e2e_s",) + tuple(f"gen/phase/{p}" for p in PHASES)
+
+
+def goodput_rollup(docs: list[dict]) -> dict | None:
+    """Merge engine ``goodput`` snapshots by summing per-bucket seconds
+    (weighting each engine by the wall clock it accounted). None when
+    the list is empty — the ledger is off everywhere."""
+    docs = [d for d in docs if isinstance(d, dict)]
+    if not docs:
+        return None
+    buckets = {b: 0.0 for b in GOODPUT_BUCKETS}
+    total, ticks = 0.0, 0
+    for d in docs:
+        total += float(d.get("total_s", 0.0))
+        ticks += int(d.get("ticks", 0))
+        for b, v in (d.get("buckets") or {}).items():
+            buckets[b] = buckets.get(b, 0.0) + float(v)
+    useful = sum(buckets[b] for b in GOODPUT_USEFUL)
+    return {
+        "engines": len(docs), "total_s": total, "ticks": ticks,
+        "buckets": buckets,
+        "fractions": {b: (v / total if total > 0 else 0.0)
+                      for b, v in buckets.items()},
+        "goodput": useful / total if total > 0 else 0.0,
+    }
+
+
+def phase_decomposition(records: list[dict]) -> dict | None:
+    """Aggregate finalized request records: per-phase mean/total
+    seconds, mean end-to-end latency, outcome counts, resume count.
+    None without records."""
+    records = [r for r in records if isinstance(r, dict)]
+    if not records:
+        return None
+    n = len(records)
+    phase_tot = {p: 0.0 for p in PHASES}
+    e2e_tot = 0.0
+    tokens = 0
+    outcomes: dict[str, int] = {}
+    resumed = 0
+    for r in records:
+        e2e_tot += float(r.get("e2e_s", 0.0))
+        tokens += int(r.get("tokens", 0))
+        for p in PHASES:
+            phase_tot[p] += float((r.get("phases") or {}).get(p, 0.0))
+        o = str(r.get("outcome", "?"))
+        outcomes[o] = outcomes.get(o, 0) + 1
+        if r.get("resume"):
+            resumed += 1
+    return {
+        "requests": n, "tokens": tokens, "resumed": resumed,
+        "outcomes": outcomes,
+        "e2e_mean_s": e2e_tot / n,
+        "phase_mean_s": {p: t / n for p, t in phase_tot.items()},
+        "phase_total_s": phase_tot,
+        # share of end-to-end latency per phase (phases partition e2e
+        # by construction, so these fractions sum to ~1.0)
+        "phase_share": {p: (t / e2e_tot if e2e_tot > 0 else 0.0)
+                        for p, t in phase_tot.items()},
+    }
+
+
+def tenant_rollup(docs: list[dict]) -> dict[str, dict[str, float]]:
+    """Sum per-tenant counter blocks (engine ledgers + the infer-side
+    book) into one tenant → counters table."""
+    out: dict[str, dict[str, float]] = {}
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        for tenant, counters in doc.items():
+            if not isinstance(counters, dict):
+                continue
+            agg = out.setdefault(str(tenant), {})
+            for k, v in counters.items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0.0) + float(v)
+    return out
+
+
+def scrape(endpoint: str, *, limit: int | None,
+           timeout: float) -> dict:
+    """One endpoint → {endpoint, health, ledger}; raises on wire
+    errors. ``ledger`` is None when FLAGS_gen_ledger is off there."""
+    from paddle_tpu.io.serving import InferenceClient
+
+    with InferenceClient(endpoint, timeout=timeout, retries=0) as client:
+        health = client.health(histograms=True)
+        dump = client.ledger_dump(limit)
+    ledger_on = bool(dump.get("generators")) or (
+        dump.get("infer_tenants") is not None)
+    return {"endpoint": endpoint, "health": health,
+            "ledger": dump if ledger_on else None}
+
+
+def build_report(scrapes: list[dict], *,
+                 failed: list[dict] = ()) -> dict:
+    """The fleet attribution document from a scrape list."""
+    from paddle_tpu.core.monitor import merge_histograms
+
+    goodputs: list[dict] = []
+    records: list[dict] = []
+    tenant_docs: list[dict] = []
+    hists: dict[str, list[dict]] = {}
+    per_endpoint = []
+    for s in scrapes:
+        dump = s.get("ledger") or {}
+        eng_dumps = (dump.get("generators") or {}).values()
+        for d in eng_dumps:
+            goodputs.append(d.get("goodput"))
+            records.extend(d.get("records") or ())
+            tenant_docs.append(d.get("tenants"))
+        if dump.get("infer_tenants"):
+            tenant_docs.append(dump["infer_tenants"])
+        for name in PHASE_HISTOGRAMS:
+            h = (s["health"].get("histograms") or {}).get(name)
+            if h and h.get("buckets"):
+                hists.setdefault(name, []).append(h)
+        per_endpoint.append({
+            "endpoint": s["endpoint"],
+            "status": s["health"].get("status"),
+            "ledger": s.get("ledger") is not None,
+            "engines": sorted(dump.get("generators") or ()),
+        })
+    return {
+        "ok": True,
+        "endpoints": per_endpoint,
+        "failed": list(failed),
+        "goodput": goodput_rollup(goodputs),
+        "phases": phase_decomposition(records),
+        "phase_percentiles": {
+            name: {k: round(float(h[k]), 6)
+                   for k in ("count", "p50", "p95", "p99")}
+            for name, docs in sorted(hists.items())
+            for h in (merge_histograms(docs),)},
+        "tenants": tenant_rollup(tenant_docs),
+    }
+
+
+def render(report: dict) -> str:
+    """Human-readable report text (the default CLI output)."""
+    lines: list[str] = []
+    eps = report.get("endpoints") or []
+    on = sum(1 for e in eps if e.get("ledger"))
+    lines.append(f"fleet: {len(eps)} endpoint(s), ledger on at {on}; "
+                 f"{len(report.get('failed') or ())} unreachable")
+    gp = report.get("goodput")
+    if gp:
+        lines.append("")
+        lines.append(f"goodput {gp['goodput'] * 100:6.2f}%  "
+                     f"({gp['engines']} engine(s), "
+                     f"{gp['total_s']:.2f}s accounted, "
+                     f"{gp['ticks']} loop ticks)")
+        for b in GOODPUT_BUCKETS:
+            frac = gp["fractions"].get(b, 0.0)
+            bar = "#" * int(round(frac * 40))
+            lines.append(f"  {b:<15} {frac * 100:6.2f}%  "
+                         f"{gp['buckets'].get(b, 0.0):9.3f}s  {bar}")
+    ph = report.get("phases")
+    if ph:
+        lines.append("")
+        lines.append(f"requests {ph['requests']}  tokens {ph['tokens']}  "
+                     f"resumed {ph['resumed']}  "
+                     f"outcomes {json.dumps(ph['outcomes'])}")
+        lines.append(f"  e2e mean {ph['e2e_mean_s'] * 1e3:9.2f} ms")
+        for p in PHASES:
+            lines.append(f"  {p:<14} {ph['phase_mean_s'][p] * 1e3:9.2f} ms "
+                         f"mean  ({ph['phase_share'][p] * 100:5.1f}% of e2e)")
+    pp = report.get("phase_percentiles")
+    if pp:
+        lines.append("")
+        lines.append(f"{'histogram':<24} {'count':>7} {'p50':>10} "
+                     f"{'p95':>10} {'p99':>10}")
+        for name, h in pp.items():
+            lines.append(f"{name:<24} {h['count']:>7} "
+                         f"{h['p50'] * 1e3:>8.2f}ms {h['p95'] * 1e3:>8.2f}ms "
+                         f"{h['p99'] * 1e3:>8.2f}ms")
+    tens = report.get("tenants")
+    if tens:
+        lines.append("")
+        lines.append(f"{'tenant':<16} {'requests':>8} {'tokens':>8} "
+                     f"{'chip_s':>9} {'queue_wait_s':>12}")
+        for t in sorted(tens, key=lambda t: -tens[t].get("chip_seconds", 0)):
+            c = tens[t]
+            lines.append(f"{t:<16} {int(c.get('requests', 0)):>8} "
+                         f"{int(c.get('tokens', 0)):>8} "
+                         f"{c.get('chip_seconds', 0.0):>9.3f} "
+                         f"{c.get('queue_wait_s', 0.0):>12.4f}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("endpoints", nargs="+", metavar="HOST:PORT")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report document instead of text")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="max ledger records per engine (default: all "
+                         "buffered)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    scrapes, failed = [], []
+    for ep in args.endpoints:
+        try:
+            scrapes.append(scrape(ep, limit=args.limit,
+                                  timeout=args.timeout))
+        except (ConnectionError, RuntimeError, OSError) as e:
+            failed.append({"endpoint": ep,
+                           "error": f"{type(e).__name__}: {e}"})
+    if not scrapes:
+        print(json.dumps({"ok": False, "failed": failed}, indent=2))
+        return 1
+    report = build_report(scrapes, failed=failed)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+        if failed:
+            for f in failed:
+                print(f"unreachable: {f['endpoint']}: {f['error']}")
+    # a report with the ledger off everywhere answers nothing: fail so
+    # scripts notice the flag is missing rather than reading zeros
+    return 0 if any(s.get("ledger") for s in scrapes) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
