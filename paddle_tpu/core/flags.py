@@ -317,6 +317,39 @@ define_flag("gen_ledger_records", 256,
             "Ring capacity of finalized per-request ledger records "
             "kept per engine (oldest evicted first). Read only at "
             "engine construction, and only while gen_ledger is on")
+# --- disaggregated serving (serving/kvstore.py KVStore) ---
+define_flag("gen_kv_store", False,
+            "Tiered fleet-wide KV page store (serving/kvstore.py): "
+            "prefill publishes completed prompt pages under their "
+            "radix chain key, admission probes the store and fetches "
+            "matching prefixes before prefilling, and prefix-cache "
+            "eviction demotes pages to the store instead of dropping "
+            "them — a cache miss on one replica becomes a fetch, not "
+            "a recompute. Hard-off default: the engine builds no "
+            "store, the serving path is byte-identical, and the flag "
+            "is read only at construction — hot-path gates are "
+            "is-None attribute checks (the gen_ledger pattern)")
+define_flag("gen_kv_store_pages", 256,
+            "Host-RAM LRU tier capacity of the KV store, in pages. "
+            "Overflow demotes the least-recently-used page to the "
+            "spill tier (gen_kv_spill_dir) or drops it when no spill "
+            "tier is configured. Read only at engine construction, "
+            "and only while gen_kv_store is on")
+define_flag("gen_kv_spill_dir", "",
+            "Spill-tier root for the KV store: a local directory or "
+            "a WireFS endpoint (ptfs://host:port/kv). Pointing every "
+            "replica at the same root is what makes the store fleet-"
+            "wide — pages published or demoted by one replica are "
+            "fetchable by any other. Empty (default) keeps the store "
+            "RAM-only and replica-local. Read only at engine "
+            "construction, and only while gen_kv_store is on")
+define_flag("gen_role", "both",
+            "Replica serving role for the prefill/decode split: "
+            "'prefill' replicas run prefill and kv_put the resulting "
+            "pages but never fetch (they are the producers), 'decode' "
+            "replicas probe/fetch at admission and admit straight "
+            "into decode, 'both' (default) does both. Inert unless "
+            "gen_kv_store is on; read only at engine construction")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

@@ -51,7 +51,8 @@ __all__ = ["InferenceServer", "InferenceClient", "ModelBusyError"]
 
 SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4,
                "generate_start": 5, "generate_poll": 6,
-               "generate_cancel": 7, "unload_model": 8, "ledger_dump": 9}
+               "generate_cancel": 7, "unload_model": 8, "ledger_dump": 9,
+               "kv_put": 10, "kv_get": 11, "kv_probe": 12}
 _OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
 
 # Marker prefix for the typed busy error as it crosses the wire (the
@@ -231,6 +232,19 @@ class InferenceServer(FrameService):
                            "add_generator; FLAGS_gen_slots enables)")
         return eng
 
+    def _kv_store(self):
+        """This replica's KV page store: the first registered engine's
+        (engines sharing a replica share its store), or None with
+        ``FLAGS_gen_kv_store`` off — the kv ops then answer "not
+        stored"/"not found"/"no match" rather than erroring, so fleet
+        probes can sweep mixed fleets."""
+        with self._lock:
+            for eng in self._generators.values():
+                kv = getattr(eng, "_kv", None)
+                if kv is not None:
+                    return kv
+        return None
+
     def health(self, stats_prefix: str | None = None,
                histograms: bool = False, deep: bool = False,
                stats: bool = True) -> dict:
@@ -339,7 +353,12 @@ class InferenceServer(FrameService):
                         # tenant ("tn"): the ledger's attribution
                         # identity, replayed by failover resume so
                         # per-tenant counters survive a replica death
-                        tenant=header.get("tn"))
+                        tenant=header.get("tn"),
+                        # original-stream crash fingerprint ("fp"):
+                        # carried by failover resume so quarantine
+                        # recognizes resumed poison even though the
+                        # replay prompt grew by the delivered tokens
+                        fingerprint=header.get("fp"))
                 except EngineOverloaded as e:
                     # full engine: shed, not error — the status is
                     # retryable for every client (the start never ran)
@@ -362,6 +381,28 @@ class InferenceServer(FrameService):
                 engine = self._generator(header["model"])
                 send_frame(sock, 0,
                            {"cancelled": engine.cancel(header["gen_id"])})
+                return True
+            if name == "kv_put":
+                store = self._kv_store()
+                if store is None:
+                    send_frame(sock, 0, {"stored": False})
+                else:
+                    send_frame(sock, 0, {"stored": store.put(
+                        str(header["key"]), payload)})
+                return True
+            if name == "kv_get":
+                store = self._kv_store()
+                frame = (None if store is None
+                         else store.get(str(header["key"])))
+                send_frame(sock, 0,
+                           {"found": frame is not None,
+                            "nbytes": len(frame or b"")}, frame or b"")
+                return True
+            if name == "kv_probe":
+                store = self._kv_store()
+                keys = [str(k) for k in header.get("keys", ())]
+                send_frame(sock, 0, {"match": (0 if store is None
+                                               else store.probe(keys))})
                 return True
             if name == "ledger_dump":
                 # performance-attribution dump (FLAGS_gen_ledger): each
@@ -444,7 +485,8 @@ class InferenceClient(FrameClient):
                          timeout=timeout, retries=retries,
                          idempotent=("infer", "list_models", "load_model",
                                      "unload_model", "generate_poll",
-                                     "generate_cancel", "ledger_dump"))
+                                     "generate_cancel", "ledger_dump",
+                                     "kv_put", "kv_get", "kv_probe"))
 
     def infer(self, model: str, *inputs,
               tenant: str | None = None) -> list[np.ndarray]:
@@ -471,7 +513,8 @@ class InferenceClient(FrameClient):
                        top_p: float = 1.0, eos_token_id: int | None = None,
                        seed: int = 0, rng_skip: int = 0,
                        trace_id: str | None = None,
-                       tenant: str | None = None) -> str:
+                       tenant: str | None = None,
+                       fingerprint: str | None = None) -> str:
         """Admit a generation into ``model``'s engine; returns its id.
         A full engine surfaces as the retryable shed status (the client
         backs off per ``retry_after_s`` and retries within its budget,
@@ -485,7 +528,11 @@ class InferenceClient(FrameClient):
         passes the ORIGINAL stream's id so the replacement replica's
         slot events join the same trace. ``tenant`` (header ``tn``) is
         the attribution identity the engine's request ledger books this
-        stream's tokens/chip-seconds under (``FLAGS_gen_ledger``)."""
+        stream's tokens/chip-seconds under (``FLAGS_gen_ledger``).
+        ``fingerprint`` (header ``fp``) is the ORIGINAL stream's crash
+        fingerprint: a resuming caller passes it so the engine's
+        quarantine matches the stream's history instead of hashing the
+        grown replay prompt."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         header = {"model": model, "prompt": prompt.tolist(),
                   "max_new_tokens": int(max_new_tokens),
@@ -501,6 +548,8 @@ class InferenceClient(FrameClient):
             header["st"] = str(trace_id)
         if tenant:
             header["tn"] = str(tenant)
+        if fingerprint:
+            header["fp"] = str(fingerprint)
         try:
             return self._request("generate_start", header)[0]["gen_id"]
         except RuntimeError as e:
@@ -535,6 +584,29 @@ class InferenceClient(FrameClient):
         return self._request(
             "generate_cancel",
             {"model": model, "gen_id": gen_id})[0]["cancelled"]
+
+    # -- KV page store (disaggregated serving, FLAGS_gen_kv_store) ---------
+    def kv_put(self, key: str, frame: bytes) -> bool:
+        """Push a serialized KV page frame into the replica's store
+        under its radix chain key. Content-addressed and idempotent;
+        False when the replica already held it (or runs no store)."""
+        return self._request("kv_put", {"key": str(key),
+                                        "nbytes": len(frame)},
+                             bytes(frame))[0]["stored"]
+
+    def kv_get(self, key: str) -> bytes | None:
+        """Fetch a page frame from the replica's store, or None on a
+        miss (including store-off replicas — a mixed fleet probes
+        cleanly)."""
+        header, payload = self._request("kv_get", {"key": str(key)})
+        return payload if header["found"] else None
+
+    def kv_probe(self, keys) -> int:
+        """Longest prefix run of radix chain ``keys`` the replica's
+        store holds — the KV-locality placement signal (0 on store-off
+        replicas)."""
+        return self._request("kv_probe",
+                             {"keys": [str(k) for k in keys]})[0]["match"]
 
     def generate(self, model: str, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = 0,

@@ -32,7 +32,8 @@ import numpy as np
 
 __all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
            "paged_gather", "paged_scatter", "advance_key", "ngram_propose",
-           "speculative_generate", "STACKED_KV_SPEC", "POOL_KV_SPEC"]
+           "speculative_generate", "serialize_page", "deserialize_page",
+           "STACKED_KV_SPEC", "POOL_KV_SPEC"]
 
 # --- sharded-KV spec map (the serving DeviceLayout contract) ----------
 # Tensor-parallel serving shards the KV cache on the KV-head axis (Pope
@@ -159,6 +160,62 @@ def paged_scatter(pool, table, chunk, index, page_tokens: int,
     for leaf, ch in zip(pool, chunk):
         data = jnp.moveaxis(ch[:, 0], 2, 0)   # [T, L, Hkv, *rest]
         out.append(leaf.at[pages, :, :, offs].set(data.astype(leaf.dtype)))
+    return tuple(out)
+
+
+_PAGE_MAGIC = b"KVPG1"
+
+
+def serialize_page(leaves) -> bytes:
+    """Encode ONE page's cache leaves (``[L, Hkv, page_tokens, *rest]``
+    slices of the pool — any leaf count, so the int8 quantized layout's
+    4-leaf data+scale variant serializes identically) into a
+    self-describing wire frame: magic, a length-prefixed JSON header of
+    per-leaf dtype/shape, then the raw leaf bytes concatenated. The
+    byte image is exact — :func:`deserialize_page` rebuilds arrays that
+    compare ``tobytes()``-equal, which is what makes a fetched page
+    bit-identical to the page the publisher computed."""
+    import json
+    import struct
+    specs = []
+    blobs = []
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        specs.append({"shape": list(a.shape), "dtype": a.dtype.name})
+        blobs.append(a.tobytes())
+    head = json.dumps(specs, separators=(",", ":")).encode()
+    return b"".join([_PAGE_MAGIC, struct.pack("<I", len(head)), head]
+                    + blobs)
+
+
+def deserialize_page(buf: bytes):
+    """Decode a :func:`serialize_page` frame back into a tuple of host
+    numpy leaves. Raises ``ValueError`` on a foreign or truncated
+    frame (a corrupt store entry must read as a miss, not as garbage
+    KV)."""
+    import json
+    import struct
+    m = len(_PAGE_MAGIC)
+    if buf[:m] != _PAGE_MAGIC:
+        raise ValueError("not a KV page frame")
+    (hlen,) = struct.unpack_from("<I", buf, m)
+    head = json.loads(buf[m + 4:m + 4 + hlen].decode())
+    off = m + 4 + hlen
+    out = []
+    for spec in head:
+        try:
+            dt = np.dtype(spec["dtype"])
+        except TypeError:
+            import ml_dtypes  # jax's extension dtypes (bfloat16 etc.)
+            dt = np.dtype(getattr(ml_dtypes, spec["dtype"]))
+        n = int(np.prod(spec["shape"], dtype=np.int64)) * dt.itemsize
+        if off + n > len(buf):
+            raise ValueError("truncated KV page frame")
+        out.append(np.frombuffer(buf, dt, count=n // dt.itemsize,
+                                 offset=off).reshape(spec["shape"]))
+        off += n
+    if off != len(buf):
+        raise ValueError("trailing bytes in KV page frame")
     return tuple(out)
 
 

@@ -615,7 +615,7 @@ class ServingController:
                 "gen/ttft_s", self.target_ttft_s, self.slo_budget)
         else:
             burn_fast = burn_slow = 0.0
-        return {
+        out = {
             "replicas": n,
             "managed": len(self._managed),
             "members": len(healths),
@@ -627,6 +627,20 @@ class ServingController:
             "ttft_burn_fast": burn_fast,
             "ttft_burn_slow": burn_slow,
         }
+        kv = self._hub.fleet_kv()
+        if kv is not None:
+            # disaggregated-serving visibility: the fleet KV hit rate and
+            # tier mix travel with every decision's evidence, so a scale
+            # event can be read against how much prefill the store was
+            # absorbing at that tick
+            out["kv"] = {
+                "engines": kv["engines"], "roles": kv["roles"],
+                "hit_rate": kv["hit_rate"],
+                "fetch_bytes": kv["fetch_bytes"],
+                "demotions": kv["demotions"],
+                "prefill_recomputed": kv["prefill_recomputed"],
+            }
+        return out
 
     def _pressure(self, s: dict[str, Any]) -> list[str]:
         """Scale-up pressure reasons (empty = none). Each enabled signal

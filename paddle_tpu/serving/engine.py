@@ -135,7 +135,7 @@ from paddle_tpu.core.monitor import observe, stat_add, stat_set
 
 __all__ = ["GenerationEngine", "Generation", "EngineOverloaded",
            "RequestQuarantined", "GenerationExpired", "RESET_MARKER",
-           "QUARANTINE_MARKER", "EXPIRED_MARKER"]
+           "QUARANTINE_MARKER", "EXPIRED_MARKER", "stream_fingerprint"]
 
 _UNSET = object()
 
@@ -156,6 +156,23 @@ def _jittered(base: float) -> float:
     """``base`` scaled by U[0.5, 1.5) — the retry hint synchronized
     shed clients back off by must de-synchronize them."""
     return base * (0.5 + _jitter_rng.random())
+
+
+def stream_fingerprint(prompt, temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, seed: int = 0) -> str:
+    """Crash fingerprint of a stream — the quarantine identity. One
+    recipe shared by the engine (every :class:`Generation` hashes its
+    own request) and the resuming router client (which passes the
+    ORIGINAL stream's fingerprint on replay attempts, wire header
+    ``fp``, because the replay prompt grew by the delivered tokens and
+    would otherwise hash fresh — letting resumed poison dodge
+    quarantine)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    return hashlib.sha1(
+        prompt.tobytes()
+        + f"|{float(temperature)}|{int(top_k)}|{float(top_p)}"
+          f"|{int(seed)}".encode()
+    ).hexdigest()[:16]
 
 
 class EngineOverloaded(RuntimeError):
@@ -238,10 +255,8 @@ class Generation:
         # crash fingerprint (quarantine identity) and the RNG position a
         # resumed sampled stream replays (splits consumed before this
         # stream's first token — 0 for a fresh stream)
-        self.fingerprint = hashlib.sha1(
-            prompt.tobytes()
-            + f"|{temperature}|{top_k}|{top_p}|{seed}".encode()
-        ).hexdigest()[:16]
+        self.fingerprint = stream_fingerprint(prompt, temperature,
+                                              top_k, top_p, seed)
         self.rng_skip = 0
         # stream trace id (wire header "st"): the fleet-unique identity
         # of the LOGICAL stream this generation serves — minted once at
@@ -379,9 +394,12 @@ class _PrefixCache:
             self._touch(e)
             parent = e.page
 
-    def evict(self, n: int, pool: _PagePool) -> int:
+    def evict(self, n: int, pool: _PagePool, demote=None) -> int:
         """Free up to ``n`` pages by dropping LRU leaf entries no live
-        generation references (page refcount 1 = cache-only)."""
+        generation references (page refcount 1 = cache-only). With a
+        ``demote`` callback (the KV-store hook), each victim is handed
+        over — still registered, page still live — before release, so
+        eviction demotes the page to the store instead of dropping it."""
         freed = 0
         while freed < n:
             cands = [e for e in self._entries.values()
@@ -389,6 +407,8 @@ class _PrefixCache:
             if not cands:
                 break
             e = min(cands, key=lambda c: c.last_used)
+            if demote is not None:
+                demote(e)
             del self._entries[e.key]
             self._by_page.pop(e.page, None)
             pe = self._by_page.get(e.parent_page)
@@ -399,6 +419,24 @@ class _PrefixCache:
         if freed:
             stat_add("gen/prefix_evictions", freed)
         return freed
+
+    def chain_tokens(self, e: _PrefixEntry) -> list[bytes] | None:
+        """Root-to-leaf token bytes of ``e``'s radix chain (each element
+        is one full page's int32 token bytes) — the input to the store's
+        :func:`~paddle_tpu.serving.kvstore.page_chain_keys`. A parent is
+        only evictable after its children, so the walk is complete for
+        any live entry; returns None on a broken chain (mid-rebuild)."""
+        chain: list[bytes] = []
+        cur = e
+        while True:
+            chain.append(cur.key[1])
+            if cur.parent_page == 0:
+                break
+            cur = self._by_page.get(cur.parent_page)
+            if cur is None:
+                return None
+        chain.reverse()
+        return chain
 
 
 def _sample_slot(logits, key, temperature, top_k, top_p):
@@ -483,7 +521,8 @@ class GenerationEngine:
                  spec_k: int | None = None, spec_mode: str | None = None,
                  draft_model=None, spec_ngram: int | None = None,
                  spec_shed_occupancy: float | None = None,
-                 mesh_tp: int | None = None, ledger=None):
+                 mesh_tp: int | None = None, ledger=None,
+                 kv_store=None, role: str | None = None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -595,6 +634,43 @@ class GenerationEngine:
         else:
             self._ledger = None
             self._goodput = None
+        # disaggregated-serving KV store (hard-off by default:
+        # gen_kv_store=False builds no store and no role machinery —
+        # every hot-path gate below is a single is-None check on
+        # self._kv, same discipline as the ledger. Flags are read HERE
+        # only). kv_store= accepts True/False to force, or a KVStore to
+        # share one (how the in-proc tests model a fleet). The store
+        # lives OUTSIDE _rebuild's pool/prefix replacement: serialized
+        # host bytes survive engine self-healing by design.
+        self._role = str(flag("gen_role") if role is None else role)
+        if self._role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown gen_role {self._role!r}; expected "
+                             "'prefill', 'decode' or 'both'")
+        kv = flag("gen_kv_store") if kv_store is None else kv_store
+        if kv:
+            if not self._paged:
+                raise ValueError("gen_kv_store requires the paged engine "
+                                 "(gen_paged / paged=True): only paged "
+                                 "KV is a transferable unit")
+            from paddle_tpu.serving.kvstore import KVStore
+            self._kv_owned = not isinstance(kv, KVStore)
+            self._kv = kv if isinstance(kv, KVStore) else KVStore(
+                pages=int(flag("gen_kv_store_pages")),
+                spill=str(flag("gen_kv_spill_dir")) or None)
+            # prefill-tier replicas are producers: they publish but
+            # never fetch; decode-tier (and 'both') replicas fetch at
+            # admission. Whoever ran a prefill publishes its pages —
+            # that write is what makes the store fleet-wide.
+            self._kv_fetch = self._role in ("decode", "both")
+            self._kv_published = 0       # pages this engine put
+            self._kv_fetched_pages = 0   # pages admitted from the store
+            self._kv_fetched_bytes = 0
+            self._kv_demoted = 0         # prefix evictions demoted, not
+            self._kv_recomputed = 0      # dropped; resumed-prefill debt
+        else:
+            self._kv = None
+            self._kv_owned = False
+            self._kv_fetch = False
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -1127,7 +1203,8 @@ class GenerationEngine:
               top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
               seed: int = 0, rng_skip: int = 0,
               trace_id: str | None = None,
-              tenant: str | None = None) -> str:
+              tenant: str | None = None,
+              fingerprint: str | None = None) -> str:
         """Enqueue a generation; returns its id immediately. Raises
         :class:`EngineOverloaded` (retryable) when every slot is busy and
         the admit queue is at ``queue_max``, and the typed
@@ -1141,7 +1218,12 @@ class GenerationEngine:
         generation's slot-lifecycle events under it. ``tenant`` (wire
         header ``tn``) is the caller's attribution identity — the
         ledger books this generation's tokens/chip-seconds/queue-wait
-        under it when ``FLAGS_gen_ledger`` is on."""
+        under it when ``FLAGS_gen_ledger`` is on. ``fingerprint``
+        (wire header ``fp``) overrides the crash fingerprint computed
+        from the request itself: a resumed stream's replay prompt grew
+        by the delivered tokens, so the resuming client passes the
+        ORIGINAL stream's fingerprint — quarantine then recognizes
+        resumed poison instead of admitting it under a fresh hash."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -1178,6 +1260,8 @@ class GenerationEngine:
                          float(temperature), int(top_k), float(top_p),
                          None if eos is None else int(eos), int(seed))
         gen.rng_skip = rng_skip
+        if fingerprint:
+            gen.fingerprint = str(fingerprint)
         if trace_id:
             gen.trace_id = str(trace_id)
         if tenant:
@@ -1370,6 +1454,18 @@ class GenerationEngine:
                 doc["goodput"] = self._goodput.snapshot()
             if self._ledger is not None:
                 doc["tenants"] = self._ledger.tenants()
+            # disaggregated serving (FLAGS_gen_kv_store only): store
+            # tiers + this engine's produce/consume counters. Absent
+            # with the store off so the default health doc is
+            # byte-identical to the pre-store build.
+            if self._kv is not None:
+                doc["kv"] = dict(self._kv.snapshot(),
+                                 role=self._role,
+                                 published=self._kv_published,
+                                 fetched_pages=self._kv_fetched_pages,
+                                 fetched_bytes=self._kv_fetched_bytes,
+                                 demoted=self._kv_demoted,
+                                 prefill_recomputed=self._kv_recomputed)
             return doc
 
     def ledger_dump(self, limit: int | None = None) -> dict | None:
@@ -1391,7 +1487,10 @@ class GenerationEngine:
         with self._cond:
             if self._prefix is None:
                 return 0
-            freed = self._prefix.evict(self._pool.num_pages, self._pool)
+            freed = self._prefix.evict(self._pool.num_pages, self._pool,
+                                       demote=(self._kv_demote
+                                               if self._kv is not None
+                                               else None))
             stat_set("gen/pages_free", self._pool.free_count)
             return freed
 
@@ -1455,6 +1554,8 @@ class GenerationEngine:
             if self._paged:
                 self._pt[:] = 0
             self._cond.notify_all()
+        if self._kv is not None and self._kv_owned:
+            self._kv.close()   # shared stores outlive their engines
 
     def __enter__(self):
         return self
@@ -1768,9 +1869,27 @@ class GenerationEngine:
                 matched: list[int] = []
                 if self._prefix is not None:
                     matched = self._prefix.match(gen.prompt, self._pool)
+                if (self._kv is not None and self._kv_fetch
+                        and self._prefix is not None):
+                    matched += self._kv_admit_fetch(gen, matched)
+                    if gen.rng_skip:
+                        # a resumed stream's original prompt is
+                        # prompt[:-rng_skip] (replay appended the
+                        # delivered tokens); whatever of it the cache +
+                        # store did not cover is recomputed prefill —
+                        # the debt KV-native failover exists to zero
+                        debt = max(0, (int(gen.prompt.size)
+                                       - int(gen.rng_skip))
+                                   - len(matched) * P)
+                        self._kv_recomputed += debt
+                        if debt:
+                            stat_add("gen/kv_prefill_recomputed", debt)
                 short = (need - len(matched)) - self._pool.free_count
                 if short > 0 and self._prefix is not None:
-                    self._prefix.evict(short, self._pool)
+                    self._prefix.evict(short, self._pool,
+                                       demote=(self._kv_demote
+                                               if self._kv is not None
+                                               else None))
                 if need - len(matched) > self._pool.free_count:
                     for pid in matched:     # give the hits back; retry
                         self._pool.release(pid)   # when pages free up
@@ -1801,6 +1920,110 @@ class GenerationEngine:
                                 prompt_len=int(gen.prompt.size),
                                 pages=len(gen.pages), shared=gen.shared)
                 progressed = True
+
+    def _page_frame(self, pid: int) -> bytes:
+        """Serialize pool page ``pid`` (one device->host fetch per
+        cache leaf) into a wire frame. Works for both layouts — the
+        int8 quantized pool just has 4 leaves instead of 2."""
+        from paddle_tpu.models.generation import serialize_page
+        return serialize_page([np.asarray(leaf[pid])
+                               for leaf in self._state["cache"]])
+
+    def _kv_demote(self, e: _PrefixEntry) -> None:
+        """Prefix-cache eviction hook: publish the victim page to the
+        KV store (under its full radix chain key) before the pool
+        releases it — eviction demotes instead of dropping."""
+        chain = self._prefix.chain_tokens(e)
+        if chain is None:
+            return
+        from paddle_tpu.serving.kvstore import page_chain_keys
+        toks = np.frombuffer(b"".join(chain), np.int32)
+        key = page_chain_keys(toks, self._page_tokens)[-1]
+        if self._kv.contains(key) or self._kv.put(key,
+                                                  self._page_frame(e.page)):
+            self._kv_demoted += 1
+            stat_add("gen/kv_demotions")
+
+    def _kv_publish(self, gen: Generation) -> None:
+        """Publish every full prompt page of a finished prefill to the
+        store (prefill/'both' tier AND decode tier — whoever computed
+        pages shares them; the store's content-addressed put makes
+        re-publication a no-op)."""
+        from paddle_tpu.serving.kvstore import page_chain_keys
+        keys = page_chain_keys(gen.prompt, self._page_tokens)
+        for i, key in enumerate(keys):
+            if self._kv.contains(key):
+                continue
+            frame = self._page_frame(gen.pages[i])
+            if self._kv.put(key, frame):
+                self._kv_published += 1
+                stat_add("gen/kv_puts")
+                stat_add("gen/kv_put_bytes", len(frame))
+
+    def _kv_admit_fetch(self, gen: Generation,
+                        matched: list[int]) -> list[int]:
+        """Admission-time store fetch: extend the local radix match
+        with pages fetched from the KV store, so the miss becomes a
+        transfer instead of a prefill recompute. Fetched pages are
+        scattered into the pool host-side and registered in the prefix
+        cache (page tables are rehydrated from the page-id list like
+        any matched page). Stops at the first miss / corrupt frame /
+        page shortage; capped like ``match`` so at least one prompt
+        token remains to prefill."""
+        from paddle_tpu.models.generation import deserialize_page
+        from paddle_tpu.serving.kvstore import page_chain_keys
+        import jax.numpy as jnp
+        P = self._page_tokens
+        cap = (int(gen.prompt.size) - 1) // P
+        start = len(matched)
+        if start >= cap:
+            return []
+        t0 = time.perf_counter()
+        keys = page_chain_keys(gen.prompt, P, limit=cap)
+        fetched: list[int] = []
+        nbytes = 0
+        for key in keys[start:]:
+            frame = self._kv.get(key)
+            if frame is None:
+                break
+            try:
+                leaves = deserialize_page(frame)
+            except ValueError:
+                break                    # corrupt entry reads as a miss
+            if (len(leaves) != len(self._state["cache"])
+                    or any(l.shape != tuple(pl.shape[1:])
+                           or l.dtype != pl.dtype for l, pl
+                           in zip(leaves, self._state["cache"]))):
+                break                    # foreign layout: not our pool
+            if self._pool.free_count == 0 and self._prefix.evict(
+                    1, self._pool, demote=self._kv_demote) == 0:
+                break
+            pid = self._pool.alloc(1)[0]
+            self._state["cache"] = tuple(
+                pl.at[pid].set(jnp.asarray(l)) for pl, l
+                in zip(self._state["cache"], leaves))
+            fetched.append(pid)
+            nbytes += len(frame)
+        dt = time.perf_counter() - t0
+        if self._goodput is not None:
+            self._goodput.note("kv_fetch", dt)
+        if fetched:
+            # register the fetched chain so the NEXT admission is a
+            # local radix hit; insert gives the cache its +1 ref, the
+            # alloc above is the generation's ref — same accounting as
+            # a matched page
+            cov = start + len(fetched)
+            self._prefix.insert(gen.prompt[:cov * P], matched + fetched,
+                                self._pool)
+            self._kv_fetched_pages += len(fetched)
+            self._kv_fetched_bytes += nbytes
+            stat_add("gen/kv_hits")
+            stat_add("gen/kv_fetch_pages", len(fetched))
+            stat_add("gen/kv_fetch_bytes", nbytes)
+            stat_add("gen/kv_fetch_tokens_saved", len(fetched) * P)
+        else:
+            stat_add("gen/kv_miss")
+        return fetched
 
     def _prefill_tick(self) -> bool:
         """Advance every prefilling slot by ONE chunk (then the loop
@@ -1876,6 +2099,8 @@ class GenerationEngine:
                         time.perf_counter() - gen.prefill_t0)
                 if self._prefix is not None:
                     self._prefix.insert(gen.prompt, gen.pages, self._pool)
+                if self._kv is not None:
+                    self._kv_publish(gen)
                 gen.tokens.append(tok0)
                 if self._ledger is not None:
                     gen.first_tok_ts = time.monotonic()

@@ -1,0 +1,237 @@
+"""Tiered, fleet-wide KV page store for disaggregated serving.
+
+The paged engine (PR 6) made KV pages refcounted, position-independent
+units; this module makes them *transferable*. A :class:`KVStore` keeps
+serialized page frames (``models.generation.serialize_page``) in a
+host-RAM LRU tier, written through to an optional spill tier — any
+``io.fs`` filesystem, so a local directory for one box or a ``WireFS``
+endpoint (``ptfs://host:port/kv``) shared by every replica in the
+fleet. Pages are keyed by their radix *chain key*: a hash chain over
+the page's token bytes and every ancestor page's token bytes
+(:func:`page_chain_keys`), the store-global generalization of the
+``_PrefixCache``'s ``(parent_page, token_bytes)`` radix key. Two
+replicas that prefill the same prompt prefix derive the same keys, so
+a prefix computed (or demoted) anywhere is a fetch — not a recompute —
+everywhere.
+
+Mirrors the heterogeneous role split of the reference's heter
+parameter server (``distributed/ps/heter.py``): prefill-tier replicas
+produce pages into the store, decode-tier replicas consume them at
+admission (``serving/engine.py``), and ``StickySession`` failover
+upgrades from token replay to KV fetch.
+
+The store is an I/O-side cache, never an authority: every operation
+degrades to a miss on spill-tier failure, and a corrupt frame reads as
+a miss (``deserialize_page`` validates), so a broken store can slow
+serving down but never wrong it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Sequence
+
+import numpy as np
+
+from paddle_tpu.io.fs import fs_for_path
+
+__all__ = ["KVStore", "page_chain_keys"]
+
+
+def page_chain_keys(tokens, page_tokens: int,
+                    limit: int | None = None) -> list[str]:
+    """Radix chain keys for every FULL page of a token sequence.
+
+    ``key[i] = sha1(key[i-1] || tokens[i*P:(i+1)*P])`` over int32 token
+    bytes — key ``i`` commits to the entire prefix through page ``i``,
+    exactly like the ``_PrefixCache`` radix walk, but replica-
+    independent. Partial tail pages get no key: only whole pages are
+    ever published, so the null-page sink and half-filled tails can
+    never enter the store. ``limit`` caps the number of keys returned
+    (admission only wants the first ``cap`` pages).
+    """
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    n = toks.size // page_tokens
+    if limit is not None:
+        n = min(n, max(0, int(limit)))
+    keys = []
+    h = b""
+    for i in range(n):
+        page = toks[i * page_tokens:(i + 1) * page_tokens].tobytes()
+        h = hashlib.sha1(h + page).digest()
+        keys.append(h.hex())
+    return keys
+
+
+class KVStore:
+    """Two-tier page store: host-RAM LRU over an ``io.fs`` spill tier.
+
+    ``put`` writes through to the spill tier (that write IS the fleet-
+    wide publication), so RAM eviction is a pure demotion — the bytes
+    survive in the spill tier and ``get`` re-promotes them. Without a
+    spill tier the store is replica-local and RAM eviction drops.
+    Thread-safe; all counters ride :meth:`snapshot` into engine
+    ``stats()`` / health.
+    """
+
+    def __init__(self, *, pages: int = 256, spill: str | None = None):
+        self._cap = max(1, int(pages))
+        self._ram: OrderedDict[str, bytes] = OrderedDict()
+        self._lock = threading.Lock()
+        self._spill_root = str(spill).rstrip("/") if spill else None
+        self._fs = None
+        if self._spill_root:
+            self._fs = fs_for_path(self._spill_root)
+            try:
+                self._fs.mkdirs(self._spill_root)
+            except Exception:
+                pass  # FSService mkdirs is idempotent; races are benign
+        self.hits = 0          # get() served (either tier)
+        self.spill_hits = 0    # ...of which came from the spill tier
+        self.misses = 0        # get() found nothing
+        self.puts = 0          # new frames accepted
+        self.put_bytes = 0
+        self.fetch_bytes = 0   # bytes returned by get()
+        self.demotions = 0     # RAM -> spill-backed eviction
+        self.dropped = 0       # RAM eviction with no spill tier
+        self.probes = 0
+
+    # -- spill tier ----------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return f"{self._spill_root}/{key}.kvpg"
+
+    def _spill_write(self, key: str, frame: bytes) -> None:
+        if self._fs is None:
+            return
+        fd, tmp = tempfile.mkstemp(prefix="kvpg.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(frame)
+            self._fs.upload(tmp, self._path(key))
+        except Exception:
+            pass  # spill failure degrades to a replica-local entry
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _spill_read(self, key: str) -> bytes | None:
+        if self._fs is None:
+            return None
+        fd, tmp = tempfile.mkstemp(prefix="kvpg.")
+        os.close(fd)
+        try:
+            self._fs.download(self._path(key), tmp)
+            with open(tmp, "rb") as f:
+                return f.read()
+        except Exception:
+            return None  # absent or unreachable: a miss, never an error
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _spill_has(self, key: str) -> bool:
+        if self._fs is None:
+            return False
+        try:
+            return self._fs.is_file(self._path(key))
+        except Exception:
+            return False
+
+    # -- public API ----------------------------------------------------
+
+    def put(self, key: str, frame: bytes) -> bool:
+        """Insert a page frame. Content-addressed: a key already held
+        (either tier) is a no-op. Returns True when the frame was newly
+        accepted."""
+        with self._lock:
+            if key in self._ram:
+                self._ram.move_to_end(key)
+                return False
+            if self._spill_has(key):
+                return False
+            self._ram[key] = frame
+            self.puts += 1
+            self.put_bytes += len(frame)
+            self._spill_write(key, frame)
+            self._shrink_locked()
+            return True
+
+    def get(self, key: str) -> bytes | None:
+        """Fetch a page frame, promoting spill-tier hits back into
+        RAM. Returns None on a miss."""
+        with self._lock:
+            frame = self._ram.get(key)
+            if frame is not None:
+                self._ram.move_to_end(key)
+            else:
+                frame = self._spill_read(key)
+                if frame is not None:
+                    self.spill_hits += 1
+                    self._ram[key] = frame
+                    self._shrink_locked()
+            if frame is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self.fetch_bytes += len(frame)
+            return frame
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._ram or self._spill_has(key)
+
+    def probe(self, keys: Sequence[str]) -> int:
+        """Longest prefix run of ``keys`` present in the store (either
+        tier). Chain keys commit to their whole prefix, so the first
+        absent key ends the usable run — pages past a hole cannot be
+        admitted. Advisory: bumps no hit/miss counters."""
+        with self._lock:
+            self.probes += 1
+            n = 0
+            for k in keys:
+                if k in self._ram or self._spill_has(k):
+                    n += 1
+                else:
+                    break
+            return n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ram_entries": len(self._ram),
+                "ram_cap": self._cap,
+                "spill": bool(self._spill_root),
+                "hits": self.hits, "spill_hits": self.spill_hits,
+                "misses": self.misses, "puts": self.puts,
+                "put_bytes": self.put_bytes,
+                "fetch_bytes": self.fetch_bytes,
+                "demotions": self.demotions, "dropped": self.dropped,
+                "probes": self.probes,
+            }
+
+    def close(self) -> None:
+        fs, self._fs = self._fs, None
+        if fs is not None and hasattr(fs, "close"):
+            try:
+                fs.close()
+            except Exception:
+                pass
+
+    # -- internals -----------------------------------------------------
+
+    def _shrink_locked(self) -> None:
+        while len(self._ram) > self._cap:
+            self._ram.popitem(last=False)
+            if self._fs is not None:
+                self.demotions += 1
+            else:
+                self.dropped += 1

@@ -58,10 +58,13 @@ __all__ = ["RequestLedger", "GoodputMeter", "TenantBook", "PHASES",
 PHASES = ("admit_wait_s", "prefill_s", "decode_s", "deliver_s")
 
 # Engine-loop wall-clock taxonomy. Every loop second lands in exactly
-# one bucket; the first three are "useful token work" (the goodput
-# numerator).
+# one bucket; the buckets named in GOODPUT_USEFUL are "useful token
+# work" (the goodput numerator). kv_fetch is time spent pulling pages
+# from the KV store at admission — it *replaces* prefill compute, but
+# it is transfer, not token work, so it stays out of the numerator.
 GOODPUT_BUCKETS = ("prefill", "decode", "spec_verify", "host_gather",
-                   "admission_idle", "recompile", "watchdog_stuck")
+                   "admission_idle", "recompile", "watchdog_stuck",
+                   "kv_fetch")
 GOODPUT_USEFUL = ("prefill", "decode", "spec_verify")
 
 # Untagged traffic books under this tenant key, so fleet totals still

@@ -31,9 +31,13 @@ negative spike) and answers windowed queries over them:
 With ``FLAGS_gen_ledger`` on, engine health docs additionally carry the
 request-ledger signals (``serving/ledger.py``) and the hub rolls them
 up fleet-wide: ``phase_percentiles()`` merges the per-phase latency
-histograms every finalized generation observes, ``tenants()`` sums the
-per-tenant consumption gauges, and ``fleet_goodput()`` combines the
-engines' loop-time taxonomies into one fleet goodput fraction.
+histograms every finalized generation observes (typed
+:class:`PhasesNotReady` — not a bare ``{}`` — when nothing merged yet),
+``tenants()`` sums the per-tenant consumption gauges, and
+``fleet_goodput()`` combines the engines' loop-time taxonomies into one
+fleet goodput fraction.  With ``FLAGS_gen_kv_store`` on, ``fleet_kv()``
+likewise sums the engines' KV-store gauge blocks into the fleet hit
+rate / fetch-bytes / demotion scoreboard.
 
 Membership churn is survivable by construction: an endpoint's first
 snapshot is a baseline (no delta), an endpoint that disappears simply
@@ -52,7 +56,38 @@ from typing import Any
 
 from paddle_tpu.core.monitor import hist_fraction_above, merge_histograms
 
-__all__ = ["MetricsHub", "hist_delta"]
+__all__ = ["MetricsHub", "PhasesNotReady", "hist_delta"]
+
+
+class PhasesNotReady(dict):
+    """Typed empty result from :meth:`MetricsHub.phase_percentiles`:
+    nothing merged this window.  A dict subclass so it JSON-serializes
+    through health/report paths, and **falsy** (it holds no phase
+    entries) so ``if pct:`` call sites behave exactly as with the old
+    bare ``{}`` — but it carries the diagnosis the bare dict silently
+    dropped: ``ticks_observed`` maps endpoint -> health ticks ingested.
+    Cumulative histograms need two ticks to difference into a window
+    delta, so an endpoint below 2 explains the emptiness ("not ready
+    yet"); every endpoint at >= 2 with still nothing means the request
+    ledger is off (or idle) fleet-wide."""
+
+    __slots__ = ("ticks_observed",)
+
+    def __init__(self, ticks_observed: dict[str, int]):
+        super().__init__()
+        self.ticks_observed = dict(ticks_observed)
+
+    @property
+    def not_ready(self) -> bool:
+        return True
+
+    @property
+    def waiting(self) -> list[str]:
+        """Endpoints that cannot contribute yet (fewer than two ticks)."""
+        return sorted(ep for ep, n in self.ticks_observed.items() if n < 2)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"PhasesNotReady(ticks_observed={self.ticks_observed!r})"
 
 
 def hist_delta(prev: dict | None, cur: dict | None) -> dict | None:
@@ -226,18 +261,36 @@ class MetricsHub:
                         "gen/phase/prefill_s", "gen/phase/decode_s",
                         "gen/phase/deliver_s")
 
+    def ticks_observed(self) -> dict[str, int]:
+        """Health ticks ingested per endpoint (windowed count). Cumulative
+        histograms need TWO ticks to difference into a window delta, so
+        an endpoint here with fewer than 2 cannot contribute to any
+        windowed merge yet — the readiness signal
+        :meth:`phase_percentiles` reports on an empty merge."""
+        with self._lock:
+            return {ep: len(s.ticks) for ep, s in self._series.items()}
+
     def phase_percentiles(self, ticks: int | None = None
                           ) -> dict[str, dict[str, float]]:
         """Fleet-merged per-phase latency percentiles over the last N
         ticks (default: slow window): the request ledger's phase
         histograms combined across every endpoint.  Phases nothing
-        observed are omitted; {} with the ledger off fleet-wide."""
+        observed are omitted.  When NOTHING merged, returns the typed
+        (and falsy — ``if pct:`` callers keep working)
+        :class:`PhasesNotReady` instead of a bare ``{}``, carrying
+        ``ticks_observed`` per endpoint: before an endpoint's second
+        tick there is no delta to merge, and the caller can now tell
+        "not ready yet" (some endpoint below 2 ticks) from "ledger off
+        fleet-wide" (everyone ticking, still nothing) instead of
+        guessing at an empty dict."""
         out: dict[str, dict[str, float]] = {}
         for name in self.PHASE_HISTOGRAMS:
             h = self.window_histogram(name, ticks or self.slow_ticks)
             if h is not None:
                 out[name] = {k: h[k] for k in
                              ("count", "sum", "p50", "p95", "p99")}
+        if not out:
+            return PhasesNotReady(self.ticks_observed())
         return out
 
     def tenants(self) -> dict[str, dict[str, float]]:
@@ -294,6 +347,44 @@ class MetricsHub:
             "fractions": {b: (v / total if total > 0 else 0.0)
                           for b, v in buckets.items()},
             "goodput": useful / total if total > 0 else 0.0,
+        }
+
+    def fleet_kv(self) -> dict[str, Any] | None:
+        """Fleet KV-store rollup: every (endpoint, model) engine's ``kv``
+        gauge block (``serving/kvstore.py`` snapshot + engine counters)
+        summed, with the derived fleet hit rate over all lookups — the
+        disaggregated-serving scoreboard (`tools/perf_report.py`).  None
+        when no engine reports one (store off fleet-wide)."""
+        counters: dict[str, float] = {}
+        roles: dict[str, int] = {}
+        engines = 0
+        with self._lock:
+            for s in self._series.values():
+                for g in s.gauges.values():
+                    kv = g.get("kv")
+                    if not isinstance(kv, dict):
+                        continue
+                    engines += 1
+                    role = kv.get("role")
+                    if isinstance(role, str):
+                        roles[role] = roles.get(role, 0) + 1
+                    for k, v in kv.items():
+                        if isinstance(v, (int, float)) and \
+                                not isinstance(v, bool):
+                            counters[k] = counters.get(k, 0.0) + float(v)
+        if engines == 0:
+            return None
+        # kvstore counts spill_hits as a subset of hits (either tier)
+        hits = counters.get("hits", 0.0)
+        lookups = hits + counters.get("misses", 0.0)
+        return {
+            "engines": engines,
+            "roles": roles,
+            "counters": counters,
+            "hit_rate": hits / lookups if lookups > 0 else 0.0,
+            "fetch_bytes": counters.get("fetched_bytes", 0.0),
+            "demotions": counters.get("demotions", 0.0),
+            "prefill_recomputed": counters.get("prefill_recomputed", 0.0),
         }
 
     def endpoints(self) -> list[str]:

@@ -28,6 +28,14 @@ mesh (``serving/layout.py``) while the replica stays one endpoint —
 streams remain byte-identical to unsharded replicas, so a router can
 fail a stream over between sharded and unsharded members freely
 (``tools/chaos_check.py gen-sharded``).
+
+``--kv-store --role prefill|decode|both`` joins the replica to the
+disaggregated prefill/decode tier split (``serving/kvstore.py``):
+with ``--kv-spill-dir`` pointing every member at one shared root, a
+prefix prefilled on any replica is a KV fetch — not a recompute — on
+every other, and a killed decode replica's streams resume elsewhere
+with zero recomputed prefill tokens (``tools/chaos_check.py
+gen-disagg``).
 """
 
 from __future__ import annotations
@@ -77,6 +85,20 @@ def main(argv: list[str] | None = None) -> int:
                          "0 = unsharded). The replica stays ONE "
                          "endpoint; token streams are byte-identical "
                          "to unsharded replicas")
+    ap.add_argument("--role", default=None,
+                    choices=("prefill", "decode", "both"),
+                    help="disaggregated serving tier of the --gen "
+                         "engine (FLAGS_gen_role per replica; default "
+                         "'both'). Inert unless the KV store is on")
+    ap.add_argument("--kv-store", action="store_true",
+                    help="enable the tiered KV page store for the "
+                         "--gen engine (FLAGS_gen_kv_store per "
+                         "replica); point --kv-spill-dir (or the "
+                         "FLAGS_gen_kv_spill_dir environment) at a "
+                         "shared root to make it fleet-wide")
+    ap.add_argument("--kv-spill-dir", default=None,
+                    help="KV store spill-tier root: a shared directory "
+                         "or a ptfs:// WireFS endpoint")
     args = ap.parse_args(argv)
 
     if args.mesh_tp > 0:
@@ -93,8 +115,15 @@ def main(argv: list[str] | None = None) -> int:
                 os.environ.get("XLA_FLAGS", "") +
                 f" --xla_force_host_platform_device_count={n}").strip()
 
-    from paddle_tpu.core.flags import flag
+    from paddle_tpu.core.flags import flag, set_flags
     from paddle_tpu.io.serving import InferenceServer
+
+    if args.kv_spill_dir is not None:
+        # running as ``python -m`` imports the paddle_tpu package (and
+        # with it the flag registry) BEFORE main() runs, so an env
+        # export here would be read too late — set the flag directly;
+        # the engine reads it at construction
+        set_flags({"gen_kv_spill_dir": args.kv_spill_dir})
 
     models: dict[str, str] = {}
     for spec in args.models:
@@ -130,7 +159,9 @@ def main(argv: list[str] | None = None) -> int:
                           spec_k=args.gen_spec_k,
                           spec_mode=args.gen_spec_mode,
                           draft_model=draft,
-                          mesh_tp=args.mesh_tp)
+                          mesh_tp=args.mesh_tp,
+                          kv_store=(True if args.kv_store else None),
+                          role=args.role)
     srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
 

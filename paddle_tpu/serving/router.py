@@ -75,7 +75,7 @@ from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.core.wire import FrameClient, WireShedError
 from paddle_tpu.io.serving import InferenceClient
 from paddle_tpu.serving.engine import (
-    EXPIRED_MARKER, RESET_MARKER, GenerationExpired,
+    EXPIRED_MARKER, RESET_MARKER, GenerationExpired, stream_fingerprint,
 )
 
 __all__ = ["RoutedClient", "ReplicaState", "StickySession",
@@ -168,6 +168,15 @@ class RoutedClient:
                                        retries=retries))
         self._timeout = timeout
         self._pool_size = max(int(pool_size), 1)
+        # KV-locality placement (FLAGS_gen_kv_store, read HERE only —
+        # hard-off keeps session pinning byte-identical): with the
+        # store on, an unpinned session's first generation probes the
+        # healthy replicas' stores (kv_probe) and pins the one holding
+        # the longest radix prefix of the prompt — the per-prefix
+        # generalization of the load signals
+        self._kv_locality = bool(flag("gen_kv_store"))
+        self._kv_page_tokens = (int(flag("gen_page_tokens"))
+                                if self._kv_locality else 0)
         self._lock = threading.Lock()
         self._replicas: list[ReplicaState] = []
         self._rr = 0                     # round-robin tie-breaker
@@ -583,6 +592,46 @@ class StickySession:
     def _client(self) -> InferenceClient:
         return self._router._client(self._pin())
 
+    def _kv_place(self, prompt: np.ndarray) -> None:
+        """KV-locality placement (FLAGS_gen_kv_store only): pin this
+        not-yet-pinned session to the healthy replica whose store holds
+        the longest radix-chain prefix of ``prompt`` — its admission
+        serves those pages from RAM instead of fetching (or, store-off
+        fleetwide, recomputing). Best-effort: probe errors and
+        no-match fleets fall back to the crc32 pin; an existing pin is
+        never moved (stickiness wins over locality)."""
+        with self._lock:
+            if self._endpoint is not None:
+                return
+        from paddle_tpu.serving.kvstore import page_chain_keys
+        P = self._router._kv_page_tokens
+        if P < 1:
+            return
+        keys = page_chain_keys(prompt, P,
+                               limit=(int(prompt.size) - 1) // P)
+        if not keys:
+            return
+        healthy = self._router._healthy_endpoints()
+        if len(healthy) < 2:
+            return
+        best, best_n = None, 0
+        for ep in healthy:
+            r = self._router._replica_for(ep)
+            if r is None:
+                continue
+            try:
+                n = self._router._client(r).kv_probe(keys)
+            except (ConnectionError, TimeoutError, OSError,
+                    RuntimeError):
+                continue
+            if n > best_n:
+                best, best_n = ep, n
+        if best is not None:
+            with self._lock:
+                if self._endpoint is None:
+                    self._endpoint = best
+                    stat_add("serving/router/kv_placements")
+
     def _wrap(self, fn, *, during_generation: bool):
         ep = self._endpoint
         try:
@@ -653,6 +702,8 @@ class StickySession:
                   eos_token_id=eos_token_id, seed=seed,
                   poll_wait_s=poll_wait_s, trace_id=trace_id,
                   tenant=tenant)
+        if self._router._kv_locality:
+            self._kv_place(prompt)
         if budget <= 0:
             return self._stream_once(model, prompt, max_new_tokens, **kw)
         return self._resuming_stream(model, prompt, max_new_tokens,
@@ -663,7 +714,8 @@ class StickySession:
                      eos_token_id: int | None, seed: int,
                      poll_wait_s: float, rng_skip: int = 0,
                      trace_id: str | None = None,
-                     tenant: str | None = None):
+                     tenant: str | None = None,
+                     fingerprint: str | None = None):
         """One pinned stream attempt (the pre-resumption ``generate``
         body). Server-side failures that lost the slot state but left
         the replica up — the ``engine reset:`` marker — surface as
@@ -676,7 +728,7 @@ class StickySession:
                 model, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
                 seed=seed, rng_skip=rng_skip, trace_id=trace_id,
-                tenant=tenant),
+                tenant=tenant, fingerprint=fingerprint),
             during_generation=True)
         with self._lock:
             self._active += 1
@@ -736,10 +788,14 @@ class StickySession:
         by the engine's prefill-from-prefix determinism contract, and
         sampled replays pass ``rng_skip=len(delivered)`` so the engine
         fast-forwards the per-(prompt, seed) key schedule to the break
-        position."""
+        position. Every replay also carries the ORIGINAL stream's crash
+        fingerprint (header ``fp``): the replay prompt grew by the
+        delivered tokens and would hash fresh, so without the carry a
+        poisoned stream dodges quarantine by failing over."""
         delivered: list[int] = []
         attempts = 0
         last: BaseException | None = None
+        fp = stream_fingerprint(prompt, temperature, top_k, top_p, seed)
         while True:
             n0 = len(delivered)
             try:
@@ -753,12 +809,19 @@ class StickySession:
                 else:
                     replay = np.concatenate(
                         [prompt, np.asarray(delivered, np.int32)])
+                    if self._router._kv_locality:
+                        # KV-native failover: land the resumed stream
+                        # on the replica whose store already holds the
+                        # longest prefix of the replay — its admission
+                        # fetches instead of recomputing prefill
+                        self._kv_place(replay)
                     inner = self._stream_once(
                         model, replay, max_new_tokens - n0,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
                         seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0,
-                        trace_id=trace_id, tenant=tenant)
+                        trace_id=trace_id, tenant=tenant,
+                        fingerprint=fp)
                 for tok in inner:
                     delivered.append(int(tok))
                     yield int(tok)

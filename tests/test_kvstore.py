@@ -1,0 +1,379 @@
+"""Disaggregated serving: the tiered fleet-wide KV store.
+
+The load-bearing properties: page frames are BIT-exact across the wire
+(both cache layouts — f32/bf16 2-leaf and int8 4-leaf data+scale), the
+radix chain key commits to the whole token prefix (full pages only —
+the partial tail and the null page never enter the store), RAM-tier
+eviction DEMOTES to the spill tier and refetches byte-identical, and a
+second engine sharing the spill root serves a prefix computed elsewhere
+as a KV fetch — not a prefill recompute — with streams byte-identical
+to the cold path. Defaults are hard-off: the unflagged engine builds no
+store and reads no ``gen_kv*`` flag on the hot path.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.core.flags import flag, get_flags, set_flags
+from paddle_tpu.core.monitor import get_stat
+from paddle_tpu.io.serving import InferenceClient, InferenceServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import (
+    deserialize_page, generate, init_paged_cache, serialize_page,
+)
+from paddle_tpu.serving import GenerationEngine, RoutedClient
+from paddle_tpu.serving.kvstore import KVStore, page_chain_keys
+
+pytestmark = pytest.mark.disagg
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            assert doc["error"] is None, doc["error"]
+            return toks
+
+
+def _prompt(seed=0, n=16):
+    return np.random.RandomState(seed).randint(0, VOCAB, (n,)).astype(
+        np.int32)
+
+
+# -- page frame serialization ----------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_page_frame_roundtrip_2leaf(model, dtype):
+    """The float layouts' 2-leaf page frames decode bit-for-bit: same
+    shapes, same dtypes, same bytes."""
+    import jax.numpy as jnp
+
+    proto = model.init_cache(1, 32, dtype=getattr(jnp, dtype))
+    pool = init_paged_cache(proto, num_pages=2, page_tokens=8)
+    rs = np.random.RandomState(3)
+    leaves = [np.asarray(rs.rand(*leaf.shape[1:]), np.float32).astype(
+        np.asarray(leaf).dtype) for leaf in pool]
+    back = deserialize_page(serialize_page(leaves))
+    assert len(back) == 2
+    for a, b in zip(leaves, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+
+
+def test_page_frame_roundtrip_int8_4leaf(model):
+    """The int8 quantized layout — 4 leaves, the scale leaves one
+    trailing dim shorter than their data leaves — serializes through
+    the same frame format bit-exactly."""
+    import jax.numpy as jnp
+
+    proto = model.init_cache(1, 32, dtype=jnp.int8)
+    pool = init_paged_cache(proto, num_pages=2, page_tokens=8)
+    assert len(pool) == 4
+    rs = np.random.RandomState(4)
+    leaves = []
+    for leaf in pool:
+        shape, dt = leaf.shape[1:], np.asarray(leaf).dtype
+        if dt == np.int8:
+            leaves.append(rs.randint(-127, 128, shape).astype(np.int8))
+        else:
+            leaves.append(rs.rand(*shape).astype(dt))
+    back = deserialize_page(serialize_page(leaves))
+    assert len(back) == 4
+    assert back[2].ndim == back[0].ndim - 1    # scale: one dim shorter
+    for a, b in zip(leaves, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+
+
+def test_page_frame_rejects_corruption():
+    """Foreign magic, truncation, and trailing garbage all raise — a
+    corrupt store entry must read as a MISS, never as silent wrong
+    cache bytes."""
+    frame = serialize_page([np.arange(8, dtype=np.float32)])
+    with pytest.raises(ValueError):
+        deserialize_page(b"NOTKV" + frame[5:])
+    with pytest.raises(ValueError):
+        deserialize_page(frame[:-3])
+    with pytest.raises(ValueError):
+        deserialize_page(frame + b"xx")
+
+
+def test_page_chain_keys_full_pages_only():
+    """Only FULL pages are keyed — the partial tail (and with it the
+    null-page sink positions) never enters the store — and key[i]
+    commits to the whole prefix through page i, so a shared prefix
+    yields a shared key chain and a diverging one diverges."""
+    toks = _prompt(5, 23)
+    keys = page_chain_keys(toks, 8)
+    assert len(keys) == 2                     # 23 tokens = 2 full pages
+    assert page_chain_keys(toks[:7], 8) == []  # sub-page prompt: nothing
+    # prefix property: a longer prompt's chain extends the shorter one's
+    assert page_chain_keys(toks[:16], 8) == keys
+    assert page_chain_keys(np.tile(toks, 2), 8)[:2] == keys
+    # limit stops the chain early (the admission cap)
+    assert page_chain_keys(np.tile(toks, 2), 8, limit=1) == keys[:1]
+    # divergence anywhere re-keys everything after it
+    other = toks.copy()
+    other[2] += 1
+    assert page_chain_keys(other, 8)[0] != keys[0]
+
+
+# -- the tiered store ------------------------------------------------------
+
+def test_store_put_get_probe(tmp_path):
+    st = KVStore(pages=8, spill=str(tmp_path))
+    assert st.get("missing") is None and st.misses == 1
+    assert st.put("k1", b"frame-1")
+    assert not st.put("k1", b"frame-1")       # content-addressed: no-op
+    assert st.get("k1") == b"frame-1"
+    st.put("k2", b"frame-2")
+    # probe: longest unbroken prefix run of the chain
+    assert st.probe(["k1", "k2", "k3"]) == 2
+    assert st.probe(["k3", "k1"]) == 0        # stops at the first hole
+    assert st.close() is None
+
+
+def test_store_lru_demotes_to_spill_and_refetches(tmp_path):
+    """RAM eviction is a DEMOTION: the bytes survive in the spill tier
+    and a later get() promotes them back byte-identical."""
+    st = KVStore(pages=2, spill=str(tmp_path))
+    frames = {f"k{i}": bytes([i]) * 40 for i in range(4)}
+    for k, f in frames.items():
+        st.put(k, f)
+    assert st.demotions == 2 and st.dropped == 0
+    snap = st.snapshot()
+    assert snap["ram_entries"] == 2
+    for k, f in frames.items():               # every frame survives
+        assert st.get(k) == f
+    assert st.spill_hits >= 2                 # the demoted pair
+    st.close()
+
+
+def test_store_without_spill_drops():
+    """No spill tier configured: eviction DROPS (counted) and the key
+    reads as a miss — degraded, never wrong."""
+    st = KVStore(pages=1)
+    st.put("a", b"A")
+    st.put("b", b"B")
+    assert st.dropped == 1 and st.demotions == 0
+    assert st.get("a") is None
+    assert st.get("b") == b"B"
+    assert st.snapshot()["spill"] is False
+
+
+# -- hard-off defaults ------------------------------------------------------
+
+def test_defaults_off_no_store_no_hot_path_flag_read(model, monkeypatch):
+    """Hard-off discipline: gen_kv_store/gen_role default off/'both',
+    the default engine builds NO store ('kv' absent from stats — the
+    health doc is byte-identical to a store-less build), and no
+    ``gen_kv*``/``gen_role`` flag is read on the serve hot path — only
+    at construction."""
+    assert flag("gen_kv_store") is False
+    assert flag("gen_role") == "both"
+    assert flag("gen_kv_spill_dir") == ""
+    import paddle_tpu.serving.engine as engine_mod
+
+    reads: list[str] = []
+    real_flag = engine_mod.flag
+
+    def spy(name):
+        reads.append(name)
+        return real_flag(name)
+
+    monkeypatch.setattr(engine_mod, "flag", spy)
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8) as eng:
+        assert eng._kv is None and eng._role == "both"
+        assert "gen_kv_store" in reads and "gen_role" in reads
+        reads.clear()
+        _drain(eng, eng.start(_prompt(), 6))
+        assert not [r for r in reads
+                    if r.startswith("gen_kv") or r == "gen_role"]
+        assert "kv" not in eng.stats()
+
+
+def test_store_requires_paged_cache(model):
+    with pytest.raises(ValueError, match="paged"):
+        GenerationEngine(model, slots=1, max_len=64,
+                         kv_store=KVStore(pages=4))
+
+
+# -- fleet-wide prefix reuse ------------------------------------------------
+
+def test_cross_engine_shared_prefix_fetch(model, tmp_path):
+    """A prefix prefilled on engine A is a KV FETCH on engine B (own
+    store instance, shared spill root, cold prefix cache): B's stream
+    is byte-identical to A's and to solo generate(), B fetched pages
+    instead of recomputing them, and no page leaks."""
+    prompt = _prompt(11, 16)                  # 2 full pages @ 8
+    spill = str(tmp_path)
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="both") as engA:
+        outA = _drain(engA, engA.start(prompt, 6))
+        kvA = engA.stats()["kv"]
+        assert kvA["role"] == "both" and kvA["published"] == 2
+    ref = np.asarray(generate(model, prompt[None], 6))[0, 16:]
+    np.testing.assert_array_equal(np.asarray(outA, np.int32), ref)
+
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="decode") as engB:
+        outB = _drain(engB, engB.start(prompt, 6))
+        assert outB == outA
+        kvB = engB.stats()["kv"]
+        # cap leaves the last prompt token to prefill: 1 of 2 pages
+        # is fetchable, and it came from the store, not recompute
+        assert kvB["fetched_pages"] == 1 and kvB["fetched_bytes"] > 0
+        assert kvB["published"] == 0          # decode computed no
+        assert get_stat("gen/kv_fetch_tokens_saved") >= 8
+        g = engB.stats()
+        assert g["pages_free"] + g["prefix_entries"] == g["pages"]
+
+
+def test_prefix_eviction_demotes_to_store(model, tmp_path):
+    """clear_prefix_cache (any eviction) with the store on demotes the
+    victims' pages instead of dropping them."""
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=str(tmp_path)),
+                          role="both") as eng:
+        _drain(eng, eng.start(_prompt(13, 16), 4))
+        assert eng.clear_prefix_cache() > 0
+        kv = eng.stats()["kv"]
+        assert kv["demoted"] > 0
+        g = eng.stats()
+        assert g["pages_free"] == g["pages"]
+
+
+# -- KV-native failover -----------------------------------------------------
+
+@pytest.mark.resilience
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_failover_resume_via_kv_fetch_zero_recompute(model, tmp_path,
+                                                     sampled):
+    """The tentpole acceptance: a stream resumed on a DIFFERENT decode
+    replica (replay prompt+delivered, rng_skip=delivered) whose store
+    holds the original prompt's pages completes byte-identical with
+    ZERO recomputed prefill tokens — the page-aligned original prompt
+    is covered entirely by KV fetch. Greedy and sampled (rng_skip
+    composes with the fetch unchanged)."""
+    kw = (dict(temperature=0.8, top_k=7, top_p=0.9, seed=42)
+          if sampled else {})
+    prompt = _prompt(17, 16)                  # page-aligned: 2 pages @ 8
+    spill = str(tmp_path)
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="both") as engA:
+        full = _drain(engA, engA.start(prompt, 6, **kw))
+        assert len(full) == 6
+
+    # the survivor: fresh engine, cold radix cache, same spill root
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="decode") as engB:
+        replay = np.concatenate([prompt, np.asarray(full[:3], np.int32)])
+        tail = _drain(engB, engB.start(replay, 3, rng_skip=3, **kw))
+        assert tail == full[3:]
+        kv = engB.stats()["kv"]
+        assert kv["prefill_recomputed"] == 0
+        assert kv["fetched_pages"] == 2       # the whole original prompt
+        g = engB.stats()
+        assert g["pages_free"] + g["prefix_entries"] == g["pages"]
+
+
+# -- wire ops & router locality --------------------------------------------
+
+def test_kv_wire_ops(model, tmp_path):
+    """kv_put/kv_get/kv_probe cross the wire: a store-backed replica
+    answers from its engine's store, a store-less replica degrades to
+    miss answers instead of erroring (mixed fleets probe cleanly)."""
+    eng = GenerationEngine(model, slots=1, max_len=64, paged=True,
+                           page_tokens=8,
+                           kv_store=KVStore(pages=8, spill=str(tmp_path)))
+    srv = InferenceServer().start()
+    srv.add_generator("llm", eng)
+    bare = InferenceServer().start()
+    bare.add_generator("llm", GenerationEngine(model, slots=1,
+                                               max_len=32))
+    c = InferenceClient(srv.endpoint)
+    c2 = InferenceClient(bare.endpoint)
+    try:
+        frame = serialize_page([np.arange(4, dtype=np.float32)])
+        assert c.kv_put("wire-k1", frame) is True
+        assert c.kv_put("wire-k1", frame) is False   # content-addressed
+        assert c.kv_get("wire-k1") == frame
+        assert c.kv_get("nope") is None
+        assert c.kv_probe(["wire-k1", "nope"]) == 1
+        # store-less replica: miss answers, not errors
+        assert c2.kv_put("wire-k1", frame) is False
+        assert c2.kv_get("wire-k1") is None
+        assert c2.kv_probe(["wire-k1"]) == 0
+    finally:
+        c.close()
+        c2.close()
+        srv.stop()
+        bare.stop()
+
+
+def test_router_kv_locality_pins_longest_prefix(model, tmp_path):
+    """With the store on, a session's first dispatch probes the fleet's
+    stores and pins the replica holding the longest prefix chain — the
+    request lands where its pages already are."""
+    # router reads both at init: the locality gate and the fleet's page
+    # size (the engines below are built with page_tokens=8 to match)
+    saved = get_flags(["gen_kv_store", "gen_page_tokens"])
+    set_flags({"gen_kv_store": True, "gen_page_tokens": 8})
+    servers, engines = [], []
+    try:
+        for i in range(2):
+            eng = GenerationEngine(
+                model, slots=2, max_len=64, paged=True, page_tokens=8,
+                kv_store=KVStore(pages=64,
+                                 spill=str(tmp_path / f"r{i}")),
+                role="both")
+            srv = InferenceServer().start()
+            srv.add_generator("llm", eng)
+            servers.append(srv)
+            engines.append(eng)
+        prompt = _prompt(23, 16)
+        # warm replica 1's store only (its private spill root)
+        ref = _drain(engines[1], engines[1].start(prompt, 4))
+        router = RoutedClient([s.endpoint for s in servers],
+                              probe_interval_s=0)
+        try:
+            p0 = get_stat("serving/router/kv_placements")
+            sess = router.session("locality-stream")
+            toks = list(sess.generate("llm", prompt, 4,
+                                      poll_wait_s=0.05))
+            assert toks == ref
+            assert sess.endpoint == servers[1].endpoint
+            assert get_stat("serving/router/kv_placements") == p0 + 1
+        finally:
+            router.close()
+    finally:
+        set_flags(saved)
+        for s in servers:
+            s.stop()

@@ -272,3 +272,67 @@ def test_phase_percentiles_merge_ledger_histograms():
     assert pct["gen/e2e_s"]["p50"] > 0.0
     # phases never observed are omitted, not zero-filled
     assert "gen/phase/admit_wait_s" not in pct
+
+
+def test_phase_percentiles_not_ready_is_typed():
+    """Satellite: before any endpoint has TWO health ticks there is no
+    histogram delta to merge — the old code silently returned {} and a
+    report could not tell "warming up" from "ledger off".  The empty
+    merge is now the typed (and still falsy, so ``if pct:`` callers are
+    unchanged) PhasesNotReady carrying per-endpoint ticks_observed."""
+    from paddle_tpu.serving.metrics import PhasesNotReady
+
+    hub = MetricsHub(fast_ticks=2, slow_ticks=6)
+
+    def doc(vals):
+        d = _doc([])
+        d["histograms"]["gen/phase/decode_s"] = _cum_hist(vals)
+        return d
+
+    # no ticks at all: typed, and nothing observed yet
+    pct = hub.phase_percentiles()
+    assert isinstance(pct, PhasesNotReady) and pct.not_ready
+    assert not pct                       # falsy like the old {}
+    assert pct.ticks_observed == {}
+
+    # one tick each: baselines only, still not ready — and the result
+    # names every endpoint stuck below two ticks
+    hub.ingest({"a": doc([0.1]), "b": doc([0.3])})
+    pct = hub.phase_percentiles()
+    assert isinstance(pct, PhasesNotReady)
+    assert pct.ticks_observed == {"a": 1, "b": 1}
+    assert pct.waiting == ["a", "b"]
+    assert hub.ticks_observed() == {"a": 1, "b": 1}
+
+    # second tick: a real merge — a PLAIN dict again, shape unchanged
+    hub.ingest({"a": doc([0.1] * 3), "b": doc([0.3] * 2)})
+    pct = hub.phase_percentiles()
+    assert not isinstance(pct, PhasesNotReady)
+    assert pct["gen/phase/decode_s"]["count"] == 3
+
+
+def test_fleet_kv_rollup_sums_engine_stores():
+    """fleet_kv() sums every engine's ``kv`` gauge block and derives
+    the fleet hit rate (spill_hits is a SUBSET of hits — not double
+    counted); None when no engine reports a store."""
+    hub = MetricsHub()
+    assert hub.fleet_kv() is None
+    a = _doc([])
+    a["generators"] = {"llm": {"kv": {
+        "role": "prefill", "hits": 0, "spill_hits": 0, "misses": 2,
+        "puts": 4, "fetched_bytes": 0, "demotions": 1,
+        "prefill_recomputed": 0}}}
+    b = _doc([])
+    b["generators"] = {"llm": {"kv": {
+        "role": "decode", "hits": 6, "spill_hits": 4, "misses": 2,
+        "puts": 0, "fetched_bytes": 4096, "demotions": 0,
+        "prefill_recomputed": 8}}}
+    hub.ingest({"a": a, "b": b})
+    kv = hub.fleet_kv()
+    assert kv["engines"] == 2
+    assert kv["roles"] == {"prefill": 1, "decode": 1}
+    assert kv["hit_rate"] == pytest.approx(6.0 / 10.0)
+    assert kv["fetch_bytes"] == 4096.0
+    assert kv["demotions"] == 1.0
+    assert kv["prefill_recomputed"] == 8.0
+    assert kv["counters"]["puts"] == 4.0

@@ -546,3 +546,55 @@ def test_resilience_defaults_off(model):
     finally:
         router.close()
         srv.stop()
+
+
+def test_poisoned_stream_quarantined_after_failover_hop(model):
+    """Satellite (PR 8 NOTE): a resumed stream's replay prompt grew by
+    the delivered tokens, so it used to hash a FRESH crash fingerprint
+    on every hop — a poisoned stream could walk the fleet forever, one
+    quarantine book at a time. The router now carries the ORIGINAL
+    fingerprint through the resume path (header ``fp``), so the
+    survivor that traps on the replay quarantines the original stream
+    identity and the next resume attempt is rejected typed."""
+    from paddle_tpu.serving.engine import stream_fingerprint
+
+    servers, engines = [], []
+    for _ in range(2):
+        eng = GenerationEngine(model, slots=2, max_len=32,
+                               step_wait_s=0.03, rebuilds=4,
+                               quarantine_after=1)
+        srv = InferenceServer().start()
+        srv.add_generator("llm", eng)
+        servers.append(srv)
+        engines.append(eng)
+    router = RoutedClient([s.endpoint for s in servers],
+                          probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(51)
+        prompt = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+        fp = stream_fingerprint(prompt)
+
+        sess = router.session("poison-stream")
+        it = sess.generate("llm", prompt, 10, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it)]                      # live on the pinned replica
+        pinned = sess.endpoint
+        victim = next(s for s in servers if s.endpoint == pinned)
+        survivor = next(e for s, e in zip(servers, engines)
+                        if s.endpoint != pinned)
+        victim.stop()                          # hop 1: replica death
+        # the resumed replay traps on the survivor: without the fp
+        # carry it would quarantine hash(prompt + delivered) and the
+        # NEXT resume would walk the poison right back in
+        with fault.inject_faults({"engine.decode_step": (1.0, 1)}):
+            with pytest.raises(RequestQuarantined):
+                toks += list(it)
+        assert fp in survivor._quarantined     # the ORIGINAL identity
+        assert survivor.stats()["quarantined"] == 1
+        # the poison is now rejected under its original prompt too
+        with pytest.raises(RequestQuarantined):
+            survivor.start(prompt, 4)
+    finally:
+        router.close()
+        for s in servers:
+            s.stop()

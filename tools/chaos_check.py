@@ -78,6 +78,14 @@ Scenarios (all CPU-only, single process):
     in the survivor's ``gen/retire reason=complete``; meanwhile a
     MetricsHub fed from routed ``health`` keeps answering windowed
     queries through the membership churn and prunes the dead replica.
+14. **gen-disagg**: two DECODE-tier subprocess replicas (``--role
+    decode --kv-store``) share one spill root; the replica holding a
+    live stream whose page-aligned prompt was prefilled-and-published
+    is SIGKILLed — the stream resumes byte-identical on the other
+    decode replica via KV FETCH (``fetched_pages>=1``) with ZERO
+    recomputed prefill tokens (``prefill_recomputed==0``: failover
+    upgraded from token replay to page transfer) and zero leaked pages
+    on the survivor.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
@@ -193,6 +201,14 @@ def check_defaults_off() -> None:
           not led["gen_ledger"]                   # no ledger, no meter
           and led["gen_ledger_records"] > 0,      # sane when opted in
           str(led))
+    kvs = get_flags(["gen_kv_store", "gen_role", "gen_kv_store_pages",
+                     "gen_kv_spill_dir"])
+    check("defaults/gen_kvstore_off",
+          not kvs["gen_kv_store"]                 # no store, no tiers
+          and kvs["gen_role"] == "both"           # no role split
+          and kvs["gen_kv_store_pages"] > 0       # sane when opted in
+          and kvs["gen_kv_spill_dir"] == "",      # no spill tier
+          str(kvs))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -1318,6 +1334,91 @@ def scenario_ledger(tmp: str) -> None:
         set_flags(saved)
 
 
+def scenario_gen_disagg(tmp: str) -> None:
+    """SIGKILL a decode-tier replica holding a live stream with the
+    tiered KV store on (two ``--role decode --kv-store`` replicas, one
+    shared spill root): the victim's prefill PUBLISHED the page-aligned
+    prompt's pages, so the resumed stream on the other decode replica
+    admits via KV FETCH — byte-identical completion with ZERO
+    recomputed prefill tokens and zero leaked pages on the survivor."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    monitor.reset_stats("serving/router/")
+    # the router's KV-locality placement reads both at construction;
+    # the subprocess replicas get their store via CLI args instead
+    saved = get_flags(["gen_kv_store", "gen_page_tokens"])
+    set_flags({"gen_kv_store": True, "gen_page_tokens": 8})
+    spill = os.path.join(tmp, "kv_spill")
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05",
+        "--gen-paged", "--gen-page-tokens", "8",
+        "--role", "decode", "--kv-store", "--kv-spill-dir", spill))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(61)
+        # PAGE-ALIGNED prompt (8 tokens @ page_tokens 8): the victim's
+        # prefill publishes the WHOLE original prompt, so the resumed
+        # admission covers it entirely from the store — recompute debt 0
+        prompt = rs.randint(0, 96, (8,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 12))[0, 8:]
+        sess = router.session("disagg-victim")
+        it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]          # the stream is live
+        victim = sess.endpoint
+        spawner.kill(victim)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)                 # resumes via KV fetch
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("disagg/stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={len(toks)}")
+        check("disagg/resume_counted_no_failure_surfaced",
+              err is None
+              and monitor.get_stat("serving/router/stream_resumes") >= 1
+              and monitor.get_stat("serving/router/resume_exhausted")
+              == 0,
+              str(monitor.export_stats("serving/router/")))
+        survivor = next(ep for ep in eps if ep != victim)
+        g = {}
+        with io.InferenceClient(survivor, timeout=5.0) as c:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                g = c.health()["generators"]["llm"]
+                if (g.get("active") == 0 and g.get("pages_free", 0)
+                        + g.get("prefix_entries", 0) == g.get("pages")):
+                    break
+                time.sleep(0.1)
+        kv = g.get("kv") or {}
+        check("disagg/failover_is_kv_fetch_zero_recompute",
+              kv.get("role") == "decode"
+              and kv.get("fetched_pages", 0) >= 1
+              and kv.get("prefill_recomputed", -1) == 0,
+              str(kv))
+        check("disagg/zero_leaked_pages_on_survivor",
+              g.get("pages_free", -1) + g.get("prefix_entries", 0)
+              == g.get("pages"), str(g))
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+        set_flags(saved)
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -1328,7 +1429,8 @@ def main() -> int:
                          scenario_gen_engine, scenario_gen_paged,
                          scenario_control_plane, scenario_gen_resilience,
                          scenario_gen_spec, scenario_gen_sharded,
-                         scenario_obs_fleet, scenario_ledger):
+                         scenario_obs_fleet, scenario_ledger,
+                         scenario_gen_disagg):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a

@@ -125,6 +125,40 @@ def tenant_rollup(docs: list[dict]) -> dict[str, dict[str, float]]:
     return out
 
 
+def kv_rollup(docs: list[dict]) -> dict | None:
+    """Merge engine ``kv`` gauge blocks (the tiered KV store's counters,
+    ``FLAGS_gen_kv_store``) into the fleet scoreboard: hit rate over all
+    lookups (spill_hits is a SUBSET of hits), fetch/put bytes, demotions
+    vs drops, recompute debt. None when no engine runs a store."""
+    docs = [d for d in docs if isinstance(d, dict)]
+    if not docs:
+        return None
+    counters: dict[str, float] = {}
+    roles: dict[str, int] = {}
+    for d in docs:
+        role = d.get("role")
+        if isinstance(role, str):
+            roles[role] = roles.get(role, 0) + 1
+        for k, v in d.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                counters[k] = counters.get(k, 0.0) + float(v)
+    hits = counters.get("hits", 0.0)
+    lookups = hits + counters.get("misses", 0.0)
+    return {
+        "engines": len(docs), "roles": roles,
+        "hit_rate": hits / lookups if lookups > 0 else 0.0,
+        "lookups": lookups,
+        "spill_hits": counters.get("spill_hits", 0.0),
+        "fetch_bytes": counters.get("fetch_bytes", 0.0),
+        "put_bytes": counters.get("put_bytes", 0.0),
+        "published": counters.get("published", 0.0),
+        "fetched_pages": counters.get("fetched_pages", 0.0),
+        "demotions": counters.get("demotions", 0.0),
+        "dropped": counters.get("dropped", 0.0),
+        "prefill_recomputed": counters.get("prefill_recomputed", 0.0),
+    }
+
+
 def scrape(endpoint: str, *, limit: int | None,
            timeout: float) -> dict:
     """One endpoint → {endpoint, health, ledger}; raises on wire
@@ -148,6 +182,7 @@ def build_report(scrapes: list[dict], *,
     goodputs: list[dict] = []
     records: list[dict] = []
     tenant_docs: list[dict] = []
+    kv_docs: list[dict] = []
     hists: dict[str, list[dict]] = {}
     per_endpoint = []
     for s in scrapes:
@@ -159,6 +194,9 @@ def build_report(scrapes: list[dict], *,
             tenant_docs.append(d.get("tenants"))
         if dump.get("infer_tenants"):
             tenant_docs.append(dump["infer_tenants"])
+        for g in (s["health"].get("generators") or {}).values():
+            if isinstance(g, dict) and isinstance(g.get("kv"), dict):
+                kv_docs.append(g["kv"])
         for name in PHASE_HISTOGRAMS:
             h = (s["health"].get("histograms") or {}).get(name)
             if h and h.get("buckets"):
@@ -181,6 +219,7 @@ def build_report(scrapes: list[dict], *,
             for name, docs in sorted(hists.items())
             for h in (merge_histograms(docs),)},
         "tenants": tenant_rollup(tenant_docs),
+        "kv": kv_rollup(kv_docs),
     }
 
 
@@ -222,6 +261,22 @@ def render(report: dict) -> str:
             lines.append(f"{name:<24} {h['count']:>7} "
                          f"{h['p50'] * 1e3:>8.2f}ms {h['p95'] * 1e3:>8.2f}ms "
                          f"{h['p99'] * 1e3:>8.2f}ms")
+    kv = report.get("kv")
+    if kv:
+        lines.append("")
+        roles = " ".join(f"{r}={n}" for r, n in
+                         sorted(kv["roles"].items())) or "-"
+        lines.append(f"kv store: {kv['engines']} engine(s)  "
+                     f"roles {roles}")
+        lines.append(f"  fleet hit rate {kv['hit_rate'] * 100:6.2f}%  "
+                     f"({int(kv['lookups'])} lookups, "
+                     f"{int(kv['spill_hits'])} from spill)")
+        lines.append(f"  fetched {int(kv['fetched_pages'])} page(s) / "
+                     f"{int(kv['fetch_bytes'])} B   published "
+                     f"{int(kv['published'])} / {int(kv['put_bytes'])} B")
+        lines.append(f"  demotions {int(kv['demotions'])}  dropped "
+                     f"{int(kv['dropped'])}  prefill recomputed "
+                     f"{int(kv['prefill_recomputed'])} tok")
     tens = report.get("tenants")
     if tens:
         lines.append("")
