@@ -350,6 +350,24 @@ define_flag("gen_role", "both",
             "replicas probe/fetch at admission and admit straight "
             "into decode, 'both' (default) does both. Inert unless "
             "gen_kv_store is on; read only at engine construction")
+define_flag("gen_device_pt", False,
+            "Keep the paged engine's per-slot page table resident on "
+            "device, updated incrementally with dirty-row .at[slot]"
+            ".set writes on admit/alloc/retire, so paged_step/"
+            "paged_spec_step/chunked-prefill stop re-uploading the "
+            "whole table host->device every iteration. Byte-identical "
+            "to the host-table path; sharded engines replicate the "
+            "table across the mesh. Inert unless gen_paged; read only "
+            "at engine construction")
+define_flag("gen_async_depth", 0,
+            "Decode-loop dispatch lookahead: dispatch step i+1 before "
+            "blocking on step i's token readback, doing delivery/"
+            "retirement/ledger bookkeeping against the lagged tokens. "
+            "0 (default) is the fully synchronous loop. Retirement "
+            "lands <=depth steps late, which is safe because post-EOS "
+            "steps write only pad tokens; greedy AND sampled streams "
+            "stay byte-identical to the sync loop. Read only at "
+            "engine construction")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

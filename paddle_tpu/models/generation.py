@@ -33,7 +33,7 @@ import numpy as np
 __all__ = ["generate", "sample_logits", "beam_search", "init_paged_cache",
            "paged_gather", "paged_scatter", "advance_key", "ngram_propose",
            "speculative_generate", "serialize_page", "deserialize_page",
-           "STACKED_KV_SPEC", "POOL_KV_SPEC"]
+           "STACKED_KV_SPEC", "POOL_KV_SPEC", "PAGE_TABLE_SPEC"]
 
 # --- sharded-KV spec map (the serving DeviceLayout contract) ----------
 # Tensor-parallel serving shards the KV cache on the KV-head axis (Pope
@@ -50,6 +50,12 @@ from jax.sharding import PartitionSpec as _P
 
 STACKED_KV_SPEC = _P(None, None, None, "tp")
 POOL_KV_SPEC = _P(None, None, "tp")
+# The page table itself is [slots, max_pages] int32 — tiny, and every
+# shard of a tensor-parallel pool needs the full slot->page indirection
+# to gather its own KV-head slice, so it is replicated across the mesh
+# (the device-resident-page-table path keeps it living there between
+# steps instead of re-uploading it each iteration).
+PAGE_TABLE_SPEC = _P()
 
 
 def advance_key(key, steps):

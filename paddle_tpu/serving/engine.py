@@ -222,7 +222,7 @@ class Generation:
                  "prefill_pos", "prefill_t0", "delivered", "fingerprint",
                  "rng_skip", "spec_proposed", "spec_accepted", "trace_id",
                  "tenant", "admitted_ts", "first_tok_ts", "done_ts",
-                 "chip_s", "ledgered")
+                 "chip_s", "ledgered", "dev_ops")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -277,6 +277,11 @@ class Generation:
         self.done_ts = 0.0
         self.chip_s = 0.0
         self.ledgered = False
+        # lazily built device-side per-request operands (starting PRNG
+        # key with rng_skip applied, temperature/top_k/top_p scalars) —
+        # immutable for the generation's lifetime, so chunked prefill
+        # stops re-materializing them every chunk
+        self.dev_ops: tuple | None = None
 
 
 class _PagePool:
@@ -522,7 +527,9 @@ class GenerationEngine:
                  draft_model=None, spec_ngram: int | None = None,
                  spec_shed_occupancy: float | None = None,
                  mesh_tp: int | None = None, ledger=None,
-                 kv_store=None, role: str | None = None):
+                 kv_store=None, role: str | None = None,
+                 device_pt: bool | None = None,
+                 async_depth: int | None = None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -550,6 +557,15 @@ class GenerationEngine:
         self._model = model
         self._cache_dtype = cache_dtype
         self._paged = bool(flag("gen_paged") if paged is None else paged)
+        # decode hot-loop knobs (hard-off by default; flags read HERE
+        # only, never per token): a device-resident page table (paged
+        # engines only — inert otherwise) and the async dispatch
+        # lookahead depth (0 = the fully synchronous loop)
+        self._device_pt = self._paged and bool(
+            flag("gen_device_pt") if device_pt is None else device_pt)
+        self._async_depth = max(0, int(flag("gen_async_depth")
+                                       if async_depth is None
+                                       else async_depth))
         self._prefill_chunk = int(flag("gen_prefill_chunk")
                                   if prefill_chunk is None
                                   else prefill_chunk)
@@ -696,6 +712,16 @@ class GenerationEngine:
             self._pool = None
             self._prefix = None
             self._pt = None
+        # gen_device_pt: device-resident mirror of the host table,
+        # updated with dirty-row .at[slot].set writes on admit/retire
+        # (the host array stays the scheduler's source of truth).
+        # Default path instead caches ONE whole-table upload per
+        # schedule change (_sched_pt) so an unchanged table is not
+        # re-shipped every iteration — prefill chunks, plain steps and
+        # the spec step's second upload all share it.
+        self._pt_dev = (self._layout.place_pt(self._pt)
+                        if self._device_pt else None)
+        self._sched_pt = None
         self._state: dict[str, Any] = self._init_state()
         # topology for stats()/health: static for the engine's lifetime
         # (the cache pool never resizes), so computed once here
@@ -716,6 +742,10 @@ class GenerationEngine:
 
         self._cond = threading.Condition()
         self._queue: deque[Generation] = deque()
+        # gen_async_depth lookahead books: dispatched decode steps whose
+        # token readback is deferred — entries are (stepped snapshot,
+        # device tokens, epoch at dispatch, chip share); oldest first
+        self._pending: deque[tuple] = deque()
         self._slot_gen: list[Generation | None] = [None] * self.slots
         self._gens: dict[str, Generation] = {}
         self._stopping = False
@@ -1425,7 +1455,13 @@ class GenerationEngine:
                    # occupancy. A mesh-backed engine is ONE replica;
                    # this block is how its N devices stay visible.
                    "device": dict(self._device_info),
-                   "paged": self._paged}
+                   "paged": self._paged,
+                   # decode hot-loop knobs (gen_device_pt /
+                   # gen_async_depth) + current lookahead occupancy, so
+                   # bench/chaos harnesses can assert which loop ran
+                   "device_pt": self._device_pt,
+                   "async_depth": self._async_depth,
+                   "pending_steps": len(self._pending)}
             if self._spec_k > 0:
                 prop = self._spec_proposed
                 doc["spec"] = {
@@ -1551,8 +1587,10 @@ class GenerationEngine:
                 gen.pages = []
             self._slot_gen = [None] * self.slots
             self._queue.clear()
+            self._pending.clear()
             if self._paged:
                 self._pt[:] = 0
+                self._pt_sync_full_locked()
             self._cond.notify_all()
         if self._kv is not None and self._kv_owned:
             self._kv.close()   # shared stores outlive their engines
@@ -1657,6 +1695,39 @@ class GenerationEngine:
             while len(self._crash_counts) > 1024:   # bounded books
                 self._crash_counts.pop(next(iter(self._crash_counts)))
 
+    # -- page-table device residency (gen_device_pt) -----------------------
+    def _pt_sync_row_locked(self, slot: int) -> None:
+        """Host table row ``slot`` changed (admit/retire): mirror ONLY
+        that row to the device-resident table and drop the default
+        path's cached whole-table upload. Caller holds the lock. The
+        functional ``.at`` update leaves any snapshot an in-flight
+        dispatch captured untouched."""
+        if self._pt_dev is not None:
+            self._pt_dev = self._pt_dev.at[slot].set(self._pt[slot])
+        self._sched_pt = None
+
+    def _pt_sync_full_locked(self) -> None:
+        """The whole host table changed (reset/rebuild/break): rebuild
+        the device-resident table wholesale and drop the cached
+        upload. Caller holds the lock."""
+        if self._pt_dev is not None:
+            self._pt_dev = self._layout.place_pt(self._pt)
+        self._sched_pt = None
+
+    def _pt_device_locked(self, jnp):
+        """The page-table operand for a compiled call. gen_device_pt:
+        the incrementally maintained device-resident table.
+        Default path: ONE whole-table upload cached until admit/retire
+        dirties it — the fix for re-shipping an unchanged table every
+        iteration (prefill chunks and the spec path's second upload
+        included). Caller holds the lock; the returned array is a
+        snapshot (functional updates never mutate it in place)."""
+        if self._pt_dev is not None:
+            return self._pt_dev
+        if self._sched_pt is None:
+            self._sched_pt = jnp.asarray(self._pt)
+        return self._sched_pt
+
     def _fail_active_locked(self, msg: str) -> list[Generation]:
         """Fail every slotted generation loudly (queued generations
         never touched the device — they stay queued and survive the
@@ -1677,8 +1748,10 @@ class GenerationEngine:
         self._slot_gen = [None] * self.slots
         if self._paged:
             self._pt[:] = 0
-        self._epoch += 1              # in-flight compiled results are
-        stat_set("gen/slots_active", 0)   # garbage from here on
+            self._pt_sync_full_locked()
+        self._pending.clear()         # deferred readbacks die with the
+        self._epoch += 1              # epoch: in-flight compiled results
+        stat_set("gen/slots_active", 0)   # are garbage from here on
         return victims
 
     def _rebuild(self, e: Exception) -> None:
@@ -1747,9 +1820,11 @@ class GenerationEngine:
             self._queue.clear()
             if self._paged:           # nothing runs on a broken engine;
                 self._pt[:] = 0       # reset the books for stats() sanity
+                self._pt_sync_full_locked()
                 self._pool = _PagePool(self._pool.num_pages)
                 if self._prefix is not None:
                     self._prefix = _PrefixCache(self._page_tokens)
+            self._pending.clear()
             self._cond.notify_all()
 
     def _release_slot_locked(self, gen: Generation,
@@ -1758,6 +1833,7 @@ class GenerationEngine:
             self._slot_gen[gen.slot] = None
             if self._paged:
                 self._pt[gen.slot] = 0
+                self._pt_sync_row_locked(gen.slot)
             if evicted:
                 stat_add("gen/evictions")
         if self._paged and gen.pages:
@@ -1909,6 +1985,7 @@ class GenerationEngine:
                 gen.prefill_t0 = time.perf_counter()
                 self._pt[slot] = 0
                 self._pt[slot, :len(gen.pages)] = gen.pages
+                self._pt_sync_row_locked(slot)
                 if matched:
                     stat_add("gen/prefix_hits")
                     stat_add("gen/prefix_tokens_saved", len(matched) * P)
@@ -2025,6 +2102,23 @@ class GenerationEngine:
             stat_add("gen/kv_miss")
         return fetched
 
+    def _gen_dev_ops(self, gen: Generation, jax, jnp) -> tuple:
+        """Per-request device operands (starting PRNG key with
+        ``rng_skip`` applied, temperature/top_k/top_p scalars), built
+        once and cached on the generation — they never change for its
+        lifetime, so chunked prefill stops re-materializing four host
+        arrays per chunk."""
+        if gen.dev_ops is None:
+            key = jax.random.PRNGKey(gen.seed)
+            if gen.rng_skip:
+                from paddle_tpu.models.generation import advance_key
+                key = advance_key(key, gen.rng_skip)
+            gen.dev_ops = (key,
+                           jnp.asarray(gen.temperature, jnp.float32),
+                           jnp.asarray(gen.top_k, jnp.int32),
+                           jnp.asarray(gen.top_p, jnp.float32))
+        return gen.dev_ops
+
     def _prefill_tick(self) -> bool:
         """Advance every prefilling slot by ONE chunk (then the loop
         runs a decode step — chunked prefill interleaves with decode
@@ -2037,7 +2131,7 @@ class GenerationEngine:
         with self._cond:
             work = [(s, g) for s, g in enumerate(self._slot_gen)
                     if g is not None and g.prefilling]
-            pt = None if not work else self._pt.copy()
+            pt_dev = None if not work else self._pt_device_locked(jnp)
             epoch0 = self._epoch
         ticked = False
         for slot, gen in work:
@@ -2053,23 +2147,18 @@ class GenerationEngine:
             bucket = min(self._bucket(b - a), smax - a)
             padded = np.full((bucket,), self._pad, np.int32)
             padded[:b - a] = gen.prompt[a:b]
-            key = jax.random.PRNGKey(gen.seed)
-            if gen.rng_skip:
-                from paddle_tpu.models.generation import advance_key
-                key = advance_key(key, gen.rng_skip)
+            key, temp, top_k, top_p = self._gen_dev_ops(gen, jax, jnp)
             t0 = time.perf_counter()
             try:
                 with self._gen_span(gen, "gen/prefill_chunk", slot=slot,
                                     index=a, tokens=b - a, final=final):
                     _fault.inject("engine.prefill")
                     self._state, tok0 = self._prefill_fn(
-                        self._state, jnp.asarray(pt),
+                        self._state, pt_dev,
                         jnp.asarray(slot, jnp.int32), jnp.asarray(padded),
                         jnp.asarray(a, jnp.int32),
                         jnp.asarray(b - a, jnp.int32), key,
-                        jnp.asarray(gen.temperature, jnp.float32),
-                        jnp.asarray(gen.top_k, jnp.int32),
-                        jnp.asarray(gen.top_p, jnp.float32))
+                        temp, top_k, top_p)
                     tok0 = int(tok0) if final else None
             except Exception as e:       # a prefill trap implicates
                 self._note_trap([gen], e)     # exactly this request
@@ -2129,10 +2218,7 @@ class GenerationEngine:
         bucket = self._bucket(T0)
         padded = np.full((bucket,), self._pad, np.int32)
         padded[:T0] = gen.prompt
-        key = jax.random.PRNGKey(gen.seed)
-        if gen.rng_skip:
-            from paddle_tpu.models.generation import advance_key
-            key = advance_key(key, gen.rng_skip)
+        key, temp, top_k, top_p = self._gen_dev_ops(gen, jax, jnp)
         epoch0 = self._epoch
         t0 = time.perf_counter()
         try:
@@ -2142,9 +2228,7 @@ class GenerationEngine:
                 self._state, tok0 = self._prefill_fn(
                     self._state, jnp.asarray(slot, jnp.int32),
                     jnp.asarray(padded), jnp.asarray(T0, jnp.int32), key,
-                    jnp.asarray(gen.temperature, jnp.float32),
-                    jnp.asarray(gen.top_k, jnp.int32),
-                    jnp.asarray(gen.top_p, jnp.float32))
+                    temp, top_k, top_p)
                 tok0 = int(tok0)
         except Exception as e:           # a prefill trap implicates
             self._note_trap([gen], e)         # exactly this request
@@ -2180,15 +2264,21 @@ class GenerationEngine:
             self._cond.notify_all()
 
     def _decode_step(self, jnp) -> bool:
+        if self._pending and self._spec_k > 0:
+            # speculative drafting (and the occupancy-shed decision)
+            # reads host-side context — flush the dispatch lookahead
+            # first so drafts see up-to-date tokens and slots
+            self._drain_pending()
         with self._cond:
             stepped = [(s, g) for s, g in enumerate(self._slot_gen)
                        if g is not None and not g.prefilling]
-            if not stepped:
+            if not stepped and not self._pending:
                 return False
             active = np.zeros((self.slots,), bool)
             for s, _ in stepped:
                 active[s] = True
-            pt = None if not self._paged else self._pt.copy()
+            pt_dev = (self._pt_device_locked(jnp)
+                      if self._paged and stepped else None)
             epoch0 = self._epoch
             specable: list[tuple[int, np.ndarray, int]] = []
             if self._spec_k > 0:
@@ -2207,6 +2297,11 @@ class GenerationEngine:
                          min(self._spec_k,
                              g.max_new_tokens - len(g.tokens) - 1))
                         for s, g in stepped]
+        if not stepped:
+            # nothing new to dispatch: drain the lagged in-flight steps
+            # so their retirements land and pages free up
+            self._drain_pending()
+            return True
         use_spec = False
         if specable:
             # drafting happens OUTSIDE the lock (ngram is host-side
@@ -2223,6 +2318,7 @@ class GenerationEngine:
             # no slot produced a draft -> the plain step is strictly
             # cheaper (width 1 vs K+1) and byte-identical
             use_spec = bool(dlens.any())
+        lookahead = self._async_depth > 0 and not use_spec
         t0 = time.perf_counter()
         try:
             with _trace.span("gen/decode_step", active=len(stepped),
@@ -2231,22 +2327,18 @@ class GenerationEngine:
                 if use_spec:
                     with _trace.span("gen/spec_verify",
                                      drafted=int(dlens.sum())):
-                        args = ((jnp.asarray(pt),) if self._paged
-                                else ())
+                        args = (pt_dev,) if self._paged else ()
                         self._state, out, emit = self._spec_step(
                             self._state, *args, jnp.asarray(active),
                             jnp.asarray(drafts), jnp.asarray(dlens))
                         out = np.asarray(out)
                         emit = np.asarray(emit)
-                elif self._paged:
-                    self._state, toks = self._step(self._state,
-                                                   jnp.asarray(pt),
-                                                   jnp.asarray(active))
-                    toks = np.asarray(toks)
                 else:
-                    self._state, toks = self._step(self._state,
-                                                   jnp.asarray(active))
-                    toks = np.asarray(toks)
+                    args = (pt_dev,) if self._paged else ()
+                    self._state, toks = self._step(
+                        self._state, *args, jnp.asarray(active))
+                    if not lookahead:
+                        toks = np.asarray(toks)
         except Exception as e:
             # the fused step shares one compiled call: every stepped
             # generation is implicated (co-tenant counts — see
@@ -2269,6 +2361,24 @@ class GenerationEngine:
         chip_share = (dt / len(stepped)
                       if self._ledger is not None else 0.0)
         self._last_beat = time.monotonic()
+        if lookahead:
+            # defer the blocking token readback (gen_async_depth): the
+            # autoregressive chain feeds itself on device, so the next
+            # loop iteration dispatches step i+1 before step i's tokens
+            # come back; delivery/retirement bookkeeping runs against
+            # the lagged tokens when the entry drains — <= depth steps
+            # late, safe because post-EOS steps write only pads.
+            # _consec_traps is NOT reset here: only the readback in
+            # _finish_step proves the device work actually ran.
+            self._pending.append((stepped, toks, epoch0, chip_share))
+            while len(self._pending) > self._async_depth:
+                self._drain_pending(1)
+            if self.step_wait_s > 0:
+                time.sleep(self.step_wait_s)
+                if self._goodput is not None:
+                    self._goodput.note("admission_idle",
+                                       self.step_wait_s)
+            return True
         self._consec_traps = 0           # real device work succeeded
         if self._epoch != epoch0:
             raise _EpochChanged("decode step outlived the watchdog "
@@ -2338,3 +2448,75 @@ class GenerationEngine:
                 # deliberate pacing gap: idle by configuration, not work
                 self._goodput.note("admission_idle", self.step_wait_s)
         return True
+
+    # -- async dispatch lookahead (gen_async_depth) ------------------------
+    def _drain_pending(self, n: int | None = None) -> None:
+        """Retire deferred readbacks, oldest first: block on each
+        entry's device tokens and run the delivery/retirement
+        bookkeeping the sync loop does inline. ``n`` bounds how many
+        entries drain (None = all). Loop thread only; the reset paths
+        may clear the deque concurrently, hence the guarded pop."""
+        while self._pending and (n is None or n > 0):
+            try:
+                entry = self._pending.popleft()
+            except IndexError:       # cleared under our feet (reset)
+                return
+            self._finish_step(*entry)
+            if n is not None:
+                n -= 1
+
+    def _finish_step(self, stepped, toks_dev, epoch0,
+                     chip_share) -> None:
+        """Second half of a lookahead decode step: the now-explicit
+        blocking readback — measured and booked as ``host_gather``
+        instead of swept in by ``tick`` — followed by the same
+        bookkeeping as the sync path. Deferred device errors surface
+        HERE (np.asarray is where XLA delivers them) and implicate the
+        entry's generations exactly like a sync trap. A slot retired
+        or reassigned by an earlier entry is skipped by the identity
+        guard, so lagged post-EOS tokens are never delivered."""
+        t0 = time.perf_counter()
+        try:
+            toks = np.asarray(toks_dev)
+        except Exception as e:
+            self._note_trap([g for _, g in stepped], e)
+            raise
+        if self._goodput is not None:
+            self._goodput.note("host_gather", time.perf_counter() - t0)
+        self._last_beat = time.monotonic()
+        self._consec_traps = 0           # real device work succeeded
+        if self._epoch != epoch0:
+            # the watchdog failed this entry's generations while it was
+            # in flight — its tokens are garbage; the loop's stuck
+            # latch forces the rebuild/break decision
+            return
+        sample_n = (int(flag("trace_sample"))
+                    if _trace._ACTIVE is not None else 0)
+        with self._cond:
+            emitted = 0
+            for s, gen in stepped:
+                if self._slot_gen[s] is not gen:   # retired/cancelled
+                    continue                       # by an earlier entry
+                if self._ledger is not None:
+                    gen.chip_s += chip_share
+                tok = int(toks[s])
+                gen.tokens.append(tok)
+                emitted += 1
+                if sample_n > 0 and len(gen.tokens) % sample_n == 0:
+                    self._gen_event(gen, "gen/decode_sample", slot=s,
+                                    token_index=len(gen.tokens))
+                if ((gen.eos_token_id is not None
+                     and tok == gen.eos_token_id)
+                        or len(gen.tokens) >= gen.max_new_tokens):
+                    gen.done = True
+                    if self._ledger is not None:
+                        gen.done_ts = time.monotonic()
+                    self._gen_event(gen, "gen/retire",
+                                    reason="complete",
+                                    tokens=len(gen.tokens))
+                    self._release_slot_locked(gen)
+            self._emit_total += emitted
+            self._decode_iters += 1
+            if emitted:
+                stat_add("gen/tokens", emitted)
+            self._cond.notify_all()

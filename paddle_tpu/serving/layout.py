@@ -92,6 +92,22 @@ class DeviceLayout:
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P())
 
+    def place_pt(self, table):
+        """Commit a device-resident page table to the layout: every
+        mesh shard needs the full slot->page indirection to gather its
+        own KV-head slice, so the table replicates
+        (``PAGE_TABLE_SPEC``). Identity layout: ``jax.device_put`` with
+        no sharding — a plain committed device array whose ``.at``
+        dirty-row updates stay on device between steps."""
+        import jax
+        if self.mesh is None:
+            return jax.device_put(table)
+        from jax.sharding import NamedSharding
+
+        from paddle_tpu.models.generation import PAGE_TABLE_SPEC
+        return jax.device_put(
+            table, NamedSharding(self.mesh, PAGE_TABLE_SPEC))
+
     def _kv_sharding(self, paged: bool):
         from jax.sharding import NamedSharding
 

@@ -72,6 +72,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--gen-paged", action="store_true",
                     help="paged KV cache for the --gen engine")
     ap.add_argument("--gen-page-tokens", type=int, default=8)
+    ap.add_argument("--gen-device-pt", action="store_true",
+                    help="device-resident page table for the --gen "
+                         "engine (FLAGS_gen_device_pt per replica); "
+                         "inert unless --gen-paged")
+    ap.add_argument("--gen-async-depth", type=int, default=0,
+                    help="async double-buffered decode dispatch depth "
+                         "for the --gen engine (FLAGS_gen_async_depth "
+                         "per replica; 0 = synchronous loop, the "
+                         "default). Token streams stay byte-identical")
     ap.add_argument("--gen-spec-k", type=int, default=0,
                     help="speculative decoding lookahead for the --gen "
                          "engine (0 = off, the default)")
@@ -156,6 +165,8 @@ def main(argv: list[str] | None = None) -> int:
                           step_wait_s=args.gen_step_wait_s,
                           paged=args.gen_paged,
                           page_tokens=args.gen_page_tokens,
+                          device_pt=args.gen_device_pt,
+                          async_depth=args.gen_async_depth,
                           spec_k=args.gen_spec_k,
                           spec_mode=args.gen_spec_mode,
                           draft_model=draft,

@@ -1,0 +1,278 @@
+"""Page-table-aware Pallas decode kernel (ops/pallas/paged_decode_attention.py).
+
+OpTest discipline, same contract as ``test_decode_attention.py`` but
+with the page indirection inside the index maps: in interpret mode the
+kernel must reproduce ``models.generation.paged_gather`` + masked
+attention bit-for-bit per slot, honor the physical page permutation
+(same logical sequence, different page placement → identical output),
+bound reads to the filled prefix, fold int8 pool scales exactly, and
+survive ``jax.vmap`` over slots. This is the hardware-independent
+result; the TPU timing run is the stated caveat in the module doc.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.generation import paged_gather
+from paddle_tpu.ops.pallas import _support
+from paddle_tpu.ops.pallas import paged_decode_attention as pdk
+
+
+def _mk(B=2, Hq=4, Hkv=2, P=8, M=4, D=64, L=2, N=16, quant=False,
+        dtype=jnp.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, 1, Hq, D), dtype)
+    kn = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
+    vn = jnp.asarray(rs.randn(B, Hkv, 1, D), dtype)
+    if quant:
+        pool = (
+            jnp.asarray(rs.randint(-127, 128, (N + 1, L, Hkv, P, D)),
+                        jnp.int8),
+            jnp.asarray(rs.randint(-127, 128, (N + 1, L, Hkv, P, D)),
+                        jnp.int8),
+            jnp.asarray(rs.rand(N + 1, L, Hkv, P) * 0.05 + 0.001,
+                        jnp.float32),
+            jnp.asarray(rs.rand(N + 1, L, Hkv, P) * 0.05 + 0.001,
+                        jnp.float32),
+        )
+    else:
+        pool = (jnp.asarray(rs.randn(N + 1, L, Hkv, P, D), dtype),
+                jnp.asarray(rs.randn(N + 1, L, Hkv, P, D), dtype))
+    # distinct live pages per slot, never the null page 0
+    ids = rs.permutation(np.arange(1, N + 1))[: B * M]
+    table = jnp.asarray(ids.reshape(B, M).astype(np.int32))
+    return q, kn, vn, pool, table
+
+
+def _via_paged_gather(q, kn, vn, pool, table, layer, idx, scale):
+    """Independent reference built on the REAL ``paged_gather`` (the
+    copy the kernel deletes): per slot, materialize the view, one-layer
+    masked attention in the fallback's dtype discipline."""
+    B, _, Hq, D = q.shape
+    Hkv = kn.shape[1]
+    G = Hq // Hkv
+    M = table.shape[1]
+    P = pool[0].shape[3]
+    idx = np.broadcast_to(np.asarray(idx), (B,))
+    outs = []
+    for b in range(B):
+        view = paged_gather(pool, table[b])       # [L, 1, Hkv, M*P, ...]
+        if len(pool) == 4:
+            k_c = (view[0][layer, 0].astype(q.dtype)
+                   * view[2][layer, 0][..., None])
+            v_c = (view[1][layer, 0].astype(q.dtype)
+                   * view[3][layer, 0][..., None])
+        else:
+            k_c, v_c = view[0][layer, 0], view[1][layer, 0]
+        qh = q[b, 0].reshape(Hkv, G, D)
+        s_c = jnp.einsum("hgd,hsd->hgs", qh, k_c) * scale
+        mask = jnp.arange(M * P) < idx[b]
+        s_c = jnp.where(mask[None, None, :], s_c, pdk.NEG_INF)
+        s_n = jnp.sum(qh * kn[b], axis=-1, keepdims=True) * scale
+        s_all = jnp.concatenate([s_c, s_n], -1).astype(jnp.float32)
+        p = jax.nn.softmax(s_all, -1).astype(q.dtype)
+        o = (jnp.einsum("hgs,hsd->hgd", p[..., :-1], v_c)
+             + p[..., -1:] * vn[b])
+        outs.append(o.reshape(Hq, D))
+    return jnp.stack(outs).reshape(B, 1, Hq, D)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("idx", [1, 7, 17, 32])
+def test_kernel_matches_paged_gather(quant, idx):
+    q, kn, vn, pool, table = _mk(quant=quant)
+    want = _via_paged_gather(q, kn, vn, pool, table, 1, idx, 0.125)
+    with _support.force_dispatch():
+        assert pdk.supported(q, pool, table)
+        got = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                         jnp.int32(1), jnp.int32(idx),
+                                         scale=0.125)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # the fallback arm is the same math
+    ref = pdk.paged_reference(q, kn, vn, pool, table, 1, jnp.int32(idx),
+                              scale=0.125)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_kernel_selects_layer(quant):
+    """sp_ref[b, 0] must pick layer l's plane out of the pool stack."""
+    q, kn, vn, pool, table = _mk(L=3, quant=quant, seed=7)
+    for l in range(3):
+        with _support.force_dispatch():
+            got = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                             jnp.int32(l), jnp.int32(20),
+                                             scale=0.125)
+        want = _via_paged_gather(q, kn, vn, pool, table, l, 20, 0.125)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"l={l}")
+
+
+def test_page_indirection_is_honored():
+    """The same logical sequence under two different physical page
+    placements must produce identical output — the proof that the index
+    map reads the table rather than assuming contiguity."""
+    q, kn, vn, pool, table = _mk(B=1, seed=3)
+    perm = np.array([3, 1, 0, 2])                 # logical -> new slot order
+    kp, vp = np.asarray(pool[0]).copy(), np.asarray(pool[1]).copy()
+    old = np.asarray(table[0])
+    new_ids = old[perm]                           # reuse the same pages...
+    kp2, vp2 = kp.copy(), vp.copy()
+    for lg in range(len(perm)):                   # ...but relocate content
+        kp2[new_ids[lg]] = kp[old[lg]]
+        vp2[new_ids[lg]] = vp[old[lg]]
+    table2 = jnp.asarray(new_ids[None].astype(np.int32))
+    with _support.force_dispatch():
+        a = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                       jnp.int32(0), jnp.int32(25),
+                                       scale=0.125)
+        b = pdk.paged_decode_attention(
+            q, kn, vn, (jnp.asarray(kp2), jnp.asarray(vp2)), table2,
+            jnp.int32(0), jnp.int32(25), scale=0.125)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_ignores_stale_and_unmapped():
+    """Positions >= index and the null page must not contribute:
+    poisoning them with huge values changes nothing."""
+    q, kn, vn, pool, table = _mk(seed=1)
+    idx = 19                                       # mid page 3 of 4
+    kp, vp = np.asarray(pool[0]).copy(), np.asarray(pool[1]).copy()
+    P = kp.shape[3]
+    for b in range(table.shape[0]):
+        row = np.asarray(table[b])
+        kp[row[idx // P], :, :, idx % P:] = 1e4    # stale tail of the page
+        vp[row[idx // P], :, :, idx % P:] = -1e4
+        kp[row[idx // P + 1:]] = 1e4               # wholly unfilled pages
+        vp[row[idx // P + 1:]] = -1e4
+    kp[0], vp[0] = 1e4, -1e4                       # the null page
+    poisoned = (jnp.asarray(kp), jnp.asarray(vp))
+    with _support.force_dispatch():
+        a = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                       jnp.int32(0), jnp.int32(idx),
+                                       scale=0.125)
+        b = pdk.paged_decode_attention(q, kn, vn, poisoned, table,
+                                       jnp.int32(0), jnp.int32(idx),
+                                       scale=0.125)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_per_slot_index_vector():
+    """index may be [B] — each slot masks at its own fill position."""
+    q, kn, vn, pool, table = _mk(seed=4)
+    idxv = jnp.asarray([3, 30], jnp.int32)
+    with _support.force_dispatch():
+        got = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                         jnp.int32(0), idxv, scale=0.125)
+    want = _via_paged_gather(q, kn, vn, pool, table, 0,
+                             np.asarray(idxv), 0.125)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_group_mapping():
+    """Hq=8, Hkv=2 (G=4): each q head reads ITS kv head's pages — the
+    block-diagonal mask at page granularity."""
+    q, kn, vn, pool, table = _mk(Hq=8, Hkv=2, seed=5)
+    with _support.force_dispatch():
+        got = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                         jnp.int32(1), jnp.int32(28),
+                                         scale=0.125)
+    want = _via_paged_gather(q, kn, vn, pool, table, 1, 28, 0.125)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "quant", [False, pytest.param(True, marks=pytest.mark.slow)])
+def test_kernel_under_vmap_matches_per_slot(quant):
+    """The engine's fused decode vmaps over the slot axis, so the
+    kernel must survive jax's pallas batching rule: vmapped calls equal
+    the per-slot calls exactly (pool closed over, tables/indices
+    mapped)."""
+    SLOTS = 3
+    _, _, _, pool, _ = _mk(B=1, quant=quant, seed=20)
+    qs, kns, vns, tabs = [], [], [], []
+    idxs = [2, 15, 31]
+    for s in range(SLOTS):
+        q, kn, vn, _, table = _mk(B=1, quant=quant, seed=30 + s)
+        qs.append(q), kns.append(kn), vns.append(vn), tabs.append(table)
+    qv, knv, vnv = jnp.stack(qs), jnp.stack(kns), jnp.stack(vns)
+    tabv = jnp.stack(tabs)
+    idxv = jnp.asarray(idxs, jnp.int32)
+
+    def one(q, kn, vn, tab, i):
+        assert pdk.supported(q, pool, tab)     # gate holds under tracer
+        return pdk.paged_decode_attention(q, kn, vn, pool, tab,
+                                          jnp.int32(1), i, scale=0.125)
+
+    with _support.force_dispatch():
+        got = jax.jit(jax.vmap(one, in_axes=(0, 0, 0, 0, 0)))(
+            qv, knv, vnv, tabv, idxv)
+        want = jnp.stack([
+            pdk.paged_decode_attention(qs[s], kns[s], vns[s], pool,
+                                       tabs[s], jnp.int32(1),
+                                       jnp.int32(idxs[s]), scale=0.125)
+            for s in range(SLOTS)])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for s in range(SLOTS):
+        np.testing.assert_allclose(
+            np.asarray(got[s]),
+            np.asarray(_via_paged_gather(qs[s], kns[s], vns[s], pool,
+                                         tabs[s], 1, idxs[s], 0.125)),
+            rtol=2e-5, atol=2e-5, err_msg=f"slot {s}")
+
+
+def test_supported_gates():
+    q, _, _, pool, table = _mk()
+    with _support.force_dispatch():
+        assert pdk.supported(q, pool, table)
+        # prefill chunk (T > 1) is not this kernel's job
+        assert not pdk.supported(jnp.zeros((2, 4, 4, 64)), pool, table)
+        # head_dim off the MXU grid
+        assert not pdk.supported(
+            jnp.zeros((2, 1, 4, 32)),
+            (jnp.zeros((17, 2, 2, 8, 32)),) * 2, table)
+        # page size not sublane-aligned
+        assert not pdk.supported(
+            jnp.zeros((2, 1, 4, 64)),
+            (jnp.zeros((17, 2, 2, 6, 64)),) * 2, table)
+        # table batch mismatch
+        assert not pdk.supported(q, pool, table[:1])
+        # int8 leaves without the 4-leaf scale layout
+        assert not pdk.supported(
+            q, (jnp.zeros((17, 2, 2, 8, 64), jnp.int8),) * 2, table)
+    # no dispatch context off-TPU → fallback
+    if not _support.on_tpu():
+        assert not pdk.supported(q, pool, table)
+
+
+def test_fallback_arm_dispatch(monkeypatch):
+    """Off-TPU with no force_dispatch the public entry must take the
+    einsum fallback (raw_call untouched); under force_dispatch it must
+    route through the pallas_call."""
+    q, kn, vn, pool, table = _mk(seed=6)
+    calls = {}
+    orig = pdk.raw_call
+
+    def spy(*a, **kw):
+        calls["n"] = calls.get("n", 0) + 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pdk, "raw_call", spy)
+    out_f = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                       jnp.int32(0), jnp.int32(10),
+                                       scale=0.125)
+    if not _support.on_tpu():
+        assert calls.get("n", 0) == 0          # fallback arm
+    with _support.force_dispatch():
+        out_k = pdk.paged_decode_attention(q, kn, vn, pool, table,
+                                           jnp.int32(0), jnp.int32(10),
+                                           scale=0.125)
+    assert calls.get("n", 0) >= 1              # kernel arm engaged
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_f),
+                               rtol=2e-5, atol=2e-5)

@@ -749,6 +749,121 @@ def bench_disagg(reps: int = 3) -> dict:
     return out
 
 
+def bench_hotloop(model, all_prompts, reps: int = 3) -> dict:
+    """Decode hot-loop overhaul cells: the synchronous host-table loop
+    (defaults) vs async double-buffered dispatch + the device-resident
+    page table (``gen_async_depth=2`` + ``gen_device_pt``), identical
+    paged geometry, conc-1 and conc-8, plus the goodput meter's view of
+    the host readback.
+
+    Byte-identity is FATAL-asserted in both directions first: greedy
+    streams from both engines against solo ``generate()``, and one
+    sampled stream equal across engines — lookahead must never change
+    a token. Each cell then reports best-of tokens/s and the per-cell
+    ``host_gather`` fraction (delta of the cumulative meter).
+
+    CPU-proxy caveat, stated plainly: on this single-core CPU host the
+    XLA compute thread and the engine loop share one core, so dispatch
+    lookahead has nothing to overlap INTO — tokens/s parity (or a
+    slight dispatch-overhead regression) is the expected CPU result,
+    and the explicit ``host_gather`` booking under async makes that
+    bucket read HIGHER here, not lower (the sync loop hides the same
+    wait inside its ``decode`` dt). The speedup and host-fraction-drop
+    acceptance gates therefore arm only on a real accelerator
+    (``platform != cpu``), where the device computes while the host
+    books; the CPU run still proves byte-identity, the accounting
+    invariants, and that the overhauled loop serves at parity."""
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    out: dict = {
+        "slots": SLOTS, "max_new_tokens": MAX_NEW,
+        "prompt_len": PROMPT_LEN, "reps": reps, "async_depth": 2,
+        "note": ("cells are best-of tokens/s on warmed engines; "
+                 "host_gather fractions are per-cell deltas of the "
+                 "cumulative goodput meter. CPU proxy: 1 core means "
+                 "lookahead has nothing to overlap into, and async's "
+                 "explicit host_gather booking inflates that bucket vs "
+                 "the sync loop (which hides the readback wait inside "
+                 "decode) — speedup/host-drop gates arm on accelerators "
+                 "only; byte-identity and accounting gates always arm"),
+    }
+    geom = dict(slots=SLOTS, max_len=MAX_LEN, queue_max=32, paged=True,
+                page_tokens=8, ledger=True)
+    engines = {
+        "sync": GenerationEngine(model, **geom),
+        "async_device_pt": GenerationEngine(model, device_pt=True,
+                                            async_depth=2, **geom),
+    }
+    try:
+        # -- byte identity: FATAL, not a statistic --------------------
+        ref = np.asarray(generate(model, all_prompts[:4],
+                                  MAX_NEW))[:, PROMPT_LEN:]
+        sampled: dict[str, list[int]] = {}
+        for name, eng in engines.items():
+            for i in range(4):
+                toks = _drain_engine(eng, eng.start(all_prompts[i],
+                                                    MAX_NEW))
+                if not np.array_equal(np.asarray(toks, np.int32),
+                                      ref[i]):
+                    print(f"FATAL: {name} engine diverges from solo "
+                          f"generate", file=sys.stderr)
+                    sys.exit(2)
+            sampled[name] = _drain_engine(eng, eng.start(
+                all_prompts[0], MAX_NEW, temperature=0.8, top_k=9,
+                top_p=0.9, seed=17))
+        if sampled["sync"] != sampled["async_device_pt"]:
+            print("FATAL: sampled stream differs between sync and "
+                  "async engines", file=sys.stderr)
+            sys.exit(2)
+        out["byte_identical"] = True
+
+        # -- cells ----------------------------------------------------
+        cells: dict[str, dict] = {}
+        for name, eng in engines.items():
+            bench_engine(eng, list(all_prompts[:8]))     # warm conc-8
+            cell: dict[str, dict] = {}
+            for n in (1, 8):
+                g0 = eng.stats()["goodput"]
+                runs = [bench_engine(eng, list(all_prompts[:n]))
+                        for _ in range(reps)]
+                g1 = eng.stats()["goodput"]
+                tot = g1["total_s"] - g0["total_s"]
+                frac = {b: (g1["buckets"][b] - g0["buckets"][b]) / tot
+                        for b in g1["buckets"]}
+                assert abs(sum(frac.values()) - 1.0) < 1e-6
+                cell[str(n)] = {
+                    "tokens_per_s": round(max(r["tokens_per_s"]
+                                              for r in runs), 1),
+                    "host_gather_fraction": round(frac["host_gather"],
+                                                  4),
+                    "decode_fraction": round(frac["decode"], 4),
+                }
+            st = eng.stats()
+            cell["flags"] = {"device_pt": st["device_pt"],
+                             "async_depth": st["async_depth"]}
+            cells[name] = cell
+    finally:
+        for eng in engines.values():
+            eng.close()
+    out["cells"] = cells
+    sync8 = cells["sync"]["8"]
+    hot8 = cells["async_device_pt"]["8"]
+    out["conc8_speedup"] = round(hot8["tokens_per_s"]
+                                 / sync8["tokens_per_s"], 4)
+    out["conc8_host_gather_drop"] = round(
+        sync8["host_gather_fraction"] - hot8["host_gather_fraction"], 4)
+    gates = {"byte_identical": out["byte_identical"],
+             "fractions_sum_to_one": True}
+    if on_accel:
+        gates["conc8_speedup_gt_1"] = out["conc8_speedup"] > 1.0
+        gates["conc8_host_gather_drops"] = (
+            out["conc8_host_gather_drop"] > 0.0)
+    out["gates"] = gates
+    out["ok"] = all(gates.values())
+    return out
+
+
 def summarize(runs: list[dict]) -> dict:
     ttft = runs[0]["ttft"]    # per-request spread from the first run
     return {
@@ -781,6 +896,13 @@ def main() -> int:
     ap.add_argument("--disagg-only", action="store_true",
                     help="run only the disaggregated-serving fleet "
                          "KV-store scenario and write BENCH_disagg.json")
+    ap.add_argument("--hotloop-out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_hotloop.json"))
+    ap.add_argument("--hotloop-only", action="store_true",
+                    help="run only the decode hot-loop overhaul cells "
+                         "(sync vs async+device-pt) and write "
+                         "BENCH_hotloop.json")
     args = ap.parse_args()
 
     import jax
@@ -810,6 +932,23 @@ def main() -> int:
               f"{gp['overhead']['8']:.2%} (ceiling 3%); "
               f"wrote {args.goodput_out}; ok={ok}")
         return 0 if ok else 1
+
+    if args.hotloop_only:
+        hl = bench_hotloop(model, all_prompts, reps=args.reps)
+        hl["bench"] = "hotloop"
+        hl["platform"] = jax.devices()[0].platform
+        with open(args.hotloop_out, "w") as f:
+            json.dump(hl, f, indent=2)
+            f.write("\n")
+        s8, h8 = hl["cells"]["sync"]["8"], hl["cells"]["async_device_pt"]["8"]
+        print(f"hotloop: conc-8 sync {s8['tokens_per_s']} tok/s "
+              f"(host_gather {s8['host_gather_fraction']:.1%}) vs "
+              f"async+device-pt {h8['tokens_per_s']} tok/s "
+              f"(host_gather {h8['host_gather_fraction']:.1%}); "
+              f"speedup {hl['conc8_speedup']:.3f}, byte-identical "
+              f"{hl['byte_identical']}; wrote {args.hotloop_out}; "
+              f"ok={hl['ok']}")
+        return 0 if hl["ok"] else 1
 
     if args.disagg_only:
         dg = bench_disagg(reps=args.reps)

@@ -201,6 +201,11 @@ def check_defaults_off() -> None:
           not led["gen_ledger"]                   # no ledger, no meter
           and led["gen_ledger_records"] > 0,      # sane when opted in
           str(led))
+    hl = get_flags(["gen_device_pt", "gen_async_depth"])
+    check("defaults/gen_hotloop_off",
+          not hl["gen_device_pt"]                 # host page table
+          and hl["gen_async_depth"] == 0,         # synchronous loop
+          str(hl))
     kvs = get_flags(["gen_kv_store", "gen_role", "gen_kv_store_pages",
                      "gen_kv_spill_dir"])
     check("defaults/gen_kvstore_off",
@@ -1419,6 +1424,96 @@ def scenario_gen_disagg(tmp: str) -> None:
         set_flags(saved)
 
 
+def scenario_gen_hotloop(tmp: str) -> None:
+    """SIGKILL the subprocess replica running the overhauled decode hot
+    loop (``--gen-async-depth 2 --gen-device-pt``) while it holds a
+    live SAMPLED stream: the delivered prefix — which under lookahead
+    lags device progress by up to ``depth`` steps — resumes on a plain
+    SYNCHRONOUS survivor byte-identical to the uninterrupted solo
+    stream, and the survivor drains back to a full page pool. The wire
+    contract (delivered tokens + rng_skip) never sees dispatch depth or
+    page-table residency, which is exactly what this scenario pins."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    monitor.reset_stats("serving/router/")
+    base = ("--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+            "--gen-max-len", "32", "--gen-step-wait-s", "0.05",
+            "--gen-paged", "--gen-page-tokens", "8")
+    # victim runs the full hot-loop overhaul; survivor is the plain
+    # synchronous loop — failover must cross the dispatch-mode boundary
+    hot = SubprocessSpawner(extra_args=base + ("--gen-async-depth", "2",
+                                               "--gen-device-pt"))
+    plain = SubprocessSpawner(extra_args=base)
+    ep_hot = hot.spawn()
+    ep_plain = plain.spawn()
+    router = RoutedClient([ep_hot, ep_plain], probe_interval_s=0)
+    try:
+        rs = np.random.RandomState(53)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        import jax
+        kw = dict(temperature=0.8, top_k=7, top_p=0.9, seed=42)
+        ref = np.asarray(generate(
+            model, prompt[None], 12, key=jax.random.PRNGKey(42),
+            **{k: v for k, v in kw.items() if k != "seed"}))[0, 5:]
+        # pin a session to the async replica so the kill hits the
+        # lookahead loop mid-stream (routing hashes the session id —
+        # try ids until one lands; the endpoint is set by the start)
+        it = toks = None
+        for n in range(32):
+            sess = router.session(f"hot-victim-{n}")
+            it = sess.generate("llm", prompt, 12, poll_wait_s=0.05,
+                               resume_budget=2, **kw)
+            first = next(it)             # start() ran: endpoint is real
+            if sess.endpoint == ep_hot:
+                toks = [first, next(it)]     # lookahead stream is live
+                break
+            list(it)                     # drain the mis-pinned stream
+        check("genhot/victim_session_pinned", toks is not None,
+              f"endpoint never hashed to {ep_hot}")
+        hot.kill(ep_hot)                 # real SIGKILL, no goodbye
+        err = None
+        try:
+            toks += list(it)             # resumes on the sync survivor
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("genhot/sampled_stream_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={toks} ref={ref.tolist()}")
+        check("genhot/resume_counted",
+              monitor.get_stat("serving/router/stream_resumes") >= 1,
+              str(monitor.export_stats("serving/router/")))
+        g = {}
+        with io.InferenceClient(ep_plain, timeout=5.0) as c:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                g = c.health()["generators"]["llm"]
+                if (g.get("active") == 0 and g.get("pages_free", 0)
+                        + g.get("prefix_entries", 0) == g.get("pages")):
+                    break
+                time.sleep(0.1)
+        check("genhot/zero_leaked_pages_on_survivor",
+              g.get("pages_free", -1) + g.get("prefix_entries", 0)
+              == g.get("pages"), str(g))
+        check("genhot/survivor_is_synchronous",
+              g.get("async_depth") == 0 and g.get("device_pt") is False
+              and g.get("pending_steps") == 0, str(g))
+    finally:
+        router.close()
+        for sp in (hot, plain):
+            for ep in list(sp.procs):
+                sp.kill(ep)
+
+
 def main() -> int:
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
@@ -1430,7 +1525,8 @@ def main() -> int:
                          scenario_control_plane, scenario_gen_resilience,
                          scenario_gen_spec, scenario_gen_sharded,
                          scenario_obs_fleet, scenario_ledger,
-                         scenario_gen_disagg):
+                         scenario_gen_disagg,
+                         scenario_gen_hotloop):
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a
