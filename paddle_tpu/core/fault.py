@@ -35,6 +35,19 @@ registered site, by layer):
                                       scale-up and replace; fires drive
                                       the spawn circuit breaker
                                       (serving/control.py)
+    ``kvstore.get`` / ``kvstore.put`` — KVStore public API entry
+                                      (serving/kvstore.py); a fire
+                                      degrades to a miss / dropped
+                                      publication and books a RAM-tier
+                                      health failure
+    ``kvstore.spill``               — KV spill-tier transfers: read,
+                                      write-through, existence probe
+                                      (serving/kvstore.py); fires drive
+                                      the spill-tier circuit breaker
+    ``wire.kv_get``                 — peer-replica KV fetch round-trip
+                                      (serving/kvstore.py, covering
+                                      callable and endpoint peers);
+                                      fires drive the peer-tier breaker
 
 A spec string (the ``fault_inject`` flag, or :func:`configure`) selects
 sites::

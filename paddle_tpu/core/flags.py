@@ -350,6 +350,51 @@ define_flag("gen_role", "both",
             "replicas probe/fetch at admission and admit straight "
             "into decode, 'both' (default) does both. Inert unless "
             "gen_kv_store is on; read only at engine construction")
+define_flag("gen_kv_fetch_timeout_s", 0.0,
+            "Per-page deadline for a cold KV-store fetch (spill/peer "
+            "tiers): a fetch still pending at the deadline is "
+            "abandoned and answers a degraded miss — the engine "
+            "recomputes the prefix locally (gen/kv_fetch_degraded "
+            "books the debt) instead of wedging admission on a slow "
+            "tier. 0 (default) = unbounded, inline, thread-free "
+            "fetches, byte-identical to the pre-hardening path. Read "
+            "only at engine construction, only while gen_kv_store is "
+            "on")
+define_flag("gen_kv_admit_timeout_s", 0.0,
+            "Admission-level budget across ALL page fetches of one "
+            "generation's prefix chain: once exceeded, remaining "
+            "pages degrade to local prefill recompute (the PR 14 miss "
+            "path — byte-identical by construction). 0 (default) = "
+            "unbounded. Read only at engine construction, only while "
+            "gen_kv_store is on")
+define_flag("gen_kv_hedge_ms", 0.0,
+            "Hedged-fetch latency threshold in milliseconds: a spill-"
+            "tier read still pending after this long races a peer "
+            "replica's wire kv_get (gen_kv_peers); the first valid "
+            "frame wins and the loser is abandoned. 0 (default) = no "
+            "hedging. Read only at engine construction, only while "
+            "gen_kv_store is on")
+define_flag("gen_kv_breaker", 0,
+            "Consecutive tier failures that open a KV-store tier's "
+            "circuit breaker (spill and peer tiers; the control.py "
+            "spawner-breaker idiom with exp-backoff half-open "
+            "probes). While open the tier is skipped — puts stay RAM-"
+            "only, eviction of unspilled frames drops loudly, fetches "
+            "degrade to recompute, and the replica stops advertising "
+            "KV placement (kv_probe answers no-match). 0 (default) = "
+            "no breakers, no extra state. Read only at engine "
+            "construction, only while gen_kv_store is on")
+define_flag("gen_kv_breaker_backoff_s", 0.5,
+            "Half-open probe backoff base for an open KV tier "
+            "breaker, doubled per failed probe and capped at 32x. "
+            "Inert unless gen_kv_breaker > 0; read only at engine "
+            "construction")
+define_flag("gen_kv_peers", "",
+            "Comma-separated peer replica endpoints (host:port) for "
+            "the KV store's peer tier: hedged/fallback kv_get fetches "
+            "when the spill tier is slow, broken, or absent. Empty "
+            "(default) = no peer tier. Read only at engine "
+            "construction, only while gen_kv_store is on")
 define_flag("gen_device_pt", False,
             "Keep the paged engine's per-slot page table resident on "
             "device, updated incrementally with dirty-row .at[slot]"

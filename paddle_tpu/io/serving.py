@@ -401,6 +401,12 @@ class InferenceServer(FrameService):
             if name == "kv_probe":
                 store = self._kv_store()
                 keys = [str(k) for k in header.get("keys", ())]
+                if store is not None and not store.placeable:
+                    # cordoned or breaker-open: stop advertising KV
+                    # locality — a no-match answer makes the router's
+                    # _kv_place look elsewhere (match>0 is what pins)
+                    send_frame(sock, 0, {"match": 0, "degraded": True})
+                    return True
                 send_frame(sock, 0, {"match": (0 if store is None
                                                else store.probe(keys))})
                 return True

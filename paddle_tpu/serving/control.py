@@ -639,6 +639,14 @@ class ServingController:
                 "fetch_bytes": kv["fetch_bytes"],
                 "demotions": kv["demotions"],
                 "prefill_recomputed": kv["prefill_recomputed"],
+                # tier failure domains: a degraded store or rising
+                # fetch_degraded explains a throughput dip as recompute
+                # debt, not capacity shortfall — scale decisions read
+                # this before adding replicas
+                "degraded_engines": kv["degraded_engines"],
+                "fetch_degraded": kv["fetch_degraded"],
+                "timeouts": kv["timeouts"],
+                "breaker_opens": kv["breaker_opens"],
             }
         return out
 

@@ -670,9 +670,18 @@ class GenerationEngine:
                                  "KV is a transferable unit")
             from paddle_tpu.serving.kvstore import KVStore
             self._kv_owned = not isinstance(kv, KVStore)
+            peers = tuple(p.strip() for p in
+                          str(flag("gen_kv_peers")).split(",") if p.strip())
             self._kv = kv if isinstance(kv, KVStore) else KVStore(
                 pages=int(flag("gen_kv_store_pages")),
-                spill=str(flag("gen_kv_spill_dir")) or None)
+                spill=str(flag("gen_kv_spill_dir")) or None,
+                fetch_timeout_s=float(flag("gen_kv_fetch_timeout_s")),
+                hedge_ms=float(flag("gen_kv_hedge_ms")),
+                breaker=int(flag("gen_kv_breaker")),
+                breaker_backoff_s=float(flag("gen_kv_breaker_backoff_s")),
+                peers=peers)
+            # admission-level fetch budget across one gen's page chain
+            self._kv_admit_s = float(flag("gen_kv_admit_timeout_s"))
             # prefill-tier replicas are producers: they publish but
             # never fetch; decode-tier (and 'both') replicas fetch at
             # admission. Whoever ran a prefill publishes its pages —
@@ -683,10 +692,12 @@ class GenerationEngine:
             self._kv_fetched_bytes = 0
             self._kv_demoted = 0         # prefix evictions demoted, not
             self._kv_recomputed = 0      # dropped; resumed-prefill debt
+            self._kv_degraded = 0        # fetches degraded to recompute
         else:
             self._kv = None
             self._kv_owned = False
             self._kv_fetch = False
+            self._kv_admit_s = 0.0
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -756,12 +767,20 @@ class GenerationEngine:
         # the state epoch that invalidates an in-flight compiled call's
         # results after the watchdog failed its generations
         self._crash_counts: dict[str, int] = {}
+        # co-tenant-ambiguous (fused decode / watchdog) trap books:
+        # "suspect" fingerprints need 2 independent hits before
+        # quarantine so a neighbor's poison can't evict bystanders
+        self._suspect_counts: dict[str, int] = {}
         self._quarantined: dict[str, str] = {}
         self._expired: dict[str, float] = {}
         self._rebuilds = 0
         self._consec_traps = 0
         self._epoch = 0
         self._stuck = False
+        # generation currently blocked in _kv_admit_fetch (lock held by
+        # no one while the store I/O runs): the watchdog counts it as
+        # busy work and fails it resumable when the beat goes stale
+        self._admitting: Generation | None = None
         self._last_beat = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gen-engine")
@@ -1501,7 +1520,8 @@ class GenerationEngine:
                                  fetched_pages=self._kv_fetched_pages,
                                  fetched_bytes=self._kv_fetched_bytes,
                                  demoted=self._kv_demoted,
-                                 prefill_recomputed=self._kv_recomputed)
+                                 prefill_recomputed=self._kv_recomputed,
+                                 fetch_degraded=self._kv_degraded)
             return doc
 
     def ledger_dump(self, limit: int | None = None) -> dict | None:
@@ -1671,29 +1691,40 @@ class GenerationEngine:
                 self._break(e)       # terminal: refuse new work,
                 return               # keep pollers sane
 
-    def _note_trap(self, gens: list[Generation], e: BaseException) -> None:
+    def _note_trap(self, gens: list[Generation], e: BaseException, *,
+                   exact: bool = False) -> None:
         """Record a prefill/decode trap against the implicated
-        generations' crash fingerprints; a fingerprint that reaches
-        ``gen_quarantine_after`` is quarantined — its future starts get
-        the typed :class:`RequestQuarantined`. Prefill traps implicate
-        exactly the prefilling request; decode traps implicate every
-        generation in the fused step (co-tenants of a poison request
-        accumulate counts too — set the threshold above 1 when mixed
-        traffic shares an engine)."""
+        generations' crash fingerprints; a fingerprint that reaches its
+        quarantine threshold is quarantined — its future starts get the
+        typed :class:`RequestQuarantined`. Prefill traps implicate
+        exactly the prefilling request (``exact=True``: threshold is
+        ``gen_quarantine_after`` as configured). Fused-decode and
+        watchdog traps implicate every stepped generation — when more
+        than one was stepped those fingerprints are co-tenant-
+        AMBIGUOUS: booked separately as "suspect" and requiring at
+        least 2 independent hits before quarantine, so a neighbor's
+        poison request can't get a well-behaved bystander quarantined
+        off one shared trap. A trap implicating exactly one generation
+        is exact by pigeonhole regardless of the site."""
         stat_add("gen/traps")
         if self._quarantine_after <= 0 or not gens:
             return
+        exact = exact or len(gens) == 1
+        need = (self._quarantine_after if exact
+                else max(2, self._quarantine_after))
+        books = self._crash_counts if exact else self._suspect_counts
         msg = f"{type(e).__name__}: {e}"
         with self._cond:
             for gen in gens:
                 fp = gen.fingerprint
-                self._crash_counts[fp] = self._crash_counts.get(fp, 0) + 1
-                if (self._crash_counts[fp] >= self._quarantine_after
-                        and fp not in self._quarantined):
+                books[fp] = books.get(fp, 0) + 1
+                if not exact:
+                    stat_add("gen/suspect_traps")
+                if books[fp] >= need and fp not in self._quarantined:
                     self._quarantined[fp] = msg
                     stat_add("gen/quarantined")
-            while len(self._crash_counts) > 1024:   # bounded books
-                self._crash_counts.pop(next(iter(self._crash_counts)))
+            while len(books) > 1024:            # bounded books
+                books.pop(next(iter(books)))
 
     # -- page-table device residency (gen_device_pt) -----------------------
     def _pt_sync_row_locked(self, slot: int) -> None:
@@ -1788,7 +1819,13 @@ class GenerationEngine:
                     return
                 if self._stuck or self._broken is not None:
                     continue
-                busy = any(g is not None for g in self._slot_gen)
+                # an admission-time KV fetch counts as busy work: the
+                # admitting generation holds no slot yet, but a wedged
+                # store read stalls the whole loop exactly like a
+                # wedged compiled call
+                admitting = self._admitting
+                busy = (any(g is not None for g in self._slot_gen)
+                        or admitting is not None)
                 stalled = time.monotonic() - self._last_beat
                 if not busy or stalled <= self._watchdog_s:
                     continue
@@ -1797,6 +1834,20 @@ class GenerationEngine:
                     f"{RESET_MARKER} stuck step: decode loop "
                     f"unresponsive for {stalled:.1f}s "
                     f"(gen_watchdog_s={self._watchdog_s:g})")
+                if admitting is not None and not admitting.done:
+                    # stranded mid-admission (PR 8 contract): fail it
+                    # resumable too — it was never slotted, so
+                    # _fail_active_locked can't see it
+                    admitting.done = True
+                    admitting.error = (
+                        f"{RESET_MARKER} stuck step: admission kv "
+                        f"fetch unresponsive for {stalled:.1f}s "
+                        f"(gen_watchdog_s={self._watchdog_s:g})")
+                    self._gen_event(admitting, "gen/retire",
+                                    reason="failed",
+                                    tokens=len(admitting.tokens))
+                    self._ledger_finalize(admitting, "failed")
+                    victims = victims + [admitting]
                 self._stuck = True
                 self._cond.notify_all()
             self._note_trap(victims,
@@ -1947,7 +1998,19 @@ class GenerationEngine:
                     matched = self._prefix.match(gen.prompt, self._pool)
                 if (self._kv is not None and self._kv_fetch
                         and self._prefix is not None):
+                    epoch0 = self._epoch
                     matched += self._kv_admit_fetch(gen, matched)
+                    if self._epoch != epoch0 or self._stuck:
+                        # the store fetch ran with the lock released
+                        # and a rebuild/watchdog reset landed under it:
+                        # matched pages belong to the replaced pool —
+                        # do NOT release them into the fresh one
+                        return progressed
+                    if gen.done:        # cancelled while fetching
+                        for pid in matched:
+                            self._pool.release(pid)
+                        stat_set("gen/pages_free", self._pool.free_count)
+                        continue        # loop top pops the dead head
                     if gen.rng_skip:
                         # a resumed stream's original prompt is
                         # prompt[:-rng_skip] (replay appended the
@@ -2046,7 +2109,18 @@ class GenerationEngine:
         cache (page tables are rehydrated from the page-id list like
         any matched page). Stops at the first miss / corrupt frame /
         page shortage; capped like ``match`` so at least one prompt
-        token remains to prefill."""
+        token remains to prefill.
+
+        The store I/O runs with the scheduler lock RELEASED (the
+        caller holds it): a slow or dead tier must not freeze pollers,
+        cancels, or the watchdog heartbeat. ``self._admitting`` marks
+        the generation as busy work for the watchdog; after
+        re-acquiring, an epoch change or stuck latch means the pool we
+        were admitting into is gone — everything is dropped. Every
+        budget overrun, tier failure or corrupt frame degrades the
+        remainder of the chain to local prefill recompute
+        (byte-identical by construction) and books
+        ``gen/kv_fetch_degraded``."""
         from paddle_tpu.models.generation import deserialize_page
         from paddle_tpu.serving.kvstore import page_chain_keys
         import jax.numpy as jnp
@@ -2057,21 +2131,60 @@ class GenerationEngine:
             return []
         t0 = time.perf_counter()
         keys = page_chain_keys(gen.prompt, P, limit=cap)
+        shapes = [(tuple(pl.shape[1:]), pl.dtype)
+                  for pl in self._state["cache"]]
+        epoch0 = self._epoch
+        self._admitting = gen
+        self._cond.release()
+        frames: list[tuple[tuple, int]] = []   # (validated leaves, nbytes)
+        degraded = False
+        try:
+            for key in keys[start:]:
+                if gen.done or self._stuck or self._stopping:
+                    break
+                if (self._kv_admit_s > 0
+                        and time.perf_counter() - t0 > self._kv_admit_s):
+                    # admission-level budget across the whole chain:
+                    # the rest is recompute debt, not a wedge
+                    degraded = True
+                    stat_add("gen/kv_admit_timeouts")
+                    break
+                try:
+                    frame, deg = self._kv.fetch(key)
+                except Exception:
+                    frame, deg = None, True
+                if frame is None:
+                    degraded |= deg
+                    break
+                try:
+                    leaves = deserialize_page(frame)
+                except ValueError:
+                    # corrupt/truncated store entry: a miss, but a
+                    # DEGRADED one — the bytes existed and were bad
+                    degraded = True
+                    stat_add("gen/kv_corrupt")
+                    break
+                if (len(leaves) != len(shapes)
+                        or any(l.shape != shp or l.dtype != dt
+                               for l, (shp, dt) in zip(leaves, shapes))):
+                    break                # foreign layout: not our pool
+                frames.append((leaves, len(frame)))
+        finally:
+            self._cond.acquire()
+            self._admitting = None
+        dt = time.perf_counter() - t0
+        if self._goodput is not None:
+            self._goodput.note("kv_fetch", dt)
+        if degraded:
+            self._kv_degraded += 1
+            stat_add("gen/kv_fetch_degraded")
+        if gen.done or self._epoch != epoch0 or self._stuck:
+            # cancelled / watchdog-failed / rebuilt while unlocked: the
+            # caller re-evaluates; nothing was alloc'd yet
+            return []
         fetched: list[int] = []
         nbytes = 0
-        for key in keys[start:]:
-            frame = self._kv.get(key)
-            if frame is None:
-                break
-            try:
-                leaves = deserialize_page(frame)
-            except ValueError:
-                break                    # corrupt entry reads as a miss
-            if (len(leaves) != len(self._state["cache"])
-                    or any(l.shape != tuple(pl.shape[1:])
-                           or l.dtype != pl.dtype for l, pl
-                           in zip(leaves, self._state["cache"]))):
-                break                    # foreign layout: not our pool
+        for leaves, flen in frames:
             if self._pool.free_count == 0 and self._prefix.evict(
                     1, self._pool, demote=self._kv_demote) == 0:
                 break
@@ -2080,10 +2193,7 @@ class GenerationEngine:
                 pl.at[pid].set(jnp.asarray(l)) for pl, l
                 in zip(self._state["cache"], leaves))
             fetched.append(pid)
-            nbytes += len(frame)
-        dt = time.perf_counter() - t0
-        if self._goodput is not None:
-            self._goodput.note("kv_fetch", dt)
+            nbytes += flen
         if fetched:
             # register the fetched chain so the NEXT admission is a
             # local radix hit; insert gives the cache its +1 ref, the
@@ -2161,7 +2271,7 @@ class GenerationEngine:
                         temp, top_k, top_p)
                     tok0 = int(tok0) if final else None
             except Exception as e:       # a prefill trap implicates
-                self._note_trap([gen], e)     # exactly this request
+                self._note_trap([gen], e, exact=True)  # exactly this one
                 raise
             dt = time.perf_counter() - t0
             observe("gen/prefill_chunk_s", dt)
@@ -2231,7 +2341,7 @@ class GenerationEngine:
                     temp, top_k, top_p)
                 tok0 = int(tok0)
         except Exception as e:           # a prefill trap implicates
-            self._note_trap([gen], e)         # exactly this request
+            self._note_trap([gen], e, exact=True)     # exactly this one
             raise
         dt = time.perf_counter() - t0
         observe("gen/prefill_s", dt)

@@ -6,8 +6,9 @@ serialized page frames (``models.generation.serialize_page``) in a
 host-RAM LRU tier, written through to an optional spill tier — any
 ``io.fs`` filesystem, so a local directory for one box or a ``WireFS``
 endpoint (``ptfs://host:port/kv``) shared by every replica in the
-fleet. Pages are keyed by their radix *chain key*: a hash chain over
-the page's token bytes and every ancestor page's token bytes
+fleet — and can additionally fetch from *peer replicas* over the wire
+``kv_get`` op. Pages are keyed by their radix *chain key*: a hash chain
+over the page's token bytes and every ancestor page's token bytes
 (:func:`page_chain_keys`), the store-global generalization of the
 ``_PrefixCache``'s ``(parent_page, token_bytes)`` radix key. Two
 replicas that prefill the same prompt prefix derive the same keys, so
@@ -21,9 +22,31 @@ admission (``serving/engine.py``), and ``StickySession`` failover
 upgrades from token replay to KV fetch.
 
 The store is an I/O-side cache, never an authority: every operation
-degrades to a miss on spill-tier failure, and a corrupt frame reads as
-a miss (``deserialize_page`` validates), so a broken store can slow
-serving down but never wrong it.
+degrades to a miss on tier failure, and a corrupt frame reads as a
+miss (``deserialize_page`` validates), so a broken store can slow
+serving down but never wrong it. The hardening layer (all hard-off by
+default) makes that degradation *bounded and observable*:
+
+- **Deadlines** (``fetch_timeout_s``): a cold fetch that outruns its
+  budget is abandoned — the caller degrades to a miss (the engine
+  recomputes prefill) instead of wedging on a slow tier.
+- **Hedging** (``hedge_ms`` + ``peers``): a spill read that hasn't
+  answered within the hedge threshold races a peer replica's wire
+  ``kv_get``; the first valid frame wins, the loser is abandoned.
+- **Per-tier health** (:class:`_TierHealth`): RAM, spill and peer tiers
+  each track error/latency EWMAs and — with ``breaker`` > 0 — open a
+  circuit breaker after that many consecutive failures, with an
+  exponential-backoff half-open probe (the control-plane
+  spawner-breaker idiom). A broken spill tier is *skipped*, never
+  waited on: puts keep the frame RAM-only and eviction of an
+  unspilled frame drops loudly (``degraded_drops``) instead of
+  wedging; :attr:`placeable` goes False so the router's ``kv_probe``
+  placement stops pinning new sessions here.
+
+Fault sites (``core/fault.py``): ``kvstore.get`` / ``kvstore.put``
+fire at the public API (the call degrades to a miss/no-op and books a
+RAM-tier failure), ``kvstore.spill`` fires on spill-tier transfers,
+``wire.kv_get`` on the peer-tier round-trip.
 """
 
 from __future__ import annotations
@@ -32,11 +55,14 @@ import hashlib
 import os
 import tempfile
 import threading
+import time
 from collections import OrderedDict
-from typing import Sequence
+from typing import Callable, Sequence
 
 import numpy as np
 
+from paddle_tpu.core import fault as _fault
+from paddle_tpu.core.monitor import stat_add
 from paddle_tpu.io.fs import fs_for_path
 
 __all__ = ["KVStore", "page_chain_keys"]
@@ -67,18 +93,138 @@ def page_chain_keys(tokens, page_tokens: int,
     return keys
 
 
+class _TierHealth:
+    """One tier's health book: error/latency EWMAs plus a consecutive-
+    failure circuit breaker with exponential-backoff half-open probing
+    (the ``control.py`` spawner-breaker idiom, per store tier).
+
+    ``threshold`` <= 0 disables the breaker entirely — :meth:`allow`
+    is then a constant True and only the EWMAs/counters update, so the
+    default build carries no breaker state machine. No threads: the
+    breaker is evaluated lazily at access time."""
+
+    _ALPHA = 0.2          # EWMA smoothing for err rate and latency
+
+    __slots__ = ("name", "threshold", "backoff_s", "ok", "errors",
+                 "consec", "opens", "half_opens", "closes", "err_ewma",
+                 "lat_ewma_s", "_open_until", "_probing", "_lock")
+
+    def __init__(self, name: str, *, threshold: int = 0,
+                 backoff_s: float = 0.5):
+        self.name = name
+        self.threshold = int(threshold)
+        self.backoff_s = max(float(backoff_s), 0.001)
+        self.ok = 0
+        self.errors = 0
+        self.consec = 0           # consecutive failures
+        self.opens = 0            # closed -> open transitions
+        self.half_opens = 0       # probes granted while open
+        self.closes = 0           # open -> closed recoveries
+        self.err_ewma = 0.0
+        self.lat_ewma_s = 0.0
+        self._open_until = 0.0
+        self._probing = False
+        self._lock = threading.Lock()
+
+    @property
+    def breaker_open(self) -> bool:
+        """True from the moment the breaker opens until a successful
+        (half-open) probe closes it — the half-open window counts as
+        open: the tier is unproven."""
+        return self.threshold > 0 and self.consec >= self.threshold
+
+    def allow(self) -> bool:
+        """May the caller touch this tier right now? False while the
+        breaker is open and backing off; after the backoff elapses
+        exactly ONE caller gets a half-open trial at a time."""
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self.consec < self.threshold:
+                return True
+            if time.monotonic() < self._open_until or self._probing:
+                return False
+            self._probing = True      # the half-open trial
+            self.half_opens += 1
+            return True
+
+    def record(self, ok: bool, dt: float) -> None:
+        """Book one tier interaction's outcome + latency. A success
+        closes an open breaker; a failure during the half-open trial
+        re-opens it with a doubled (capped) backoff."""
+        with self._lock:
+            a = self._ALPHA
+            self.lat_ewma_s += a * (float(dt) - self.lat_ewma_s)
+            self.err_ewma += a * ((0.0 if ok else 1.0) - self.err_ewma)
+            self._probing = False
+            was_open = self.threshold > 0 and self.consec >= self.threshold
+            if ok:
+                self.ok += 1
+                self.consec = 0
+                self._open_until = 0.0
+                if was_open:
+                    self.closes += 1
+                return
+            self.errors += 1
+            self.consec += 1
+            if self.threshold > 0 and self.consec >= self.threshold:
+                backoff = self.backoff_s * min(
+                    2 ** (self.consec - self.threshold), 32)
+                self._open_until = time.monotonic() + backoff
+                if not was_open:
+                    self.opens += 1
+                    stat_add(f"kv/breaker_open/{self.name}")
+
+    def state(self) -> str:
+        if not self.breaker_open:
+            return "closed"
+        if time.monotonic() >= self._open_until:
+            return "half_open"
+        return "open"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ok": self.ok, "errors": self.errors,
+                "consec_failures": self.consec,
+                "err_ewma": round(self.err_ewma, 4),
+                "lat_ewma_ms": round(self.lat_ewma_s * 1e3, 3),
+                "state": self.state(),
+                "opens": self.opens, "half_opens": self.half_opens,
+                "closes": self.closes,
+            }
+
+
 class KVStore:
-    """Two-tier page store: host-RAM LRU over an ``io.fs`` spill tier.
+    """Tiered page store: host-RAM LRU over an ``io.fs`` spill tier,
+    with an optional peer-replica tier for hedged fetches.
 
     ``put`` writes through to the spill tier (that write IS the fleet-
     wide publication), so RAM eviction is a pure demotion — the bytes
     survive in the spill tier and ``get`` re-promotes them. Without a
     spill tier the store is replica-local and RAM eviction drops.
-    Thread-safe; all counters ride :meth:`snapshot` into engine
-    ``stats()`` / health.
+    Thread-safe; all counters and the per-tier health block ride
+    :meth:`snapshot` into engine ``stats()`` / health.
+
+    Hardening knobs (all hard-off by default — zero knobs means zero
+    helper threads and the byte-identical pre-hardening path):
+
+    - ``fetch_timeout_s``: per-``get`` cold-fetch deadline; an overrun
+      abandons the in-flight tier read and answers a (degraded) miss.
+    - ``hedge_ms``: latency threshold after which a pending spill read
+      is raced against a peer; first valid frame wins.
+    - ``breaker`` / ``breaker_backoff_s``: consecutive failures that
+      open a tier's circuit breaker, and the half-open probe backoff
+      base (doubled per failed probe, capped 32x).
+    - ``peers``: peer replicas to fetch from — wire endpoints
+      (``host:port``, dialed with the serving ``kv_get`` op) or
+      callables ``key -> bytes | None`` (in-process fleets/tests).
     """
 
-    def __init__(self, *, pages: int = 256, spill: str | None = None):
+    def __init__(self, *, pages: int = 256, spill: str | None = None,
+                 fetch_timeout_s: float = 0.0, hedge_ms: float = 0.0,
+                 breaker: int = 0, breaker_backoff_s: float = 0.5,
+                 peers: Sequence[str | Callable] = ()):
         self._cap = max(1, int(pages))
         self._ram: OrderedDict[str, bytes] = OrderedDict()
         self._lock = threading.Lock()
@@ -90,48 +236,141 @@ class KVStore:
                 self._fs.mkdirs(self._spill_root)
             except Exception:
                 pass  # FSService mkdirs is idempotent; races are benign
-        self.hits = 0          # get() served (either tier)
+        self._timeout_s = max(float(fetch_timeout_s), 0.0)
+        self._hedge_ms = max(float(hedge_ms), 0.0)
+        self._peers = tuple(peers or ())
+        self._peer_clients: dict[str, object] = {}
+        self._peer_rr = 0
+        b = max(int(breaker), 0)
+        self._health = {
+            # RAM can't meaningfully break (refusing memory helps no
+            # one) — it books API-level latency and injected faults;
+            # spill and peer get the full breaker.
+            "ram": _TierHealth("ram"),
+            "spill": _TierHealth("spill", threshold=b,
+                                 backoff_s=breaker_backoff_s),
+            "peer": _TierHealth("peer", threshold=b,
+                                backoff_s=breaker_backoff_s),
+        }
+        self._cordoned = False
+        # keys whose spill write-through was skipped (open breaker) or
+        # failed: eviction of these DROPS the bytes (counted loudly)
+        # instead of pretending the spill tier holds them
+        self._unspilled: set[str] = set()
+        self.hits = 0          # get() served (any tier)
         self.spill_hits = 0    # ...of which came from the spill tier
+        self.peer_hits = 0     # ...of which came from a peer replica
         self.misses = 0        # get() found nothing
         self.puts = 0          # new frames accepted
         self.put_bytes = 0
         self.fetch_bytes = 0   # bytes returned by get()
         self.demotions = 0     # RAM -> spill-backed eviction
-        self.dropped = 0       # RAM eviction with no spill tier
+        self.dropped = 0       # RAM eviction with no spill backing
+        self.degraded_drops = 0   # ...because the spill tier was broken
+        self.timeouts = 0      # cold fetches abandoned at the deadline
+        self.hedges = 0        # peer hedges launched
+        self.hedge_wins = 0    # ...won by the peer
         self.probes = 0
+
+    # -- health / degradation ------------------------------------------
+
+    def cordon(self) -> None:
+        """Administratively mark the store unplaceable (drain): the
+        wire ``kv_probe`` answers no-match so the router's KV-locality
+        placement stops pinning new sessions to this replica. Existing
+        entries still serve."""
+        if not self._cordoned:
+            self._cordoned = True
+            stat_add("kv/cordoned")
+
+    def uncordon(self) -> None:
+        self._cordoned = False
+
+    @property
+    def cordoned(self) -> bool:
+        return self._cordoned
+
+    @property
+    def placeable(self) -> bool:
+        """False while cordoned or any tier breaker is open — the
+        KV-locality placement signal. A store that cannot reliably
+        serve its claimed prefix must not attract new pins."""
+        return not self._cordoned and not any(
+            h.breaker_open for h in self._health.values())
 
     # -- spill tier ----------------------------------------------------
 
     def _path(self, key: str) -> str:
         return f"{self._spill_root}/{key}.kvpg"
 
-    def _spill_write(self, key: str, frame: bytes) -> None:
+    def _spill_absent(self, e: BaseException) -> bool:
+        """Classify a spill-read error: True means the tier answered
+        and the frame is simply ABSENT (a clean miss); False means the
+        tier itself failed (degradation — drives the breaker). A
+        missing file only counts as absence while the spill ROOT still
+        exists: a vanished root (dir deleted, volume gone) is tier
+        loss, not a miss."""
+        if isinstance(e, ConnectionError):       # includes InjectedFault
+            return False
+        absent = isinstance(e, FileNotFoundError) or (
+            isinstance(e, (RuntimeError, OSError))
+            and "FileNotFoundError" in str(e))   # WireFS error surface
+        if not absent:
+            return False
+        try:
+            return bool(self._fs.is_dir(self._spill_root))
+        except Exception:
+            return False
+
+    def _spill_write(self, key: str, frame: bytes) -> bool:
+        """Write-through one frame; returns success. Books spill-tier
+        health; failures degrade to a replica-local (unspilled) entry."""
         if self._fs is None:
-            return
+            return False
+        t0 = time.monotonic()
         fd, tmp = tempfile.mkstemp(prefix="kvpg.")
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(frame)
+            _fault.inject("kvstore.spill")
             self._fs.upload(tmp, self._path(key))
+            self._health["spill"].record(True, time.monotonic() - t0)
+            return True
         except Exception:
-            pass  # spill failure degrades to a replica-local entry
+            self._health["spill"].record(False, time.monotonic() - t0)
+            return False
         finally:
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
 
-    def _spill_read(self, key: str) -> bytes | None:
+    def _spill_read(self, key: str,
+                    record: bool = True) -> tuple[bytes | None, bool]:
+        """→ ``(frame, failed)``: ``failed`` is True when the tier
+        errored (degradation), False on success or clean absence.
+        ``record=False`` defers health booking to the caller (the
+        hedged/deadlined orchestrator, which must not double-book an
+        abandoned read)."""
         if self._fs is None:
-            return None
+            return None, False
+        t0 = time.monotonic()
         fd, tmp = tempfile.mkstemp(prefix="kvpg.")
         os.close(fd)
         try:
+            _fault.inject("kvstore.spill")
             self._fs.download(self._path(key), tmp)
             with open(tmp, "rb") as f:
-                return f.read()
-        except Exception:
-            return None  # absent or unreachable: a miss, never an error
+                frame = f.read()
+            if record:
+                self._health["spill"].record(True, time.monotonic() - t0)
+            return frame, False
+        except Exception as e:
+            absent = self._spill_absent(e)
+            if record:
+                self._health["spill"].record(absent,
+                                             time.monotonic() - t0)
+            return None, not absent
         finally:
             try:
                 os.unlink(tmp)
@@ -141,81 +380,326 @@ class KVStore:
     def _spill_has(self, key: str) -> bool:
         if self._fs is None:
             return False
+        t0 = time.monotonic()
         try:
-            return self._fs.is_file(self._path(key))
+            _fault.inject("kvstore.spill")
+            present = self._fs.is_file(self._path(key))
+            self._health["spill"].record(True, time.monotonic() - t0)
+            return present
         except Exception:
+            self._health["spill"].record(False, time.monotonic() - t0)
             return False
+
+    # -- peer tier -----------------------------------------------------
+
+    def _peer_endpoint_get(self, peer: str, key: str) -> bytes | None:
+        client = self._peer_clients.get(peer)
+        if client is None:
+            from paddle_tpu.io.serving import InferenceClient
+            client = InferenceClient(
+                peer, timeout=(self._timeout_s or 5.0), retries=0)
+            self._peer_clients[peer] = client
+        try:
+            return client.kv_get(key)
+        except Exception:
+            # a dead connection poisons the cached client: rebuild next
+            self._peer_clients.pop(peer, None)
+            try:
+                client.close()
+            except Exception:
+                pass
+            raise
+
+    def _peer_read(self, key: str,
+                   record: bool = True) -> tuple[bytes | None, bool]:
+        """Fetch from the peer tier, rotating through ``peers``; the
+        first frame wins. → ``(frame, failed)`` like
+        :meth:`_spill_read`: ``failed`` only when every peer errored
+        (an answered miss means the tier is alive)."""
+        if not self._peers:
+            return None, False
+        t0 = time.monotonic()
+        with self._lock:
+            self._peer_rr += 1
+            rr = self._peer_rr
+        order = [self._peers[(rr + i) % len(self._peers)]
+                 for i in range(len(self._peers))]
+        answered = False
+        for peer in order:
+            try:
+                _fault.inject("wire.kv_get")
+                frame = (peer(key) if callable(peer)
+                         else self._peer_endpoint_get(peer, key))
+            except Exception:
+                continue
+            answered = True
+            if frame is not None:
+                if record:
+                    self._health["peer"].record(True,
+                                                time.monotonic() - t0)
+                return frame, False
+        if record:
+            self._health["peer"].record(answered, time.monotonic() - t0)
+        return None, not answered
+
+    # -- cold fetch orchestration (deadline + hedge) -------------------
+
+    def _fetch_cold(self, key: str) -> tuple[bytes | None, str | None,
+                                             bool]:
+        """RAM missed: consult the spill and peer tiers. → ``(frame,
+        tier, degraded)`` where ``degraded`` marks a miss caused by
+        tier failure / timeout / open breaker rather than confirmed
+        absence. Runs with ``self._lock`` RELEASED."""
+        spill_ok = self._fs is not None and self._health["spill"].allow()
+        peer_ok = bool(self._peers) and self._health["peer"].allow()
+        # a tier skipped because its breaker is open is degradation:
+        # the frame may exist but is unreachable right now
+        degraded = ((self._fs is not None and not spill_ok)
+                    or (bool(self._peers) and not peer_ok))
+        if not spill_ok and not peer_ok:
+            return None, None, degraded
+        if self._timeout_s <= 0 and self._hedge_ms <= 0:
+            # unhardened: inline, thread-free — the default path
+            if spill_ok:
+                frame, failed = self._spill_read(key)
+                if frame is not None:
+                    return frame, "spill", False
+                degraded |= failed
+            if peer_ok:
+                frame, failed = self._peer_read(key)
+                if frame is not None:
+                    return frame, "peer", False
+                degraded |= failed
+            return None, None, degraded
+        return self._fetch_race(key, spill_ok, peer_ok, degraded)
+
+    def _fetch_race(self, key: str, spill_ok: bool, peer_ok: bool,
+                    degraded: bool) -> tuple[bytes | None, str | None,
+                                             bool]:
+        """Deadline-bounded, optionally hedged cold fetch. The spill
+        read starts first; the peer is launched when there is no spill
+        tier, when the spill read misses/fails, or — hedging — when the
+        spill read is still pending after ``hedge_ms``. The first valid
+        frame wins; the loser (and anything still pending at the
+        deadline) is abandoned: its daemon worker's result is discarded
+        and its health outcome is booked by the orchestrator as a
+        timeout failure, so a silently hung tier still drives its
+        breaker."""
+        cv = threading.Condition()
+        results: dict[str, tuple[bytes | None, bool, float]] = {}
+        t0 = time.monotonic()
+        deadline = t0 + self._timeout_s if self._timeout_s > 0 else None
+        abandoned = {"flag": False}
+
+        def run(tier: str, fn) -> None:
+            ts = time.monotonic()
+            try:
+                frame, failed = fn(key, record=False)
+            except Exception:
+                frame, failed = None, True
+            dt = time.monotonic() - ts
+            with cv:
+                if not abandoned["flag"]:
+                    self._health[tier].record(
+                        frame is not None or not failed, dt)
+                results[tier] = (frame, failed, dt)
+                cv.notify_all()
+
+        def start(tier: str, fn) -> None:
+            threading.Thread(target=run, args=(tier, fn), daemon=True,
+                             name=f"kv-{tier}-fetch").start()
+
+        launched: list[str] = []
+        hedged = False
+        if spill_ok:
+            start("spill", self._spill_read)
+            launched.append("spill")
+        else:
+            start("peer", self._peer_read)
+            launched.append("peer")
+        hedge_at = (t0 + self._hedge_ms / 1e3
+                    if (self._hedge_ms > 0 and spill_ok and peer_ok)
+                    else None)
+        with cv:
+            while True:
+                for tier in ("spill", "peer"):
+                    r = results.get(tier)
+                    if r is not None and r[0] is not None:
+                        if hedged and tier == "peer":
+                            with self._lock:
+                                self.hedge_wins += 1
+                        return r[0], tier, False
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    abandoned["flag"] = True
+                    with self._lock:
+                        self.timeouts += 1
+                    for tier in launched:
+                        if tier not in results:
+                            self._health[tier].record(False, now - t0)
+                    stat_add("kv/fetch_timeouts")
+                    return None, None, True
+                if (peer_ok and "peer" not in launched
+                        and (("spill" in results
+                              and results["spill"][0] is None)
+                             or (hedge_at is not None
+                                 and now >= hedge_at))):
+                    # sequential fallback after a spill miss/failure, or
+                    # the hedge: race the peer against the pending read
+                    hedged = "spill" not in results
+                    if hedged:
+                        with self._lock:
+                            self.hedges += 1
+                        stat_add("kv/hedges")
+                    start("peer", self._peer_read)
+                    launched.append("peer")
+                if len(results) == len(launched) and (
+                        "peer" in launched or not peer_ok):
+                    degraded |= any(r[1] for r in results.values())
+                    return None, None, degraded
+                waits = [0.05]
+                if deadline is not None:
+                    waits.append(deadline - now)
+                if hedge_at is not None and "peer" not in launched:
+                    waits.append(hedge_at - now)
+                cv.wait(timeout=max(min(waits), 0.001))
 
     # -- public API ----------------------------------------------------
 
     def put(self, key: str, frame: bytes) -> bool:
         """Insert a page frame. Content-addressed: a key already held
         (either tier) is a no-op. Returns True when the frame was newly
-        accepted."""
+        accepted. Spill I/O runs OUTSIDE the store lock, and a spill
+        tier with an open breaker is skipped entirely — the frame
+        stays RAM-only (``_unspilled``) rather than wedging the caller
+        (eviction included) on a sick tier."""
+        t0 = time.monotonic()
+        try:
+            _fault.inject("kvstore.put")
+        except Exception:
+            self._health["ram"].record(False, time.monotonic() - t0)
+            return False
         with self._lock:
             if key in self._ram:
                 self._ram.move_to_end(key)
                 return False
-            if self._spill_has(key):
+        spill_up = self._fs is not None and self._health["spill"].allow()
+        if spill_up and self._spill_has(key):
+            self._health["ram"].record(True, time.monotonic() - t0)
+            return False
+        wrote = spill_up and self._spill_write(key, frame)
+        with self._lock:
+            if key in self._ram:       # lost an insert race: no-op
                 return False
             self._ram[key] = frame
             self.puts += 1
             self.put_bytes += len(frame)
-            self._spill_write(key, frame)
+            if self._fs is not None and not wrote:
+                self._unspilled.add(key)
             self._shrink_locked()
-            return True
+        self._health["ram"].record(True, time.monotonic() - t0)
+        return True
 
-    def get(self, key: str) -> bytes | None:
-        """Fetch a page frame, promoting spill-tier hits back into
-        RAM. Returns None on a miss."""
+    def fetch(self, key: str) -> tuple[bytes | None, bool]:
+        """Fetch a page frame, promoting cold-tier hits back into RAM.
+        → ``(frame, degraded)``: ``degraded`` is True when a miss was
+        caused by tier failure, timeout or an open breaker instead of
+        confirmed absence — the engine books ``gen/kv_fetch_degraded``
+        on it (the recompute debt is degradation, not a cache miss)."""
+        t0 = time.monotonic()
+        try:
+            _fault.inject("kvstore.get")
+        except Exception:
+            self._health["ram"].record(False, time.monotonic() - t0)
+            with self._lock:
+                self.misses += 1
+            return None, True
         with self._lock:
             frame = self._ram.get(key)
             if frame is not None:
                 self._ram.move_to_end(key)
-            else:
-                frame = self._spill_read(key)
-                if frame is not None:
-                    self.spill_hits += 1
-                    self._ram[key] = frame
-                    self._shrink_locked()
+                self.hits += 1
+                self.fetch_bytes += len(frame)
+        self._health["ram"].record(True, time.monotonic() - t0)
+        if frame is not None:
+            return frame, False
+        frame, tier, degraded = self._fetch_cold(key)
+        with self._lock:
             if frame is None:
                 self.misses += 1
-                return None
+                return None, degraded
+            if tier == "spill":
+                self.spill_hits += 1
+            elif tier == "peer":
+                self.peer_hits += 1
+                if self._fs is not None:
+                    # a peer frame was never written through locally:
+                    # evicting it would lose the bytes — count honestly
+                    self._unspilled.add(key)
+            self._ram[key] = frame
             self.hits += 1
             self.fetch_bytes += len(frame)
-            return frame
+            self._shrink_locked()
+            return frame, False
+
+    def get(self, key: str) -> bytes | None:
+        """Fetch a page frame; None on a miss (see :meth:`fetch` for
+        the degradation-aware form)."""
+        return self.fetch(key)[0]
 
     def contains(self, key: str) -> bool:
         with self._lock:
-            return key in self._ram or self._spill_has(key)
+            if key in self._ram:
+                return True
+        if self._fs is None or not self._health["spill"].allow():
+            return False
+        return self._spill_has(key)
 
     def probe(self, keys: Sequence[str]) -> int:
-        """Longest prefix run of ``keys`` present in the store (either
+        """Longest prefix run of ``keys`` present in the store (any
         tier). Chain keys commit to their whole prefix, so the first
         absent key ends the usable run — pages past a hole cannot be
-        admitted. Advisory: bumps no hit/miss counters."""
+        admitted. Advisory: bumps no hit/miss counters. Spill checks
+        run outside the lock and are skipped while the spill breaker
+        is open (an unreachable tier answers no-match, it does not
+        wedge the prober)."""
         with self._lock:
             self.probes += 1
-            n = 0
-            for k in keys:
-                if k in self._ram or self._spill_has(k):
-                    n += 1
-                else:
-                    break
-            return n
+            ram_keys = set(self._ram)
+        spill_ok = self._fs is not None and self._health["spill"].allow()
+        n = 0
+        for k in keys:
+            if k in ram_keys or (spill_ok and self._spill_has(k)):
+                n += 1
+            else:
+                break
+        return n
 
     def snapshot(self) -> dict:
         with self._lock:
+            health = {name: h.snapshot()
+                      for name, h in self._health.items()}
             return {
                 "ram_entries": len(self._ram),
                 "ram_cap": self._cap,
                 "spill": bool(self._spill_root),
+                "peers": len(self._peers),
                 "hits": self.hits, "spill_hits": self.spill_hits,
+                "peer_hits": self.peer_hits,
                 "misses": self.misses, "puts": self.puts,
                 "put_bytes": self.put_bytes,
                 "fetch_bytes": self.fetch_bytes,
                 "demotions": self.demotions, "dropped": self.dropped,
+                "degraded_drops": self.degraded_drops,
+                "timeouts": self.timeouts,
+                "hedges": self.hedges, "hedge_wins": self.hedge_wins,
                 "probes": self.probes,
+                "errors": sum(h.errors for h in self._health.values()),
+                "breaker_opens": sum(h.opens
+                                     for h in self._health.values()),
+                "cordoned": self._cordoned,
+                "degraded": not self.placeable,
+                "health": health,
             }
 
     def close(self) -> None:
@@ -225,13 +709,27 @@ class KVStore:
                 fs.close()
             except Exception:
                 pass
+        clients, self._peer_clients = dict(self._peer_clients), {}
+        for client in clients.values():
+            try:
+                client.close()
+            except Exception:
+                pass
 
     # -- internals -----------------------------------------------------
 
     def _shrink_locked(self) -> None:
         while len(self._ram) > self._cap:
-            self._ram.popitem(last=False)
-            if self._fs is not None:
+            key, _ = self._ram.popitem(last=False)
+            if self._fs is not None and key not in self._unspilled:
                 self.demotions += 1
+            elif self._fs is not None:
+                # demote-to-drop: the spill tier was broken when this
+                # frame arrived, so eviction LOSES the bytes — loud
+                # (counter + stat), never wedged on the sick tier
+                self._unspilled.discard(key)
+                self.dropped += 1
+                self.degraded_drops += 1
+                stat_add("kv/demote_dropped")
             else:
                 self.dropped += 1

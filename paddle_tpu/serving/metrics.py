@@ -358,6 +358,7 @@ class MetricsHub:
         counters: dict[str, float] = {}
         roles: dict[str, int] = {}
         engines = 0
+        degraded_engines = 0
         with self._lock:
             for s in self._series.values():
                 for g in s.gauges.values():
@@ -365,6 +366,8 @@ class MetricsHub:
                     if not isinstance(kv, dict):
                         continue
                     engines += 1
+                    if kv.get("degraded"):
+                        degraded_engines += 1
                     role = kv.get("role")
                     if isinstance(role, str):
                         roles[role] = roles.get(role, 0) + 1
@@ -385,6 +388,13 @@ class MetricsHub:
             "fetch_bytes": counters.get("fetched_bytes", 0.0),
             "demotions": counters.get("demotions", 0.0),
             "prefill_recomputed": counters.get("prefill_recomputed", 0.0),
+            # failure-domain visibility: stores reporting themselves
+            # degraded (cordoned / breaker open), fetches that fell
+            # back to recompute, deadline abandons, breaker trips
+            "degraded_engines": degraded_engines,
+            "fetch_degraded": counters.get("fetch_degraded", 0.0),
+            "timeouts": counters.get("timeouts", 0.0),
+            "breaker_opens": counters.get("breaker_opens", 0.0),
         }
 
     def endpoints(self) -> list[str]:

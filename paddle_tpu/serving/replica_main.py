@@ -108,6 +108,27 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--kv-spill-dir", default=None,
                     help="KV store spill-tier root: a shared directory "
                          "or a ptfs:// WireFS endpoint")
+    ap.add_argument("--kv-fetch-timeout-s", type=float, default=None,
+                    help="per-page cold-fetch deadline for the KV "
+                         "store (FLAGS_gen_kv_fetch_timeout_s per "
+                         "replica); overruns degrade to recompute")
+    ap.add_argument("--kv-hedge-ms", type=float, default=None,
+                    help="hedged-fetch latency threshold "
+                         "(FLAGS_gen_kv_hedge_ms per replica): a "
+                         "pending spill read races a --kv-peers "
+                         "replica after this many ms")
+    ap.add_argument("--kv-breaker", type=int, default=None,
+                    help="consecutive failures opening a KV tier "
+                         "circuit breaker (FLAGS_gen_kv_breaker per "
+                         "replica; 0 = no breakers)")
+    ap.add_argument("--kv-breaker-backoff-s", type=float, default=None,
+                    help="half-open probe backoff base for an open KV "
+                         "tier breaker "
+                         "(FLAGS_gen_kv_breaker_backoff_s per replica)")
+    ap.add_argument("--kv-peers", default=None,
+                    help="comma-separated peer replica endpoints for "
+                         "the KV store's peer tier "
+                         "(FLAGS_gen_kv_peers per replica)")
     args = ap.parse_args(argv)
 
     if args.mesh_tp > 0:
@@ -127,12 +148,21 @@ def main(argv: list[str] | None = None) -> int:
     from paddle_tpu.core.flags import flag, set_flags
     from paddle_tpu.io.serving import InferenceServer
 
-    if args.kv_spill_dir is not None:
-        # running as ``python -m`` imports the paddle_tpu package (and
-        # with it the flag registry) BEFORE main() runs, so an env
-        # export here would be read too late — set the flag directly;
-        # the engine reads it at construction
-        set_flags({"gen_kv_spill_dir": args.kv_spill_dir})
+    # running as ``python -m`` imports the paddle_tpu package (and
+    # with it the flag registry) BEFORE main() runs, so an env export
+    # here would be read too late — set the flags directly; the engine
+    # reads them at construction
+    kv_flags = {
+        "gen_kv_spill_dir": args.kv_spill_dir,
+        "gen_kv_fetch_timeout_s": args.kv_fetch_timeout_s,
+        "gen_kv_hedge_ms": args.kv_hedge_ms,
+        "gen_kv_breaker": args.kv_breaker,
+        "gen_kv_breaker_backoff_s": args.kv_breaker_backoff_s,
+        "gen_kv_peers": args.kv_peers,
+    }
+    kv_flags = {k: v for k, v in kv_flags.items() if v is not None}
+    if kv_flags:
+        set_flags(kv_flags)
 
     models: dict[str, str] = {}
     for spec in args.models:

@@ -627,6 +627,14 @@ class StickySession:
             if n > best_n:
                 best, best_n = ep, n
         if best is not None:
+            # revalidate at pin time: the probe loop is slow (network
+            # round-trips), and a cordon/mark-down can land between the
+            # healthy snapshot above and here — locality must never
+            # override liveness
+            r = self._router._replica_for(best)
+            if r is None or not r.healthy or r.cordoned:
+                stat_add("serving/router/kv_place_rejected")
+                return
             with self._lock:
                 if self._endpoint is None:
                     self._endpoint = best

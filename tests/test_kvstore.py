@@ -11,12 +11,16 @@ to the cold path. Defaults are hard-off: the unflagged engine builds no
 store and reads no ``gen_kv*`` flag on the hot path.
 """
 
+import shutil
 import tempfile
+import threading
+import time
 
 import numpy as np
 import pytest
 
 import paddle_tpu
+from paddle_tpu.core import fault
 from paddle_tpu.core.flags import flag, get_flags, set_flags
 from paddle_tpu.core.monitor import get_stat
 from paddle_tpu.io.serving import InferenceClient, InferenceServer
@@ -377,3 +381,319 @@ def test_router_kv_locality_pins_longest_prefix(model, tmp_path):
         set_flags(saved)
         for s in servers:
             s.stop()
+
+
+def test_kv_place_never_pins_cordoned_holder(model, tmp_path):
+    """Satellite: KV locality must never override liveness. A cordon
+    landing DURING the (slow, networked) probe loop — after the healthy
+    snapshot, before the pin — used to let _kv_place pin a replica the
+    router had just taken out of rotation. The pin-time revalidation
+    rejects it and the session falls back to a live replica; the stream
+    still completes (cold, recomputed — degraded, never wrong)."""
+    saved = get_flags(["gen_kv_store", "gen_page_tokens"])
+    set_flags({"gen_kv_store": True, "gen_page_tokens": 8})
+    servers, engines = [], []
+    try:
+        for i in range(2):
+            eng = GenerationEngine(
+                model, slots=2, max_len=64, paged=True, page_tokens=8,
+                kv_store=KVStore(pages=64,
+                                 spill=str(tmp_path / f"r{i}")),
+                role="both")
+            srv = InferenceServer().start()
+            srv.add_generator("llm", eng)
+            servers.append(srv)
+            engines.append(eng)
+        prompt = _prompt(29, 16)
+        # warm replica 1 only: it is the longest-chain holder
+        ref = _drain(engines[1], engines[1].start(prompt, 4))
+        holder = servers[1].endpoint
+        box = {}
+
+        def factory(ep):
+            c = InferenceClient(ep, retries=0)
+            if ep == holder:
+                real = c.kv_probe
+
+                def probe(keys):
+                    n = real(keys)
+                    # the race: the drain cordons the holder while its
+                    # winning probe answer is in flight
+                    box["router"].cordon(holder)
+                    return n
+
+                c.kv_probe = probe
+            return c
+
+        router = RoutedClient([s.endpoint for s in servers],
+                              probe_interval_s=0,
+                              client_factory=factory)
+        box["router"] = router
+        try:
+            r0 = get_stat("serving/router/kv_place_rejected")
+            sess = router.session("cordoned-holder-stream")
+            toks = list(sess.generate("llm", prompt, 4,
+                                      poll_wait_s=0.05))
+            assert toks == ref                # recomputed cold, not wrong
+            assert sess.endpoint == servers[0].endpoint
+            assert get_stat("serving/router/kv_place_rejected") == r0 + 1
+        finally:
+            router.close()
+    finally:
+        set_flags(saved)
+        for s in servers:
+            s.stop()
+
+
+# -- failure-domain hardening ----------------------------------------------
+
+def test_store_breaker_opens_half_opens_closes(tmp_path):
+    """Spill-tier circuit breaker lifecycle: consecutive transfer
+    failures open it (the store stops touching the tier and reports
+    itself unplaceable), the backoff elapses into a half-open probe,
+    and a successful probe closes it — all observable in the health
+    snapshot."""
+    st = KVStore(pages=8, spill=str(tmp_path), breaker=2,
+                 breaker_backoff_s=0.05)
+    st.put("warm", b"W" * 16)
+    with fault.inject_faults({"kvstore.spill": 1.0}, seed=3):
+        assert st.fetch("cold-1") == (None, True)
+        assert st.fetch("cold-2") == (None, True)       # opens here
+        h = st.snapshot()["health"]["spill"]
+        assert h["opens"] == 1 and h["state"] in ("open", "half_open")
+        assert st.snapshot()["degraded"] is True
+        assert st.placeable is False
+        # while open the tier is skipped, not retried: still degraded,
+        # but no new spill-tier error is booked
+        e0 = st.snapshot()["health"]["spill"]["errors"]
+        assert st.fetch("cold-3") == (None, True)
+        assert st.snapshot()["health"]["spill"]["errors"] == e0
+    assert st.get("warm") == b"W" * 16       # RAM serves through it all
+    time.sleep(0.12)                          # backoff elapses
+    # half-open probe (injection gone): a clean answer closes
+    assert st.get("cold-1") is None
+    h = st.snapshot()["health"]["spill"]
+    assert h["state"] == "closed"
+    assert h["half_opens"] >= 1 and h["closes"] == 1
+    assert st.placeable is True
+    assert st.snapshot()["breaker_opens"] == 1
+    st.close()
+
+
+def test_store_broken_spill_demotes_to_drop_loudly(tmp_path):
+    """A put against an OPEN spill breaker keeps the frame RAM-only;
+    evicting such a frame cannot pretend the spill tier holds it — it
+    drops, loudly (degraded_drops), instead of wedging eviction on the
+    sick tier."""
+    st = KVStore(pages=1, spill=str(tmp_path), breaker=1,
+                 breaker_backoff_s=30.0)
+    with fault.inject_faults({"kvstore.spill": 1.0}, seed=5):
+        st.put("a", b"A" * 8)                # write-through fails: open
+        assert st.snapshot()["health"]["spill"]["state"] == "open"
+        st.put("b", b"B" * 8)                # evicts unspilled "a"
+    snap = st.snapshot()
+    assert snap["degraded_drops"] == 1 and snap["dropped"] == 1
+    assert snap["demotions"] == 0
+    assert st.get("b") == b"B" * 8           # RAM entry still serves
+    st.close()
+
+
+def test_store_fetch_deadline_abandons_slow_tier(tmp_path, monkeypatch):
+    """gen_kv_fetch_timeout_s: a cold fetch outrunning its budget is
+    abandoned — bounded latency, a degraded miss, and a tier failure
+    booked against the wedged tier."""
+    st = KVStore(pages=8, spill=str(tmp_path), fetch_timeout_s=0.1)
+    st.put("warm", b"X" * 8)
+    real = st._fs.download
+
+    def slow(src, dst):
+        time.sleep(0.6)
+        return real(src, dst)
+
+    monkeypatch.setattr(st._fs, "download", slow)
+    t0 = time.monotonic()
+    frame, degraded = st.fetch("cold")
+    dt = time.monotonic() - t0
+    assert frame is None and degraded is True
+    assert dt < 0.45                          # bounded, not the 0.6s sleep
+    assert st.timeouts == 1
+    assert st.snapshot()["health"]["spill"]["errors"] >= 1
+    assert st.get("warm") == b"X" * 8         # RAM unaffected
+    st.close()
+
+
+def test_store_hedged_fetch_peer_wins(tmp_path, monkeypatch):
+    """gen_kv_hedge_ms: a spill read still pending after the hedge
+    threshold races a peer replica; the peer's frame wins and the slow
+    spill read is abandoned — correct bytes, bounded latency."""
+    frames = {"hk": b"H" * 32}
+    seeder = KVStore(pages=8, spill=str(tmp_path))
+    seeder.put("hk", frames["hk"])
+    seeder.close()
+    st = KVStore(pages=8, spill=str(tmp_path), fetch_timeout_s=2.0,
+                 hedge_ms=20.0, peers=(lambda k: frames.get(k),))
+    real = st._fs.download
+
+    def slow(src, dst):
+        time.sleep(0.6)
+        return real(src, dst)
+
+    monkeypatch.setattr(st._fs, "download", slow)
+    t0 = time.monotonic()
+    frame, degraded = st.fetch("hk")
+    assert frame == frames["hk"] and degraded is False
+    assert time.monotonic() - t0 < 0.5        # won before the spill read
+    snap = st.snapshot()
+    assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+    assert snap["peer_hits"] == 1
+    st.close()
+
+
+def test_store_peer_fallback_without_spill(tmp_path):
+    """The peer tier also serves as the sequential fallback: no spill
+    tier at all, a peer holding the frame answers the cold fetch (no
+    hedge involved — there is nothing to race)."""
+    frames = {"pk": b"P" * 24}
+    st = KVStore(pages=8, peers=(lambda k: frames.get(k),))
+    frame, degraded = st.fetch("pk")
+    assert frame == frames["pk"] and degraded is False
+    assert st.peer_hits == 1 and st.hedges == 0
+    assert st.fetch("absent") == (None, False)    # clean miss: answered
+    st.close()
+
+
+def test_spill_dir_loss_degrades_to_recompute(model, tmp_path):
+    """Satellite: the spill root vanishing mid-serving (volume loss) is
+    TIER loss, not a clean miss — the fetch degrades to local prefill
+    recompute with gen/kv_fetch_degraded booked, the stream stays
+    byte-identical, and the pool returns to full."""
+    prompt = _prompt(31, 16)
+    spill = str(tmp_path / "kv")
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="both") as engA:
+        outA = _drain(engA, engA.start(prompt, 6))
+    ref = np.asarray(generate(model, prompt[None], 6))[0, 16:]
+    np.testing.assert_array_equal(np.asarray(outA, np.int32), ref)
+
+    store = KVStore(pages=64, spill=spill)
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=store,
+                          role="decode") as engB:
+        shutil.rmtree(spill)                  # the tier vanishes
+        d0 = get_stat("gen/kv_fetch_degraded")
+        outB = _drain(engB, engB.start(prompt, 6))
+        assert outB == outA                   # recomputed, byte-identical
+        kv = engB.stats()["kv"]
+        assert kv["fetch_degraded"] >= 1
+        assert kv["fetched_pages"] == 0
+        assert get_stat("gen/kv_fetch_degraded") >= d0 + 1
+        g = engB.stats()
+        assert g["pages_free"] + g["prefix_entries"] == g["pages"]
+
+
+def test_corrupt_spill_frame_degrades_to_recompute(model, tmp_path):
+    """Satellite: a truncated spill frame reads as a DEGRADED miss
+    (gen/kv_corrupt + gen/kv_fetch_degraded) — recompute debt, zero
+    wrong bytes, pool intact."""
+    prompt = _prompt(37, 16)
+    spill = str(tmp_path)
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="both") as engA:
+        outA = _drain(engA, engA.start(prompt, 6))
+    key = page_chain_keys(prompt, 8)[0]       # the page admission fetches
+    path = tmp_path / f"{key}.kvpg"
+    data = path.read_bytes()
+    path.write_bytes(data[:len(data) // 2])   # truncate in place
+    c0 = get_stat("gen/kv_corrupt")
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=KVStore(
+                              pages=64, spill=spill),
+                          role="decode") as engB:
+        outB = _drain(engB, engB.start(prompt, 6))
+        assert outB == outA
+        kv = engB.stats()["kv"]
+        assert kv["fetch_degraded"] >= 1 and kv["fetched_pages"] == 0
+        assert get_stat("gen/kv_corrupt") >= c0 + 1
+        g = engB.stats()
+        assert g["pages_free"] + g["prefix_entries"] == g["pages"]
+
+
+@pytest.mark.resilience
+def test_watchdog_fails_stuck_admit_fetch_resumable(model, tmp_path):
+    """Satellite: a wedged _kv_admit_fetch must trip gen_watchdog_s and
+    fail the ADMITTING generation with the resumable reset marker (the
+    stranded-gen contract) — it holds no slot yet, so the pre-hardening
+    watchdog saw no busy work and the loop wedged silently. The engine
+    then recovers for subsequent work."""
+    from paddle_tpu.serving.engine import RESET_MARKER
+
+    block = threading.Event()                 # armed after warm-up
+    release = threading.Event()
+
+    class _BlockingStore(KVStore):
+        def fetch(self, key):
+            if block.is_set():
+                release.wait(8.0)             # a dead tier, no deadline
+            return super().fetch(key)
+
+    st = _BlockingStore(pages=16, spill=str(tmp_path))
+    with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                          page_tokens=8, kv_store=st, role="decode",
+                          watchdog_s=5.0, rebuilds=2) as eng:
+        # warm the compiled paths under the generous deadline (XLA
+        # compile IS a legitimate long step), then tighten it
+        _drain(eng, eng.start(_prompt(47, 16), 4))
+        eng._watchdog_s = 0.3
+        block.set()
+        gid = eng.start(_prompt(41, 16), 4)
+        deadline = time.monotonic() + 6.0
+        doc = eng.poll(gid, wait_s=0.2)
+        while not doc["done"] and time.monotonic() < deadline:
+            doc = eng.poll(gid, wait_s=0.2)
+        assert doc["done"], "watchdog never fired: admission wedged"
+        assert doc["error"] and RESET_MARKER in doc["error"]
+        assert "admission kv fetch" in doc["error"]
+        block.clear()
+        release.set()
+        # the loop unwinds the abandoned fetch and rebuilds; new starts
+        # are shed (EngineOverloaded) until it does — retry briefly
+        from paddle_tpu.serving.engine import EngineOverloaded
+        deadline = time.monotonic() + 6.0
+        while True:
+            try:
+                gid2 = eng.start(_prompt(43, 16), 4)
+                break
+            except EngineOverloaded:
+                assert time.monotonic() < deadline, "engine never healed"
+                time.sleep(0.1)
+        out = _drain(eng, gid2)
+        assert len(out) == 4                  # engine recovered
+
+
+def test_kv_hardening_defaults_off(tmp_path, monkeypatch):
+    """Hard-off discipline for the hardening flags: all zero/empty by
+    default, and the defaults store runs THREAD-FREE — cold fetches are
+    inline, no hedge or deadline machinery exists to pay for."""
+    assert flag("gen_kv_fetch_timeout_s") == 0.0
+    assert flag("gen_kv_admit_timeout_s") == 0.0
+    assert flag("gen_kv_hedge_ms") == 0.0
+    assert flag("gen_kv_breaker") == 0
+    assert flag("gen_kv_peers") == ""
+    assert flag("gen_kv_breaker_backoff_s") > 0
+    st = KVStore(pages=4, spill=str(tmp_path))
+    import paddle_tpu.serving.kvstore as kvstore_mod
+
+    def no_thread(*a, **k):
+        raise AssertionError("defaults path spawned a fetch thread")
+
+    monkeypatch.setattr(kvstore_mod.threading, "Thread", no_thread)
+    st.put("k", b"Z" * 8)
+    assert st.get("k") == b"Z" * 8
+    assert st.get("cold-miss") is None        # cold path: still inline
+    h = st.snapshot()["health"]
+    assert all(t["state"] == "closed" for t in h.values())
+    st.close()

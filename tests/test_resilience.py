@@ -315,6 +315,46 @@ def test_quarantined_start_surfaces_typed_over_wire(model):
         srv.stop()
 
 
+def test_fused_decode_trap_is_suspect_needs_two_hits(model):
+    """Satellite: a fused-decode trap implicates EVERY stepped
+    generation — co-tenant-ambiguous attribution. One shared trap must
+    not quarantine anyone (a poison request would take its innocent
+    co-tenants down with it, even at quarantine_after=1); a second
+    independent hit on the same fingerprint convicts. Prefill traps
+    (exact) keep their configured threshold of 1 — see
+    test_quarantine_after_n_traps."""
+    with GenerationEngine(model, slots=2, max_len=32, rebuilds=8,
+                          quarantine_after=1, step_wait_s=0.03) as eng:
+        rs = np.random.RandomState(61)
+        a = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+        b = rs.randint(0, VOCAB, (5,)).astype(np.int32)
+        s0 = get_stat("gen/suspect_traps")
+        for hit in (1, 2):
+            # both streams must ride the SAME fused step when the trap
+            # fires, or attribution degenerates to exact-by-pigeonhole
+            g1, g2 = eng.start(a, 12), eng.start(b, 12)
+            assert _wait(lambda: (len(eng.poll(g1)["tokens"]) > 0
+                                  and len(eng.poll(g2)["tokens"]) > 0
+                                  and not eng.poll(g1)["done"]
+                                  and not eng.poll(g2)["done"]),
+                         timeout=10.0)
+            with fault.inject_faults({"engine.decode_step": (1.0, 1)}):
+                _, err1 = _drain(eng, g1)
+                _, err2 = _drain(eng, g2)
+            assert err1 is not None and err2 is not None
+            if hit == 1:
+                # one ambiguous hit: suspects, not convicts — the next
+                # round's eng.start(a/b) below must be admissible
+                assert eng.stats()["quarantined"] == 0
+        assert get_stat("gen/suspect_traps") >= s0 + 4
+        # two independent ambiguous hits: now both are convicted
+        with pytest.raises(RequestQuarantined):
+            eng.start(a, 4)
+        with pytest.raises(RequestQuarantined):
+            eng.start(b, 4)
+        assert eng.stats()["quarantined"] == 2
+
+
 def test_watchdog_fails_stuck_generations(model):
     """A wedged decode loop (heartbeat older than gen_watchdog_s with
     active work) gets its generations failed loudly with the resumable

@@ -86,6 +86,18 @@ Scenarios (all CPU-only, single process):
     recomputed prefill tokens (``prefill_recomputed==0``: failover
     upgraded from token replay to page transfer) and zero leaked pages
     on the survivor.
+15. **kv-campaign**: a seeded RANDOMIZED campaign over the KV failure
+    domain — each scenario draws a store topology (shared spill / one
+    shared store / peer tier), a producer/consumer role pair, hardening
+    flags (fetch deadline, hedge, breaker), and a 1-3-site fault spec
+    from the KV path, then asserts the invariants that must hold no
+    matter what the faults did: streams byte-identical to solo
+    ``generate()``, zero leaked pages, and every fired fault visible in
+    the degradation ledger (tier errors/timeouts, ``fetch_degraded``).
+    Ends with a deterministic breaker open → half-open → closed
+    lifecycle check and a no-hot-path-flag-reads defaults check.
+    ``--campaign N [--seed S]`` runs an N-scenario campaign standalone
+    (defaults checks + campaign only).
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
@@ -94,7 +106,9 @@ the pre-speculation build — and ``gen_mesh_tp``: no mesh is built by
 default, the engine's device layout is the identity and every compiled
 entry point is the plain single-device jit).
 
-Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py``. Exits nonzero
+Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py`` for the full
+suite, or ``... chaos_check.py --campaign N [--seed S]`` for an
+N-scenario randomized KV campaign standalone. Exits nonzero
 (with a JSON report on stdout) if any recovery path or stat fails — a
 scenario that raises is recorded as a failed check, never a bare
 traceback, so the harness is CI-runnable as-is.
@@ -214,6 +228,43 @@ def check_defaults_off() -> None:
           and kvs["gen_kv_store_pages"] > 0       # sane when opted in
           and kvs["gen_kv_spill_dir"] == "",      # no spill tier
           str(kvs))
+    kvh = get_flags(["gen_kv_fetch_timeout_s", "gen_kv_admit_timeout_s",
+                     "gen_kv_hedge_ms", "gen_kv_breaker",
+                     "gen_kv_breaker_backoff_s", "gen_kv_peers"])
+    check("defaults/gen_kv_hardening_off",
+          kvh["gen_kv_fetch_timeout_s"] == 0.0    # unbounded, inline
+          and kvh["gen_kv_admit_timeout_s"] == 0.0
+          and kvh["gen_kv_hedge_ms"] == 0.0       # no hedging
+          and kvh["gen_kv_breaker"] == 0          # no breakers
+          and kvh["gen_kv_breaker_backoff_s"] > 0  # sane when opted in
+          and kvh["gen_kv_peers"] == "",          # no peer tier
+          str(kvh))
+    # behavior at defaults: the store is THREAD-FREE — hedge/deadline
+    # machinery must not exist to pay for, cold fetches are inline
+    import threading as _threading
+
+    from paddle_tpu.serving.kvstore import KVStore as _KVStore
+
+    with tempfile.TemporaryDirectory(prefix="ptpu_kvdef_") as d:
+        st = _KVStore(pages=4, spill=d)
+        spawned = []
+        real_thread = _threading.Thread
+
+        def _spy_thread(*a, **k):
+            spawned.append(k.get("name", "?"))
+            return real_thread(*a, **k)
+
+        _threading.Thread = _spy_thread
+        try:
+            st.put("k", b"x" * 8)
+            got = st.get("k")
+            miss = st.get("nope")
+        finally:
+            _threading.Thread = real_thread
+            st.close()
+        check("defaults/gen_kv_hardening_threadfree",
+              not spawned and got == b"x" * 8 and miss is None,
+              f"spawned={spawned}")
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -1514,24 +1565,193 @@ def scenario_gen_hotloop(tmp: str) -> None:
                 sp.kill(ep)
 
 
-def main() -> int:
-    check_defaults_off()
-    with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
-        os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
-        for scenario in (scenario_serving_wire, scenario_checkpoint,
-                         scenario_elastic_resume, scenario_overload,
-                         scenario_obs, scenario_serving_routed,
-                         scenario_gen_engine, scenario_gen_paged,
-                         scenario_control_plane, scenario_gen_resilience,
-                         scenario_gen_spec, scenario_gen_sharded,
-                         scenario_obs_fleet, scenario_ledger,
-                         scenario_gen_disagg,
-                         scenario_gen_hotloop):
-            try:
-                scenario(tmp)
-            except Exception as e:   # a crash is a failed check, not a
-                check(f"{scenario.__name__}/completed", False,   # traceback
-                      f"{type(e).__name__}: {e}")
+def _campaign_drain(engine, gid, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gid, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            return toks, doc["error"]
+
+
+def run_campaign(n: int, seed: int, tmp: str) -> None:
+    """Seeded randomized chaos campaign over the KV failure domain.
+
+    ``n`` scenarios, each drawn from ``random.Random(seed)``: a random
+    store topology (shared spill root / one shared store object / peer
+    tier), a random producer/consumer role pair, random hardening flags
+    (fetch deadline, hedge threshold, breaker), and a random fault spec
+    of 1-3 sites from the KV path (``kvstore.get``, ``kvstore.put``,
+    ``kvstore.spill``, ``wire.kv_get``, ``fs.download``). A producer
+    engine prefills-and-publishes a prompt, then a cold consumer engine
+    serves the SAME prompt — admitting via KV fetch where the tiers
+    survive, degrading to local recompute where they do not. Invariants
+    asserted per scenario, whatever the faults did:
+
+    - both streams byte-identical to solo ``generate()`` (degradation
+      changes WHERE prefill ran, never a single byte of output);
+    - zero leaked pages on both engines;
+    - every fault that FIRED is visible in the degradation ledger (tier
+      errors/timeouts on a store, or ``fetch_degraded`` on an engine) —
+      silent slow paths are the bug this campaign exists to catch.
+
+    Ends with a deterministic breaker-lifecycle check (open →
+    backoff → half-open probe → closed, all observable in tier health)
+    and a defaults check that a hardened-flags-off engine never reads a
+    ``gen_kv_*`` flag on the hot path."""
+    import random
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.serving.kvstore import KVStore
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    rng = random.Random(seed)
+    refs: dict = {}
+
+    def ref_for(pseed, plen, new):
+        key = (pseed, plen, new)
+        if key not in refs:
+            p = np.random.RandomState(pseed).randint(
+                0, 96, (plen,)).astype(np.int32)
+            refs[key] = (p, np.asarray(generate(model, p[None],
+                                                new))[0, plen:])
+        return refs[key]
+
+    sites = ("kvstore.get", "kvstore.put", "kvstore.spill",
+             "wire.kv_get", "fs.download")
+    role_pairs = (("both", "decode"), ("prefill", "decode"),
+                  ("both", "both"))
+    topos = ("shared_spill", "shared_store", "peer")
+
+    for i in range(n):
+        tag = f"campaign/{i:02d}"
+        pseed = rng.randrange(1000)
+        plen = rng.choice((16, 24))
+        new = rng.choice((4, 6))
+        prod_role, cons_role = rng.choice(role_pairs)
+        topo = rng.choice(topos)
+        hard = dict(fetch_timeout_s=rng.choice((0.0, 0.25)),
+                    hedge_ms=rng.choice((0.0, 5.0)),
+                    breaker=rng.choice((0, 2)), breaker_backoff_s=0.05)
+        spec = {s: (rng.choice((0.3, 0.7, 1.0)), rng.choice((1, 2, 3)))
+                for s in rng.sample(sites, rng.randint(1, 3))}
+        desc = (f"topo={topo} roles={prod_role}/{cons_role} "
+                f"prompt=({pseed},{plen})+{new} hard={hard} spec={spec}")
+        prompt, ref = ref_for(pseed, plen, new)
+        stores: list = []
+        try:
+            if topo == "shared_spill":
+                spill = os.path.join(tmp, f"kvcamp{i}")
+                prod_store = KVStore(pages=64, spill=spill, **hard)
+                cons_store = KVStore(pages=64, spill=spill, **hard)
+                stores = [prod_store, cons_store]
+            elif topo == "shared_store":
+                prod_store = cons_store = KVStore(pages=64, **hard)
+                stores = [prod_store]
+            else:                      # peer tier: consumer reaches the
+                prod_store = KVStore(pages=64)       # producer directly
+                cons_store = KVStore(
+                    pages=64, spill=os.path.join(tmp, f"kvcamp{i}"),
+                    peers=(prod_store.get,), **hard)
+                stores = [prod_store, cons_store]
+            with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                                  page_tokens=8, kv_store=prod_store,
+                                  role=prod_role) as prod, \
+                 GenerationEngine(model, slots=2, max_len=64, paged=True,
+                                  page_tokens=8, kv_store=cons_store,
+                                  role=cons_role) as cons:
+                with fault.inject_faults(spec, seed=seed * 1000 + i):
+                    pt, pe = _campaign_drain(prod, prod.start(prompt, new))
+                    ct, ce = _campaign_drain(cons, cons.start(prompt, new))
+                    fired = {s: f for s, (_, f)
+                             in fault.site_counts().items() if f}
+                check(f"{tag}/streams_byte_identical",
+                      pe is None and ce is None
+                      and np.array_equal(np.asarray(pt, np.int32), ref)
+                      and np.array_equal(np.asarray(ct, np.int32), ref),
+                      f"{desc} perr={pe} cerr={ce}")
+                leaks = []
+                for who, eng in (("producer", prod), ("consumer", cons)):
+                    g = eng.stats()
+                    if g["pages_free"] + g["prefix_entries"] != g["pages"]:
+                        leaks.append((who, g["pages_free"],
+                                      g["prefix_entries"], g["pages"]))
+                check(f"{tag}/zero_leaked_pages", not leaks,
+                      f"{desc} leaks={leaks}")
+                booked = sum(s["errors"] + s["timeouts"]
+                             for s in (st.snapshot() for st in stores))
+                booked += sum(eng.stats()["kv"]["fetch_degraded"]
+                              for eng in (prod, cons))
+                check(f"{tag}/degradation_explained",
+                      not fired or booked > 0,
+                      f"{desc} fired={fired} booked={booked}")
+        finally:
+            for st in stores:
+                st.close()
+
+    # deterministic tail: the full breaker lifecycle, observable in tier
+    # health — consecutive spill failures OPEN the breaker (the store
+    # stops being placeable), the backoff elapses, ONE half-open probe
+    # goes through, and a clean answer CLOSES it again
+    st = KVStore(pages=8, spill=os.path.join(tmp, "kvcamp_breaker"),
+                 breaker=2, breaker_backoff_s=0.05)
+    try:
+        st.put("warm", b"W" * 8)
+        with fault.inject_faults({"kvstore.spill": 1.0}, seed=11):
+            for k in ("c1", "c2", "c3"):
+                st.get(k)
+        h = st.snapshot()["health"]["spill"]
+        check("campaign/breaker_opens",
+              h["opens"] == 1 and h["state"] in ("open", "half_open")
+              and not st.placeable, str(h))
+        time.sleep(0.12)               # backoff elapses -> probe window
+        st.get("c1")                   # clean absence closes the tier
+        h = st.snapshot()["health"]["spill"]
+        check("campaign/breaker_half_opens_then_closes",
+              h["half_opens"] >= 1 and h["closes"] == 1
+              and h["state"] == "closed" and st.placeable, str(h))
+    finally:
+        st.close()
+
+    # defaults: a hardened-flags-off engine serves byte-identical and
+    # never reads a gen_kv_* flag on the hot path (construction only)
+    import paddle_tpu.serving.engine as engine_mod
+
+    prompt, ref = ref_for(3, 16, 4)
+    reads: list = []
+    real_flag = engine_mod.flag
+    engine_mod.flag = lambda name: (reads.append(name), real_flag(name))[1]
+    try:
+        with GenerationEngine(model, slots=2, max_len=64, paged=True,
+                              page_tokens=8) as eng:
+            ctor = [r for r in reads if r.startswith("gen_kv")]
+            del reads[:]
+            toks, err = _campaign_drain(eng, eng.start(prompt, 4))
+            hot = [r for r in reads if r.startswith("gen_kv")]
+    finally:
+        engine_mod.flag = real_flag
+    check("campaign/defaults_no_hot_path_flag_reads",
+          err is None and np.array_equal(np.asarray(toks, np.int32), ref)
+          and ctor and not hot,
+          f"err={err} ctor_reads={len(ctor)} hot_reads={hot}")
+
+
+def scenario_kv_campaign(tmp: str) -> None:
+    """A small fixed slice of the randomized KV chaos campaign (see
+    ``run_campaign``): 5 scenarios at seed 0, plus the deterministic
+    breaker-lifecycle and defaults tails. ``--campaign N --seed S``
+    runs a larger campaign standalone."""
+    run_campaign(5, 0, tmp)
+
+
+def _report() -> int:
     ok = all(c[1] for c in CHECKS)
     print(json.dumps({
         "ok": ok,
@@ -1540,9 +1760,46 @@ def main() -> int:
                      for n, p, d in CHECKS if not p],
         "stats": {k: v for k, v in monitor.export_stats().items()
                   if k.split("/")[0] in ("wire", "ckpt", "fault", "train",
-                                         "serving", "gen", "control")},
+                                         "serving", "gen", "control",
+                                         "kv")},
     }, indent=2))
     return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    campaign_n = None
+    seed = 0
+    if "--campaign" in argv:
+        campaign_n = int(argv[argv.index("--campaign") + 1])
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    check_defaults_off()
+    with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
+        os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
+        if campaign_n is not None:     # campaign-only run: defaults +
+            try:                       # the randomized KV campaign
+                run_campaign(campaign_n, seed, tmp)
+            except Exception as e:
+                check("run_campaign/completed", False,
+                      f"{type(e).__name__}: {e}")
+            return _report()
+        for scenario in (scenario_serving_wire, scenario_checkpoint,
+                         scenario_elastic_resume, scenario_overload,
+                         scenario_obs, scenario_serving_routed,
+                         scenario_gen_engine, scenario_gen_paged,
+                         scenario_control_plane, scenario_gen_resilience,
+                         scenario_gen_spec, scenario_gen_sharded,
+                         scenario_obs_fleet, scenario_ledger,
+                         scenario_gen_disagg,
+                         scenario_gen_hotloop,
+                         scenario_kv_campaign):
+            try:
+                scenario(tmp)
+            except Exception as e:   # a crash is a failed check, not a
+                check(f"{scenario.__name__}/completed", False,   # traceback
+                      f"{type(e).__name__}: {e}")
+    return _report()
 
 
 if __name__ == "__main__":
