@@ -506,6 +506,34 @@ define_flag("control_burn_threshold", 1.0,
             "Burn-rate level both windows must exceed before TTFT "
             "pressure fires (1.0 = consuming the error budget exactly "
             "at the allowed rate)")
+define_flag("control_ha_lease_dir", "",
+            "Control-plane HA root: a shared directory (or ptfs:// "
+            "WireFS path) holding the leader lease file and the durable "
+            "fleet-state journal (serving/ha.py). Non-empty turns the "
+            "controller into one of N lease contenders: exactly one "
+            "acts, standbys take over within one TTL, and a new leader "
+            "replays the journal to the exact managed set. Empty — the "
+            "default — disables HA entirely: no lease probes, no "
+            "journal writes, byte-identical to the single-controller "
+            "build. Read only at controller construction")
+define_flag("control_ha_lease_ttl_s", 3.0,
+            "Leader lease TTL: the holder renews once per controller "
+            "tick; standbys treat a lease older than this as expired "
+            "and claim it with a bumped term. Must comfortably exceed "
+            "control_interval_s (a leader that cannot renew within one "
+            "TTL is deposed). Only read once control_ha_lease_dir is "
+            "set")
+define_flag("control_ha_holder", "",
+            "Stable identity this controller claims the lease under "
+            "(shows in the lease file, journal records, and the "
+            "leader/term health block). Empty — the default — derives "
+            "host:pid:nonce. Only read once control_ha_lease_dir is "
+            "set")
+define_flag("control_ha_compact_records", 256,
+            "Journal records accumulated before the leader compacts "
+            "the fleet-state journal into a checkpoint snapshot "
+            "(replay cost stays bounded). Only read once "
+            "control_ha_lease_dir is set")
 define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "

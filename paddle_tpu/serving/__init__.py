@@ -30,6 +30,10 @@ from paddle_tpu.serving.engine import (
     EngineOverloaded, Generation, GenerationEngine, GenerationExpired,
     RequestQuarantined,
 )
+from paddle_tpu.serving.ha import (
+    ControlService, FencedSpawner, FleetJournal, FleetState, LeaderLease,
+    StaleEpochError, control_dump,
+)
 from paddle_tpu.serving.layout import DeviceLayout
 from paddle_tpu.serving.ledger import GoodputMeter, RequestLedger, TenantBook
 from paddle_tpu.serving.metrics import MetricsHub, hist_delta
@@ -44,4 +48,6 @@ __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "ControlDecision", "ReplicaSpawner", "InProcSpawner",
            "SubprocessSpawner", "RequestQuarantined", "GenerationExpired",
            "StreamResumeExhausted", "MetricsHub", "hist_delta",
-           "DeviceLayout", "RequestLedger", "GoodputMeter", "TenantBook"]
+           "DeviceLayout", "RequestLedger", "GoodputMeter", "TenantBook",
+           "LeaderLease", "FleetJournal", "FleetState", "FencedSpawner",
+           "StaleEpochError", "ControlService", "control_dump"]

@@ -69,17 +69,41 @@ decision instead of calling the spawner, lets one half-open trial
 through when the backoff elapses, and closes the breaker on the first
 success. The fleet degrades predictably instead of hot-looping spawns.
 
+High availability (``FLAGS_control_ha_lease_dir``, hard-off): N
+controllers contend for a file-based leader lease (``serving/ha.py``)
+on a shared directory or ``ptfs://`` root — exactly one acts per tick,
+standbys take over within one TTL. The leader write-ahead journals
+every fleet mutation (spawn/adopt/remove/register_model/drain), so a
+newly-elected leader replays to the exact managed set and registry,
+probes journaled endpoints over the never-shed ``health`` op, ADOPTS
+the live ones (streams untouched), replaces the dead, and resumes any
+in-progress sticky drain. Every spawner action is fenced on the
+leader's (holder, term): a deposed leader's queued spawn/stop raises
+the typed ``StaleEpochError`` and is recorded as a ``fenced`` decision,
+never executed. With the flag empty (the default) none of this exists:
+no lease probes, no journal bytes, no extra thread — byte-identical to
+the single-controller build.
+
 Observability: ``control/replicas`` gauge; ``control/ticks`` /
 ``control/scale_ups`` / ``control/scale_downs`` / ``control/replaced`` /
 ``control/model_evictions`` / ``control/model_faults`` /
 ``control/drain_forced`` / ``control/spawn_failures`` /
 ``control/spawn_breaker_opened`` / ``control/spawn_skipped`` counters;
+``control/ha_acquired`` / ``control/ha_renewals`` /
+``control/ha_takeovers`` / ``control/ha_adopted`` /
+``control/ha_deposed`` / ``control/ha_fenced`` /
+``control/ha_standby_ticks`` / ``control/ha_drains_resumed`` /
+``control/ha_journal_records`` / ``control/ha_journal_errors`` /
+``control/ha_compactions`` / ``control/ha_lost_spawns`` counters;
 ``control/drain_s`` histogram; ``control/tick`` / ``control/scale_up`` /
 ``control/drain`` spans.
 """
 
 from __future__ import annotations
 
+import os
+import random as _random_mod
+import signal as _signal
 import subprocess
 import sys
 import threading
@@ -96,6 +120,10 @@ from paddle_tpu.core.monitor import observe, stat_add, stat_set
 from paddle_tpu.io.serving import (
     InferenceClient, InferenceServer, ModelBusyError,
 )
+from paddle_tpu.serving.ha import (
+    ControlService, FencedSpawner, FleetJournal, FleetState, LeaderLease,
+    StaleEpochError,
+)
 from paddle_tpu.serving.metrics import MetricsHub
 from paddle_tpu.serving.router import RoutedClient
 
@@ -103,6 +131,16 @@ __all__ = ["ServingController", "ControlDecision", "ReplicaSpawner",
            "InProcSpawner", "SubprocessSpawner"]
 
 _log = get_logger()
+
+_jitter_rng = _random_mod.Random()
+
+
+def _jittered(base: float) -> float:
+    """U[0.9, 1.1) x base — decorrelates N controllers' (and routers')
+    probe cadence so standbys don't synchronize their health scrapes
+    into a thundering herd on the leader's fleet (the PR-8 shed-jitter
+    idiom, tighter band: a cadence, not a backoff)."""
+    return base * (0.9 + 0.2 * _jitter_rng.random())
 
 
 @dataclass
@@ -114,7 +152,8 @@ class ControlDecision:
 
     action: str                  # scale_up | scale_down | hold | evict |
     #                              fault_in | replace | spawn_failed |
-    #                              spawn_breaker
+    #                              spawn_breaker | adopt | fenced |
+    #                              deposed | drain_resume
     reason: str
     endpoint: str | None = None
     clean: bool = True           # drains: finished inside the deadline?
@@ -141,6 +180,17 @@ class ReplicaSpawner:
     def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
         raise NotImplementedError                # pragma: no cover
 
+    def adopt(self, endpoint: str, pid: int | None = None) -> None:
+        """Take responsibility for an already-running replica this
+        spawner did not create — a newly-elected HA leader adopting the
+        previous leader's fleet from the journal. Default: nothing to
+        track (a k8s spawner would look the pod up by endpoint)."""
+
+    def pid_of(self, endpoint: str) -> int | None:
+        """OS pid of a replica this spawner tracks (journaled so an
+        adopting leader can escalate a stop); None when not a process."""
+        return None
+
 
 class InProcSpawner(ReplicaSpawner):
     """Replicas are :class:`~paddle_tpu.io.serving.InferenceServer`
@@ -153,6 +203,7 @@ class InProcSpawner(ReplicaSpawner):
         self._factory = factory
         self._lock = threading.Lock()
         self.servers: dict[str, InferenceServer] = {}
+        self.adopted: set[str] = set()
 
     def spawn(self) -> str:
         srv = self._factory()
@@ -162,11 +213,27 @@ class InProcSpawner(ReplicaSpawner):
             self.servers[srv.endpoint] = srv
         return srv.endpoint
 
+    def adopt(self, endpoint: str, pid: int | None = None) -> None:
+        """An adopted replica has no server object here (it lives in
+        another controller's spawner or was started by hand); stop
+        falls back to the wire ``stop_server`` op."""
+        with self._lock:
+            self.adopted.add(endpoint)
+
     def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
         with self._lock:
             srv = self.servers.pop(endpoint, None)
+            adopted = endpoint in self.adopted
+            self.adopted.discard(endpoint)
         if srv is not None:
             srv.stop(drain_s=drain_s if drain_s > 0 else None)
+        elif adopted:
+            try:
+                with InferenceClient(endpoint, timeout=5.0,
+                                     retries=0) as c:
+                    c.stop_server()
+            except (ConnectionError, RuntimeError, OSError):
+                pass
 
     def kill(self, endpoint: str) -> None:
         """Hard stop — sockets severed, no drain (a crash, for chaos)."""
@@ -192,6 +259,7 @@ class SubprocessSpawner(ReplicaSpawner):
         self._extra = tuple(extra_args)
         self._lock = threading.Lock()
         self.procs: dict[str, subprocess.Popen] = {}
+        self.adopted_pids: dict[str, int | None] = {}
 
     def spawn(self) -> str:
         cmd = [sys.executable, "-m", "paddle_tpu.serving.replica_main"]
@@ -216,33 +284,80 @@ class SubprocessSpawner(ReplicaSpawner):
             self.procs[endpoint] = proc
         return endpoint
 
+    def adopt(self, endpoint: str, pid: int | None = None) -> None:
+        """Track a replica process another controller spawned (the pid
+        comes from the HA journal; a newly-elected leader has no Popen
+        handle). stop/kill then go over the wire, escalating by pid."""
+        with self._lock:
+            if endpoint not in self.procs:
+                self.adopted_pids[endpoint] = pid
+
+    def pid_of(self, endpoint: str) -> int | None:
+        with self._lock:
+            proc = self.procs.get(endpoint)
+            if proc is not None:
+                return proc.pid
+            return self.adopted_pids.get(endpoint)
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
     def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
         with self._lock:
             proc = self.procs.pop(endpoint, None)
-        if proc is None:
+            adopted = endpoint in self.adopted_pids
+            pid = self.adopted_pids.pop(endpoint, None)
+        if proc is None and not adopted:
             return
         try:                             # graceful: wire stop op drains
             with InferenceClient(endpoint, timeout=5.0, retries=0) as c:
                 c.stop_server()
         except (ConnectionError, RuntimeError, OSError):
             pass
-        try:
-            proc.wait(timeout=max(drain_s, 0.0) + 10.0)
-        except subprocess.TimeoutExpired:
-            proc.terminate()
+        if proc is not None:
             try:
-                proc.wait(timeout=5.0)
+                proc.wait(timeout=max(drain_s, 0.0) + 10.0)
             except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.wait()
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            return
+        if pid is None:                  # adopted without a pid: the
+            return                       # wire stop is all we have
+        # adopted: no Popen handle — poll the journaled pid, escalate
+        deadline = time.monotonic() + max(drain_s, 0.0) + 10.0
+        while time.monotonic() < deadline and self._pid_alive(pid):
+            time.sleep(0.1)
+        for sig in (_signal.SIGTERM, _signal.SIGKILL):
+            if not self._pid_alive(pid):
+                return
+            try:
+                os.kill(pid, sig)
+            except OSError:
+                return
+            time.sleep(0.5)
 
     def kill(self, endpoint: str) -> None:
         """SIGKILL the replica process — no drain, no goodbye."""
         with self._lock:
             proc = self.procs.pop(endpoint, None)
+            pid = self.adopted_pids.pop(endpoint, None)
         if proc is not None:
             proc.kill()
             proc.wait()
+        elif pid is not None:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
 
 
 class ServingController:
@@ -288,6 +403,9 @@ class ServingController:
                  burn_fast_ticks: int | None = None,
                  burn_slow_ticks: int | None = None,
                  burn_threshold: float | None = None,
+                 ha_lease_dir: str | None = None,
+                 ha_lease_ttl_s: float | None = None,
+                 ha_holder: str | None = None,
                  decisions_max: int = 256):
         def _f(v, name):
             return flag(name) if v is None else v
@@ -345,6 +463,25 @@ class ServingController:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._closed = False
+        # --- control-plane HA (FLAGS_control_ha_lease_dir, hard-off):
+        # with the flag empty nothing below exists — no lease file IO,
+        # no journal, no fencing wrapper, and tick() never gates
+        self.ha_lease_dir = str(_f(ha_lease_dir,
+                                   "control_ha_lease_dir") or "")
+        self._lease: LeaderLease | None = None
+        self._journal: FleetJournal | None = None
+        self._service: ControlService | None = None
+        self._draining: str | None = None
+        if self.ha_lease_dir:
+            self._lease = LeaderLease(
+                self.ha_lease_dir,
+                ttl_s=float(_f(ha_lease_ttl_s, "control_ha_lease_ttl_s")),
+                holder=str(_f(ha_holder, "control_ha_holder") or "")
+                or None)
+            self._journal = FleetJournal(
+                self.ha_lease_dir,
+                compact_records=int(flag("control_ha_compact_records")))
+            self._spawner = FencedSpawner(spawner, self._lease)
         for ep in endpoints:
             self._router.add_endpoint(ep)
 
@@ -358,6 +495,8 @@ class ServingController:
         ``warm_models`` residency cap."""
         with self._lock:
             self._registry[name] = {"path": path, "warm": bool(warm)}
+        self._journal_rec("register_model", name=name, path=path,
+                          warm=bool(warm))
         if warm:
             try:
                 self._router.load_model(name, path)
@@ -468,6 +607,37 @@ class ServingController:
         with self._lock:
             return [d.as_dict() for d in self._decisions]
 
+    def control_dump(self, last: int | None = None) -> dict[str, Any]:
+        """The wire-shaped controller introspection doc served by
+        :class:`~paddle_tpu.serving.ha.ControlService`: the decision
+        ring (optionally the last N), the managed set and registry, and
+        the leader/term block when HA is on — decisions no longer die
+        with the controller process (``tools/obs_dump.py --control``)."""
+        with self._lock:
+            ds = [d.as_dict() for d in self._decisions]
+            managed = sorted(self._managed)
+            registry = {n: dict(s) for n, s in self._registry.items()}
+        if last is not None and last > 0:
+            ds = ds[-last:]
+        doc: dict[str, Any] = {
+            "decisions": ds, "managed": managed, "registry": registry,
+            "endpoints": self._router.endpoints(),
+        }
+        if self._lease is not None:
+            doc["leader"] = {"leading": self._lease.leading,
+                             "holder": self._lease.holder,
+                             "term": self._lease.term}
+        return doc
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Expose :meth:`control_dump` over the wire (the
+        ``control_dump`` frame op); returns the service endpoint.
+        Stopped by :meth:`close`."""
+        if self._service is None:
+            self._service = ControlService(self, host, port)
+            self._service.start()
+        return self._service.endpoint
+
     def _record(self, d: ControlDecision) -> None:
         with self._lock:
             self._decisions.append(d)
@@ -480,17 +650,192 @@ class ServingController:
             raise ConnectionError(f"{ep} is not a member")
         return self._router._client(r)
 
+    # -- control-plane HA --------------------------------------------------
+    @property
+    def lease(self) -> LeaderLease | None:
+        """The leader lease when HA is on (tests and dashboards read
+        leading/term through it); None at the flag default."""
+        return self._lease
+
+    def _journal_rec(self, op: str, **fields: Any) -> None:
+        """Write-ahead journal a fleet mutation — leaders only (a
+        standby writing would interleave with the leader's compaction).
+        Appends are fsync'd before the caller acts; a journal failure
+        is counted and logged loudly, never silently dropped."""
+        if self._journal is None or self._lease is None \
+                or not self._lease.leading:
+            return
+        if not self._lease.is_current():
+            # the journal is an actuator too: a deposed leader whose
+            # local flag is stale must not interleave records with the
+            # successor's compaction
+            stat_add("control/ha_fenced")
+            _log.warning("control-ha: journal %s fenced (deposed)", op)
+            return
+        fields.setdefault("term", self._lease.term)
+        try:
+            self._journal.append(op, **fields)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            stat_add("control/ha_journal_errors")
+            _log.warning("control-ha: journal %s failed: %s", op, e)
+
+    def _ha_fenced(self, action: str, reason: str,
+                   signals: dict[str, Any], e: BaseException,
+                   endpoint: str | None = None) -> ControlDecision:
+        """A spawner action was rejected at the actuator because the
+        lease names a newer (holder, term): record the typed decision —
+        the deposed leader's intent is explainable, never executed."""
+        d = ControlDecision(
+            "fenced", endpoint=endpoint, ts=time.time(), signals=signals,
+            reason=f"{reason}; {action} rejected by epoch fence: {e}")
+        self._record(d)
+        return d
+
+    def _probe_alive(self, ep: str) -> dict | None:
+        """One never-shed health probe; the doc when the endpoint is
+        up, None when it is not (adoption-time liveness check)."""
+        try:
+            with InferenceClient(ep, timeout=5.0, retries=0) as c:
+                doc = c.health(stats=False)
+            return doc if doc.get("status") == "ok" else None
+        except (ConnectionError, RuntimeError, OSError):
+            return None
+
+    def _ha_state(self) -> FleetState:
+        """The live fleet state as a journal checkpoint snapshot."""
+        st = FleetState()
+        with self._lock:
+            managed = sorted(self._managed)
+            st.registry = {n: dict(s) for n, s in self._registry.items()}
+        for ep in managed:
+            st.managed[ep] = {"pid": self._spawner.pid_of(ep)}
+        st.draining = self._draining
+        return st
+
+    def _ha_gate(self) -> ControlDecision | None:
+        """Per-tick leadership step: renew when leading (deposed → step
+        aside, replicas untouched — the successor adopts them), acquire
+        + take over when the lease is free, hold as a standby
+        otherwise. None means this controller leads and the reconcile
+        pass should run."""
+        lease = self._lease
+        if lease.leading:
+            if lease.renew():
+                stat_add("control/ha_renewals")
+                return None
+            stat_add("control/ha_deposed")
+            cur = lease.peek() or {}
+            d = ControlDecision(
+                "deposed", ts=time.time(),
+                reason=f"lease lost to ({cur.get('holder')!r}, term "
+                       f"{cur.get('term')}); stepping aside — managed "
+                       "replicas left running for the successor to "
+                       "adopt")
+            self._record(d)
+            return d
+        if lease.try_acquire():
+            stat_add("control/ha_acquired")
+            self._ha_takeover()
+            return None
+        stat_add("control/ha_standby_ticks")
+        cur = lease.peek() or {}
+        return ControlDecision(
+            "hold", ts=time.time(),
+            reason=f"standby: lease held by ({cur.get('holder')!r}, "
+                   f"term {cur.get('term')})",
+            signals={"leading": False, "term": lease.term})
+
+    def _ha_takeover(self) -> None:
+        """Newly-elected leader: replay the journal to the previous
+        leader's exact fleet, probe every journaled endpoint, adopt the
+        live ones (their streams are untouched — routing membership and
+        the managed set are restored around them), replace the dead,
+        resume any in-progress drain, and bootstrap up to
+        ``min_replicas``."""
+        stat_add("control/ha_takeovers")
+        state = self._journal.replay()
+        if state.lost_spawns:
+            # spawn intents that never reported an endpoint: the old
+            # leader died inside the spawner — unaddressable by replay,
+            # surfaced instead of silently forgotten
+            stat_add("control/ha_lost_spawns", state.lost_spawns)
+            _log.warning("control-ha: %d journaled spawn intent(s) "
+                         "never reported an endpoint",
+                         state.lost_spawns)
+        with self._lock:
+            for name, spec in state.registry.items():
+                self._registry.setdefault(name, dict(spec))
+        try:
+            members = set(self._router.endpoints())
+            for ep, meta in sorted(state.managed.items()):
+                if self._probe_alive(ep) is not None:
+                    self._spawner.adopt(ep, pid=meta.get("pid"))
+                    if ep not in members:
+                        self._router.add_endpoint(ep)
+                    with self._lock:
+                        self._managed.add(ep)
+                    stat_add("control/ha_adopted")
+                    self._journal_rec("adopt", ep=ep,
+                                      pid=meta.get("pid"))
+                    self._record(ControlDecision(
+                        "adopt", endpoint=ep, ts=time.time(),
+                        reason=f"takeover (term {self._lease.term}): "
+                               "journaled replica alive — adopted, "
+                               "streams untouched"))
+                else:
+                    self._journal_rec("remove", ep=ep)
+                    self._record(ControlDecision(
+                        "replace", endpoint=ep, ts=time.time(),
+                        reason=f"takeover (term {self._lease.term}): "
+                               "journaled replica dead"))
+                    stat_add("control/replaced")
+                    self._scale_up("replacing dead replica found at "
+                                   "takeover", {})
+            if state.draining is not None:
+                with self._lock:
+                    resumable = state.draining in self._managed
+                if resumable:
+                    stat_add("control/ha_drains_resumed")
+                    self._record(ControlDecision(
+                        "drain_resume", endpoint=state.draining,
+                        ts=time.time(),
+                        reason="takeover: previous leader journaled an "
+                               "unfinished sticky drain — resuming"))
+                    self.scale_down(
+                        victim=state.draining,
+                        reason="resuming drain journaled by previous "
+                               "leader")
+            while len(self._router.endpoints()) < self.min_replicas:
+                if self._scale_up("bootstrap to min_replicas",
+                                  {}).action != "scale_up":
+                    break
+            with self._lock:
+                self._last_scale = 0.0   # takeover is not a reactive
+                #                          scale event: no cooldown
+        except StaleEpochError as e:     # deposed mid-takeover: the
+            self._ha_fenced("takeover", # newer leader finishes the job
+                            f"takeover term {self._lease.term}", {}, e)
+            return
+        try:     # a takeover is a natural checkpoint: bound the next
+            self._journal.compact(self._ha_state())   # leader's replay
+        except (ConnectionError, RuntimeError, OSError) as e:
+            stat_add("control/ha_journal_errors")
+            _log.warning("control-ha: takeover compaction failed: %s", e)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServingController":
         """Spawn up to ``min_replicas`` (counting adopted endpoints) and
-        start the reconcile loop (``interval_s > 0``)."""
-        while len(self._router.endpoints()) < self.min_replicas:
-            if self._scale_up("bootstrap to min_replicas",
-                              {}).action != "scale_up":
-                break
-        with self._lock:
-            self._last_scale = 0.0   # bootstrap is not a reactive scale
-            #                          event; it must not arm the cooldown
+        start the reconcile loop (``interval_s > 0``). With HA on the
+        bootstrap is deferred to leadership: a standby must not spawn —
+        the leader bootstraps at takeover."""
+        if self._lease is None:
+            while len(self._router.endpoints()) < self.min_replicas:
+                if self._scale_up("bootstrap to min_replicas",
+                                  {}).action != "scale_up":
+                    break
+            with self._lock:
+                self._last_scale = 0.0   # bootstrap is not a reactive
+                #                  scale event; it must not arm cooldown
         if self.interval_s > 0 and self._thread is None:
             self._thread = threading.Thread(target=self._loop,
                                             daemon=True,
@@ -508,16 +853,28 @@ class ServingController:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=max(self.interval_s * 2, 2.0))
+        if self._service is not None:
+            self._service.stop()
+            self._service = None
         if stop_replicas:
             with self._lock:
                 eps = list(self._managed)
                 self._managed.clear()
             for ep in eps:
+                self._journal_rec("remove", ep=ep)
                 try:
                     self._router.remove_endpoint(ep)
                     self._spawner.stop(ep, drain_s=min(self.drain_s, 2.0))
                 except (ConnectionError, RuntimeError, OSError):
+                    # StaleEpochError lands here too: a deposed
+                    # controller's close must not stop the successor's
+                    # adopted replicas
                     pass
+        if self._lease is not None:
+            self._lease.release()
+            self._lease.close()
+        if self._journal is not None:
+            self._journal.close()
         if self._own_router:
             self._router.close()
 
@@ -529,7 +886,7 @@ class ServingController:
         return False
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        while not self._stop.wait(_jittered(self.interval_s)):
             try:
                 self.tick()
             except Exception:            # pragma: no cover - never dies
@@ -546,6 +903,18 @@ class ServingController:
             if self._closed:
                 return ControlDecision("hold", "controller closed",
                                        ts=time.time())
+        if self._lease is not None:
+            # leadership first: standbys (and a just-deposed leader)
+            # return here without touching the fleet
+            gate = self._ha_gate()
+            if gate is not None:
+                return gate
+            if self._journal.should_compact():
+                try:
+                    self._journal.compact(self._ha_state())
+                except (ConnectionError, RuntimeError, OSError) as e:
+                    stat_add("control/ha_journal_errors")
+                    _log.warning("control-ha: compaction failed: %s", e)
         with self._lock, _trace.span("control/tick"):
             stat_add("control/ticks")
             healths = self._router.health(stats_prefix="gen/",
@@ -577,11 +946,16 @@ class ServingController:
                 reason=f"unreachable for {n} consecutive ticks: "
                        f"{(doc or {}).get('error', 'no probe')}"))
             stat_add("control/replaced")
+            self._journal_rec("remove", ep=ep)
             self._router.remove_endpoint(ep)
             with self._lock:
                 self._managed.discard(ep)
             try:
                 self._spawner.stop(ep, drain_s=0.0)
+            except StaleEpochError as e:
+                self._ha_fenced("stop", "replacing dead replica", {},
+                                e, endpoint=ep)
+                return               # deposed: successor heals the rest
             except (ConnectionError, RuntimeError, OSError):
                 pass
             self._scale_up("replacing dead replica", {})
@@ -627,6 +1001,12 @@ class ServingController:
             "ttft_burn_fast": burn_fast,
             "ttft_burn_slow": burn_slow,
         }
+        if self._lease is not None:
+            # leadership travels with every decision's evidence: who
+            # made this call, under which term
+            out["leader"] = {"leading": self._lease.leading,
+                             "holder": self._lease.holder,
+                             "term": self._lease.term}
         kv = self._hub.fleet_kv()
         if kv is not None:
             # disaggregated-serving visibility: the fleet KV hit rate and
@@ -801,9 +1181,17 @@ class ServingController:
                 return d
             try:
                 _fault.inject("control.spawn")
+                # WAL: the intent is durable before the spawner acts —
+                # a leader dying inside spawn() leaves a journaled
+                # intent its successor surfaces as a lost spawn
+                self._journal_rec("spawn_intent")
                 ep = self._spawner.spawn()
+            except StaleEpochError as e:
+                return self._ha_fenced("spawn", reason, signals, e)
             except Exception as e:
                 return self._spawn_failed(reason, signals, e)
+            self._journal_rec("spawn", ep=ep,
+                              pid=self._spawner.pid_of(ep))
             with self._lock:         # half-open trial succeeded (or the
                 self._spawn_fails = 0     # breaker was never tripped):
                 self._spawn_open_until = 0.0   # close the breaker
@@ -864,17 +1252,29 @@ class ServingController:
         deadline = self.drain_s if drain_s is None else float(drain_s)
         with _trace.span("control/drain", endpoint=victim):
             t0 = time.monotonic()
+            # WAL: the drain is durable before the cordon — a leader
+            # dying mid-drain leaves its successor a journaled victim
+            # to resume waiting on (inflight==0 && undelivered==0)
+            self._journal_rec("drain_begin", ep=victim)
+            self._draining = victim
             self._router.cordon(victim)
             clean = self._await_drained(victim, deadline)
             took = time.monotonic() - t0
             observe("control/drain_s", took)
             if not clean:
                 stat_add("control/drain_forced")
+            self._journal_rec("remove", ep=victim)
             try:
                 self._spawner.stop(victim,
                                    drain_s=max(deadline - took, 0.5))
+            except StaleEpochError as e:
+                self._draining = None
+                return self._ha_fenced("stop", reason, signals or {},
+                                       e, endpoint=victim)
             except (ConnectionError, RuntimeError, OSError) as e:
                 _log.warning("control: stop of %s failed: %s", victim, e)
+            self._journal_rec("drain_end", ep=victim, clean=clean)
+            self._draining = None
             self._router.remove_endpoint(victim)
             with self._lock:
                 self._managed.discard(victim)

@@ -1,0 +1,499 @@
+"""Control-plane high availability: leased leadership, a durable
+fleet-state journal, and split-brain fencing for the serving
+controller.
+
+Reference role: the coordinator-liveness half of the reference fleet
+stack — heartbeat + barrier + elastic master restart kept the trainer
+coordinator from being a silent single point of failure. paddle_tpu's
+:class:`~paddle_tpu.serving.control.ServingController` had the same
+hole: one in-process object whose whole fleet state (managed set,
+model registry, decision ring, in-progress drains) died with it while
+orphaned ``replica_main`` subprocesses served forever. This module is
+the remedy, layered on substrates the repo already ships:
+
+- :class:`LeaderLease` — a file-based lease on a shared directory or a
+  ``ptfs://`` WireFS root (the same substrate the KV store spills to).
+  N controllers run; the one holding the lease acts, the rest tick as
+  standbys and claim the lease — with a bumped **term** — once it goes
+  a TTL without renewal. Acquisition is write-then-read-back over an
+  atomic rename, which resolves most races; the residual window where
+  two claimants briefly both believe (file leases have no CAS) is
+  closed at the *actuator* by :class:`FencedSpawner`, not here — the
+  lease provides liveness, fencing provides safety.
+- :class:`FleetJournal` — an append-only JSON-lines journal of every
+  fleet-mutating action (``spawn``/``adopt``/``remove``/
+  ``register_model``/``drain_begin``/``drain_end``), fsync'd before
+  the action it records, compacted into a checkpoint snapshot once it
+  grows past ``FLAGS_control_ha_compact_records``. :meth:`replay`
+  folds checkpoint + journal (tolerating a torn final line — the
+  previous leader died mid-append) back into the exact managed set,
+  registry, and any drain in progress.
+- :class:`FencedSpawner` — wraps a ``ReplicaSpawner`` so every
+  spawn/stop/kill/adopt first validates the caller's (holder, term)
+  against the lease file and raises the typed :class:`StaleEpochError`
+  when a newer leader holds it: a deposed leader's queued actions are
+  rejected at the actuator (no double-spawn, no stop-by-zombie).
+- :class:`ControlService` — a tiny frame service exposing the
+  controller's :class:`~paddle_tpu.serving.control.ControlDecision`
+  ring (plus leader/term and the managed set) over the wire as a
+  ``control_dump`` op, so ``tools/obs_dump.py`` can report WHY the
+  fleet scaled even across a takeover; :func:`control_dump` is the
+  client half.
+
+Everything here is constructed only when ``FLAGS_control_ha_lease_dir``
+is non-empty; the flag-default controller never imports a lease, never
+writes a journal byte, and spawns no extra thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket as _socket
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.logging import get_logger
+from paddle_tpu.core.monitor import stat_add
+from paddle_tpu.core.wire import FrameClient, FrameService, send_frame
+from paddle_tpu.io.fs import CHUNK_BYTES, fs_for_path, is_remote_path
+
+__all__ = ["LeaderLease", "FleetJournal", "FleetState", "FencedSpawner",
+           "StaleEpochError", "ControlService", "control_dump",
+           "CONTROL_OPS"]
+
+_log = get_logger()
+
+LEASE_FILE = "lease.json"
+JOURNAL_FILE = "journal.jsonl"
+STATE_FILE = "state.json"
+
+
+class StaleEpochError(RuntimeError):
+    """A fleet actuation carried a (holder, term) the lease no longer
+    names — the caller was deposed; the action must not execute."""
+
+
+class _Store:
+    """Byte-level lease/journal IO over the HA root: a local shared
+    directory (fsync'd writes, atomic rename replace) or a ``ptfs://``
+    WireFS endpoint (durability is the storage node's write+close; the
+    atomic replace is the server-side rename)."""
+
+    def __init__(self, root: str):
+        self.root = str(root).rstrip("/")
+        self._remote = is_remote_path(self.root)
+        self._fs = fs_for_path(self.root) if self._remote else None
+        if self._remote:
+            self._fs.mkdirs(self.root)
+        else:
+            os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        if self._remote:
+            return f"{self.root}/{name}"
+        return os.path.join(self.root, name)
+
+    def read(self, name: str) -> bytes | None:
+        p = self._path(name)
+        if self._remote:
+            try:
+                out, offset = b"", 0
+                while True:
+                    h, data = self._fs._client._request(
+                        "read", {"path": self._fs._rel(p),
+                                 "offset": offset, "length": CHUNK_BYTES})
+                    out += data
+                    offset += len(data)
+                    if h.get("eof", True):
+                        return out
+            except (ConnectionError, RuntimeError, OSError):
+                return None
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def append(self, name: str, data: bytes) -> None:
+        p = self._path(name)
+        if self._remote:
+            # appends are fail-fast non-idempotent on the wire (a
+            # replayed append would double a record — io/fs.py posture)
+            self._fs._client._request(
+                "write", {"path": self._fs._rel(p), "nbytes": len(data),
+                          "append": True}, data, idempotent=False)
+            return
+        with open(p, "ab") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def replace(self, name: str, data: bytes) -> None:
+        """Atomic whole-file replace: write a unique temp, rename over
+        the target (readers see the old or the new bytes, never a
+        tear)."""
+        tmp = f"{name}.{uuid.uuid4().hex[:8]}.tmp"
+        tp, p = self._path(tmp), self._path(name)
+        if self._remote:
+            self._fs._client._request(
+                "write", {"path": self._fs._rel(tp), "nbytes": len(data),
+                          "append": False}, data, idempotent=True)
+            self._fs.mv(tp, p)
+            return
+        with open(tp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tp, p)
+        try:                      # rename durability: fsync the dir
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:           # pragma: no cover - platform quirk
+            pass
+
+    def delete(self, name: str) -> None:
+        p = self._path(name)
+        try:
+            if self._remote:
+                self._fs.delete(p)
+            else:
+                os.remove(p)
+        except (ConnectionError, RuntimeError, OSError):
+            pass
+
+    def close(self) -> None:
+        if self._fs is not None:
+            self._fs.close()
+
+
+# ---------------------------------------------------------------------------
+# leader lease
+# ---------------------------------------------------------------------------
+
+class LeaderLease:
+    """File-based leader lease with TTL and monotonically-bumped terms.
+
+    One probe per call, no background thread: the controller's tick IS
+    the heartbeat. ``try_acquire`` claims an absent/expired lease with
+    ``term = observed + 1`` and confirms by read-back; ``renew``
+    refreshes the deadline under the same term and reports ``False``
+    (deposed) the instant the file names someone else. Timestamps are
+    wall-clock (`time.time`) because they must compare across hosts —
+    the TTL is assumed to dwarf clock skew, same as every file-lease
+    scheme. The unavoidable acquire race of a CAS-free file is fenced
+    downstream by :class:`FencedSpawner`/:meth:`is_current`.
+    """
+
+    def __init__(self, root: str, *, ttl_s: float | None = None,
+                 holder: str | None = None):
+        self._store = _Store(root)
+        self.ttl_s = float(flag("control_ha_lease_ttl_s")
+                           if ttl_s is None else ttl_s)
+        if holder is None:
+            holder = str(flag("control_ha_holder") or "")
+        self.holder = holder or (f"{_socket.gethostname()}:{os.getpid()}:"
+                                 f"{uuid.uuid4().hex[:6]}")
+        self.term = 0
+        self.leading = False
+
+    def peek(self) -> dict[str, Any] | None:
+        """The current lease document, or None (absent/unparseable —
+        a torn write reads as no lease and is simply re-claimed)."""
+        raw = self._store.read(LEASE_FILE)
+        if not raw:
+            return None
+        try:
+            doc = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def _write(self, term: int) -> bool:
+        now = time.time()
+        self._store.replace(LEASE_FILE, json.dumps(
+            {"holder": self.holder, "term": int(term),
+             "expires": now + self.ttl_s, "ts": now}).encode())
+        back = self.peek()
+        return (back is not None and back.get("holder") == self.holder
+                and int(back.get("term", -1)) == int(term))
+
+    def try_acquire(self) -> bool:
+        """Single acquisition probe. True only when this holder now
+        leads (fresh claim or an expired lease taken over at
+        ``term + 1``)."""
+        cur = self.peek()
+        now = time.time()
+        if (cur is not None and cur.get("holder") != self.holder
+                and now < float(cur.get("expires", 0.0))):
+            return False                     # live foreign lease
+        term = int(cur.get("term", 0)) + 1 if cur else 1
+        if self._write(term):
+            self.term = term
+            self.leading = True
+            return True
+        self.leading = False
+        return False
+
+    def renew(self) -> bool:
+        """Refresh the deadline under the current term. False — and no
+        write — once the file names another (holder, term): the caller
+        is deposed and must stop acting."""
+        if not self.leading:
+            return False
+        cur = self.peek()
+        if (cur is None or cur.get("holder") != self.holder
+                or int(cur.get("term", -1)) != self.term):
+            self.leading = False
+            return False
+        if self._write(self.term):
+            return True
+        self.leading = False
+        return False
+
+    def is_current(self) -> bool:
+        """Actuator-side fence: does the lease file, right now, name
+        this (holder, term)? Expiry is NOT checked — an expired lease
+        still naming us means nobody took over yet, and acting is safe;
+        the moment a successor claims, the file names them and this
+        goes False."""
+        cur = self.peek()
+        return (cur is not None and cur.get("holder") == self.holder
+                and int(cur.get("term", -1)) == self.term)
+
+    def release(self) -> None:
+        """Drop the lease iff it is still ours (a standby's release
+        must never delete the leader's lease)."""
+        if self.leading and self.is_current():
+            self._store.delete(LEASE_FILE)
+        self.leading = False
+
+    def close(self) -> None:
+        self._store.close()
+
+
+# ---------------------------------------------------------------------------
+# durable fleet-state journal
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetState:
+    """What a journal replay reconstructs: the managed set (with the
+    pids needed to stop adopted subprocess replicas), the model
+    registry, any drain the previous leader left in progress, and the
+    count of spawn intents that never reported an endpoint (the
+    half-spawned orphans replay cannot address)."""
+
+    managed: dict[str, dict[str, Any]] = field(default_factory=dict)
+    registry: dict[str, dict[str, Any]] = field(default_factory=dict)
+    draining: str | None = None
+    lost_spawns: int = 0
+
+    def apply(self, rec: dict[str, Any]) -> None:
+        op = rec.get("op")
+        if op == "spawn_intent":
+            self.lost_spawns += 1
+        elif op == "spawn":
+            self.lost_spawns = max(self.lost_spawns - 1, 0)
+            self.managed[rec["ep"]] = {"pid": rec.get("pid")}
+        elif op == "adopt":
+            self.managed[rec["ep"]] = {"pid": rec.get("pid")}
+        elif op == "remove":
+            self.managed.pop(rec.get("ep"), None)
+            if self.draining == rec.get("ep"):
+                self.draining = None
+        elif op == "register_model":
+            self.registry[rec["name"]] = {"path": rec.get("path"),
+                                          "warm": bool(rec.get("warm"))}
+        elif op == "drain_begin":
+            self.draining = rec.get("ep")
+        elif op == "drain_end":
+            if self.draining == rec.get("ep"):
+                self.draining = None
+        # unknown ops: skipped (a newer leader's journal stays readable)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {"managed": {ep: dict(m) for ep, m in self.managed.items()},
+                "registry": {n: dict(s) for n, s in self.registry.items()},
+                "draining": self.draining,
+                "lost_spawns": int(self.lost_spawns)}
+
+    @classmethod
+    def from_dict(cls, doc: dict[str, Any]) -> "FleetState":
+        return cls(managed={ep: dict(m) for ep, m in
+                            (doc.get("managed") or {}).items()},
+                   registry={n: dict(s) for n, s in
+                             (doc.get("registry") or {}).items()},
+                   draining=doc.get("draining"),
+                   lost_spawns=int(doc.get("lost_spawns", 0)))
+
+
+class FleetJournal:
+    """Append-only JSON-lines journal + compacted checkpoint snapshot.
+
+    Write-ahead discipline: the caller appends (fsync'd) BEFORE the
+    action the record describes, so a replayed journal is always a
+    superset of what actually happened — a crash between append and
+    action costs a probe at takeover (the endpoint is probed dead or
+    alive either way), never a forgotten replica. :meth:`compact`
+    atomically snapshots a full :class:`FleetState` and truncates the
+    journal, bounding replay cost.
+    """
+
+    def __init__(self, root: str, *, compact_records: int | None = None):
+        self._store = _Store(root)
+        self.compact_records = int(flag("control_ha_compact_records")
+                                   if compact_records is None
+                                   else compact_records)
+        self.pending = 0           # records since the last compaction
+
+    def append(self, op: str, **fields: Any) -> None:
+        rec = {"op": op, "ts": time.time(), **fields}
+        self._store.append(JOURNAL_FILE,
+                           (json.dumps(rec) + "\n").encode())
+        self.pending += 1
+        stat_add("control/ha_journal_records")
+
+    def replay(self) -> FleetState:
+        state = FleetState()
+        ckpt = self._store.read(STATE_FILE)
+        if ckpt:
+            try:
+                state = FleetState.from_dict(json.loads(ckpt.decode()))
+            except (ValueError, UnicodeDecodeError):
+                _log.warning("control-ha: unreadable state checkpoint; "
+                             "replaying journal from scratch")
+        n = 0
+        raw = self._store.read(JOURNAL_FILE) or b""
+        for line in raw.decode(errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                # torn tail: the writer died mid-append; every record
+                # before it is intact, nothing after it exists
+                break
+            if isinstance(rec, dict):
+                state.apply(rec)
+                n += 1
+        self.pending = n
+        return state
+
+    def should_compact(self) -> bool:
+        return 0 < self.compact_records <= self.pending
+
+    def compact(self, state: FleetState) -> None:
+        """Checkpoint ``state`` (atomic replace) then truncate the
+        journal. Snapshot first: a crash between the two replays the
+        checkpoint plus a journal whose records are all already folded
+        into it — every journal op is idempotent under re-apply."""
+        self._store.replace(STATE_FILE,
+                            json.dumps(state.as_dict()).encode())
+        self._store.replace(JOURNAL_FILE, b"")
+        self.pending = 0
+        stat_add("control/ha_compactions")
+
+    def close(self) -> None:
+        self._store.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing at the actuator
+# ---------------------------------------------------------------------------
+
+class FencedSpawner:
+    """Wraps a ``ReplicaSpawner`` so every action is fenced on the
+    caller's (holder, term): a deposed leader's queued spawn/stop/kill
+    raises the typed :class:`StaleEpochError` instead of executing —
+    the split-brain window a CAS-free file lease cannot close is closed
+    here, where the fleet is actually mutated."""
+
+    def __init__(self, inner, lease: LeaderLease):
+        self.inner = inner
+        self._lease = lease
+
+    def _fence(self, action: str, endpoint: str | None = None) -> None:
+        if self._lease.is_current():
+            return
+        cur = self._lease.peek() or {}
+        stat_add("control/ha_fenced")
+        raise StaleEpochError(
+            f"{action}{' ' + endpoint if endpoint else ''} fenced: this "
+            f"controller holds ({self._lease.holder!r}, term "
+            f"{self._lease.term}) but the lease names "
+            f"({cur.get('holder')!r}, term {cur.get('term')})")
+
+    def spawn(self) -> str:
+        self._fence("spawn")
+        return self.inner.spawn()
+
+    def stop(self, endpoint: str, drain_s: float = 0.0) -> None:
+        self._fence("stop", endpoint)
+        self.inner.stop(endpoint, drain_s=drain_s)
+
+    def kill(self, endpoint: str) -> None:
+        self._fence("kill", endpoint)
+        self.inner.kill(endpoint)
+
+    def adopt(self, endpoint: str, pid: int | None = None) -> None:
+        self._fence("adopt", endpoint)
+        self.inner.adopt(endpoint, pid=pid)
+
+    def pid_of(self, endpoint: str) -> int | None:
+        return self.inner.pid_of(endpoint)
+
+
+# ---------------------------------------------------------------------------
+# the decision ring over the wire
+# ---------------------------------------------------------------------------
+
+CONTROL_OPS = {"control_dump": 1}
+
+
+class ControlService(FrameService):
+    """Frame service exposing a controller's decision ring, managed
+    set, registry, and leader/term over the wire (``control_dump``).
+    Decisions used to die with the controller process; scraped over
+    this op they survive it — ``tools/obs_dump.py --control`` reports
+    why the fleet scaled across a takeover."""
+
+    op_names = {v: k for k, v in CONTROL_OPS.items()}
+
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(host, port)
+        self._controller = controller
+
+    def _dispatch(self, sock, op, header, payload) -> bool:
+        try:
+            if op == CONTROL_OPS["control_dump"]:
+                last = header.get("last")
+                send_frame(sock, 0, self._controller.control_dump(
+                    last=int(last) if last else None))
+            else:
+                send_frame(sock, 1, {"error": f"unknown op {op}"})
+        except Exception as e:  # surfaced client-side as RuntimeError
+            send_frame(sock, 1, {"error": f"{type(e).__name__}: {e}"})
+        return True
+
+
+def control_dump(endpoint: str, *, last: int | None = None,
+                 timeout: float | None = None) -> dict[str, Any]:
+    """Scrape a :class:`ControlService`: the decision ring (optionally
+    only the last N), managed set, registry, and leader block."""
+    client = FrameClient(endpoint, CONTROL_OPS, service="control",
+                         timeout=timeout, idempotent=("control_dump",))
+    try:
+        header = {} if last is None else {"last": int(last)}
+        doc, _ = client._request("control_dump", header)
+        return doc
+    finally:
+        client.close()

@@ -42,7 +42,10 @@ rate / fetch-bytes / demotion scoreboard.
 Membership churn is survivable by construction: an endpoint's first
 snapshot is a baseline (no delta), an endpoint that disappears simply
 stops contributing new deltas, and its state is pruned after a full
-slow window of absence.  Gauge-like per-model engine stats
+slow window of absence.  An endpoint RE-ADDED after such an absence
+(a replica cordoned away and readopted, an HA takeover) re-baselines
+instead of differencing the whole gap's cumulative counters into one
+bogus window delta.  Gauge-like per-model engine stats
 (``health()["generators"]``) are kept as labeled (endpoint, model)
 last-value series.
 """
@@ -196,6 +199,16 @@ class MetricsHub:
                         or doc.get("status") == "unreachable"):
                     continue
                 s = self._series.get(ep)
+                if (s is not None
+                        and self._tick - s.last_tick > self.slow_ticks):
+                    # re-adoption after a full slow window of absence:
+                    # ingestion (which refreshes last_tick) runs before
+                    # the prune sweep below, so a returning endpoint
+                    # would dodge its own prune and difference the
+                    # WHOLE gap's cumulative counters against stale
+                    # baselines — one giant bogus window delta. Treat
+                    # it as brand new: first sight is a baseline.
+                    s = None
                 if s is None:
                     s = self._series[ep] = _EndpointSeries(
                         self.slow_ticks)

@@ -205,6 +205,10 @@ def main(argv: list[str] | None = None) -> int:
                           role=args.role)
     srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
+    # after ENDPOINT (the line SubprocessSpawner blocks on): lets an
+    # operator or HA journal record the pid of a replica started by
+    # hand, so an adopting leader can escalate a stop past the wire
+    print(f"PID {os.getpid()}", flush=True)
 
     def _term(signum, frame):        # scheduler preemption: drain, exit
         srv.stop(drain_s=float(flag("wire_drain_s")))

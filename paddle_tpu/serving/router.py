@@ -61,6 +61,7 @@ Stats: ``serving/router/failovers``, ``serving/router/shed_rerouted``,
 
 from __future__ import annotations
 
+import random as _random_mod
 import threading
 import time
 import uuid
@@ -80,6 +81,16 @@ from paddle_tpu.serving.engine import (
 
 __all__ = ["RoutedClient", "ReplicaState", "StickySession",
            "GenerationFailed", "StreamResumeExhausted"]
+
+_jitter_rng = _random_mod.Random()
+
+
+def _jittered(base: float) -> float:
+    """U[0.9, 1.1) x base — decorrelates N routers' (and standby
+    controllers') probe cadence so they don't synchronize their health
+    scrapes into a thundering herd on the fleet (the PR-8 shed-jitter
+    idiom, tighter band: a cadence, not a backoff)."""
+    return base * (0.9 + 0.2 * _jitter_rng.random())
 
 
 class GenerationFailed(ConnectionError):
@@ -248,7 +259,7 @@ class RoutedClient:
 
     # -- health probing ----------------------------------------------------
     def _probe_loop(self) -> None:
-        while not self._probe_stop.wait(self._probe_interval):
+        while not self._probe_stop.wait(_jittered(self._probe_interval)):
             try:
                 self.probe()
             except Exception:      # pragma: no cover - prober never dies

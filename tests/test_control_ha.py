@@ -1,0 +1,427 @@
+"""Control-plane high availability: leased leadership over a shared
+directory, the durable fleet-state journal, actuator epoch fencing, and
+orphan-replica adoption at takeover.
+
+The load-bearing properties: a standby claims the lease within one TTL
+of the leader going silent and replays the journal to the EXACT managed
+set; live orphans are adopted — routing membership restored around
+running replicas, zero double-spawns; a deposed leader's queued
+``spawn``/``stop`` carries a stale (holder, term), is rejected at the
+actuator, and lands as a typed ``fenced`` decision, never executed; and
+every HA flag is hard-off — the flag-default controller constructs no
+lease, writes no journal byte, and reads no flag after construction.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core.flags import get_flags
+from paddle_tpu.io import InferenceClient, InferenceServer, \
+    save_inference_model
+from paddle_tpu.serving import (
+    FencedSpawner, FleetJournal, FleetState, InProcSpawner, LeaderLease,
+    ServingController, StaleEpochError, control_dump,
+)
+from paddle_tpu.serving import control as control_mod
+from paddle_tpu.serving import ha as ha_mod
+from paddle_tpu.serving import router as router_mod
+
+pytestmark = [pytest.mark.ha, pytest.mark.control]
+
+TTL = 0.5
+
+
+@pytest.fixture(scope="module")
+def mlp_path(tmp_path_factory):
+    paddle_tpu.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path_factory.mktemp("ha") / "mlp")
+    save_inference_model(path, net, [np.zeros((2, 4), np.float32)],
+                         dynamic_batch=True)
+    return path
+
+
+def _mlp_factory():
+    return InferenceServer()
+
+
+def _ctl(tmp, holder, **kw):
+    kw.setdefault("min_replicas", 2)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("drain_s", 3.0)
+    return ServingController(
+        InProcSpawner(_mlp_factory), interval_s=0,
+        ha_lease_dir=str(tmp), ha_lease_ttl_s=TTL, ha_holder=holder,
+        **kw)
+
+
+# ---------------------------------------------------------------------------
+# LeaderLease
+# ---------------------------------------------------------------------------
+
+def test_lease_acquire_renew_and_peek(tmp_path):
+    a = LeaderLease(str(tmp_path), ttl_s=TTL, holder="A")
+    assert a.try_acquire()
+    assert a.leading and a.term == 1 and a.is_current()
+    doc = a.peek()
+    assert doc["holder"] == "A" and doc["term"] == 1
+    assert doc["expires"] > time.time()
+    assert a.renew()                     # same term, refreshed deadline
+    assert a.term == 1
+    a.release()
+    assert a.peek() is None and not a.leading
+    a.close()
+
+
+def test_lease_live_foreign_holder_blocks(tmp_path):
+    a = LeaderLease(str(tmp_path), ttl_s=30.0, holder="A")
+    b = LeaderLease(str(tmp_path), ttl_s=30.0, holder="B")
+    assert a.try_acquire()
+    assert not b.try_acquire()           # live foreign lease: hold
+    assert not b.leading and b.term == 0
+    assert a.is_current() and not b.is_current()
+    a.close(), b.close()
+
+
+def test_lease_expiry_takeover_bumps_term(tmp_path):
+    a = LeaderLease(str(tmp_path), ttl_s=0.2, holder="A")
+    b = LeaderLease(str(tmp_path), ttl_s=0.2, holder="B")
+    assert a.try_acquire() and a.term == 1
+    time.sleep(0.3)                      # A goes a TTL without renewal
+    assert b.try_acquire()
+    assert b.leading and b.term == 2     # term monotonically bumped
+    # the deposed holder notices on its next probe — no write happens
+    assert not a.renew() and not a.leading
+    assert not a.is_current() and b.is_current()
+    a.close(), b.close()
+
+
+def test_lease_release_is_owner_guarded(tmp_path):
+    """A standby's release must never delete the leader's lease."""
+    a = LeaderLease(str(tmp_path), ttl_s=30.0, holder="A")
+    b = LeaderLease(str(tmp_path), ttl_s=30.0, holder="B")
+    assert a.try_acquire() and not b.try_acquire()
+    b.release()
+    assert a.is_current() and a.peek()["holder"] == "A"
+    a.close(), b.close()
+
+
+def test_lease_torn_file_is_reclaimable(tmp_path):
+    """An unparseable lease file (torn write) reads as no lease and is
+    simply re-claimed — never a crash, never a deadlock."""
+    (tmp_path / ha_mod.LEASE_FILE).write_bytes(b'{"holder": "A", "te')
+    a = LeaderLease(str(tmp_path), ttl_s=TTL, holder="B")
+    assert a.peek() is None
+    assert a.try_acquire() and a.term == 1
+    a.close()
+
+
+# ---------------------------------------------------------------------------
+# FleetJournal
+# ---------------------------------------------------------------------------
+
+def test_journal_replay_reconstructs_exact_state(tmp_path):
+    j = FleetJournal(str(tmp_path), compact_records=0)
+    j.append("spawn_intent")
+    j.append("spawn", ep="h:1", pid=11)
+    j.append("spawn_intent")
+    j.append("spawn", ep="h:2", pid=None)
+    j.append("register_model", name="m", path="/p", warm=True)
+    j.append("adopt", ep="h:3", pid=33)
+    j.append("remove", ep="h:2")
+    j.append("drain_begin", ep="h:1")
+    j.append("spawn_intent")             # died inside the spawner
+    j.append("future_op", ep="x")        # newer leader's record: skipped
+    st = FleetJournal(str(tmp_path), compact_records=0).replay()
+    assert st.managed == {"h:1": {"pid": 11}, "h:3": {"pid": 33}}
+    assert st.registry == {"m": {"path": "/p", "warm": True}}
+    assert st.draining == "h:1"          # unfinished drain survives
+    assert st.lost_spawns == 1           # the unmatched intent
+    j.close()
+
+
+def test_journal_compaction_checkpoint_roundtrip(tmp_path):
+    j = FleetJournal(str(tmp_path), compact_records=4)
+    for i in range(4):
+        j.append("spawn", ep=f"h:{i}", pid=i)
+    assert j.should_compact()
+    j.compact(j.replay())
+    assert j.pending == 0 and not j.should_compact()
+    # records fold on top of the checkpoint, not instead of it
+    j.append("remove", ep="h:0")
+    j.append("adopt", ep="h:9", pid=99)
+    st = FleetJournal(str(tmp_path), compact_records=4).replay()
+    assert set(st.managed) == {"h:1", "h:2", "h:3", "h:9"}
+    assert st.managed["h:9"] == {"pid": 99}
+    j.close()
+
+
+def test_journal_torn_tail_breaks_clean(tmp_path):
+    """The previous leader died mid-append: every record before the
+    torn line replays, nothing after it exists."""
+    j = FleetJournal(str(tmp_path), compact_records=0)
+    j.append("spawn", ep="h:1", pid=1)
+    j.append("spawn", ep="h:2", pid=2)
+    with open(tmp_path / ha_mod.JOURNAL_FILE, "ab") as f:
+        f.write(b'{"op": "remove", "ep": "h:1"')      # no newline, torn
+    st = FleetJournal(str(tmp_path), compact_records=0).replay()
+    assert set(st.managed) == {"h:1", "h:2"}
+    j.close()
+
+
+def test_fleet_state_dict_roundtrip():
+    st = FleetState(managed={"h:1": {"pid": 7}},
+                    registry={"m": {"path": "/p", "warm": False}},
+                    draining="h:1", lost_spawns=2)
+    assert FleetState.from_dict(
+        json.loads(json.dumps(st.as_dict()))).as_dict() == st.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# actuator fencing
+# ---------------------------------------------------------------------------
+
+class _RecordingSpawner:
+    def __init__(self):
+        self.calls = []
+
+    def spawn(self):
+        self.calls.append("spawn")
+        return "h:1"
+
+    def stop(self, endpoint, drain_s=0.0):
+        self.calls.append(("stop", endpoint))
+
+    def kill(self, endpoint):
+        self.calls.append(("kill", endpoint))
+
+    def adopt(self, endpoint, pid=None):
+        self.calls.append(("adopt", endpoint))
+
+    def pid_of(self, endpoint):
+        return None
+
+
+def test_fencing_rejects_stale_epoch_actions(tmp_path):
+    """A deposed leader's queued spawn/stop/kill/adopt raises the typed
+    StaleEpochError at the actuator and the inner spawner is NEVER
+    called; the current leader's actions pass through untouched."""
+    a = LeaderLease(str(tmp_path), ttl_s=0.2, holder="A")
+    b = LeaderLease(str(tmp_path), ttl_s=0.2, holder="B")
+    assert a.try_acquire()
+    ra, rb = _RecordingSpawner(), _RecordingSpawner()
+    fa, fb = FencedSpawner(ra, a), FencedSpawner(rb, b)
+    assert fa.spawn() == "h:1"           # current leader: passes
+    time.sleep(0.3)
+    assert b.try_acquire()               # B deposes A at term 2
+    for action in (fa.spawn, lambda: fa.stop("h:1"),
+                   lambda: fa.kill("h:1"), lambda: fa.adopt("h:1")):
+        with pytest.raises(StaleEpochError):
+            action()
+    assert ra.calls == ["spawn"]         # nothing executed post-depose
+    fb.adopt("h:1")
+    fb.stop("h:1")
+    assert rb.calls == [("adopt", "h:1"), ("stop", "h:1")]
+    assert fa.pid_of("h:1") is None      # reads are not fenced
+    a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# controller end-to-end: standby hold, takeover adoption, fencing
+# ---------------------------------------------------------------------------
+
+def test_takeover_adopts_live_fleet_and_fences_zombie(mlp_path,
+                                                      tmp_path):
+    """The whole failover story in one fleet: leader bootstraps and
+    registers a model; a standby holds; the leader goes silent; within
+    one TTL the standby takes the lease at term+1, replays the journal,
+    and ADOPTS the live replicas (same endpoints, zero double-spawns,
+    registry intact); the zombie leader's next tick is a ``deposed``
+    decision and its queued scale-up a ``fenced`` one — never executed,
+    and its close() cannot stop the successor's fleet."""
+    c1 = _ctl(tmp_path, "A")
+    c2 = _ctl(tmp_path, "B")
+    try:
+        c1.start()
+        c1.register_model("m", mlp_path, warm=True)
+        assert c1.router.endpoints() == []   # HA: bootstrap waits for
+        c1.tick()                            # leadership; tick leads
+        assert c1.lease.leading and c1.lease.term == 1
+        eps = set(c1.router.endpoints())
+        assert len(eps) == 2
+
+        c2.start()
+        d = c2.tick()
+        assert d.action == "hold" and "standby" in d.reason
+        assert "'A'" in d.reason and not c2.router.endpoints()
+
+        # the leader dies silently: no renewals; one TTL later the
+        # standby's ordinary tick claims the lease and takes over
+        time.sleep(TTL + 0.2)
+        c2.tick()
+        assert c2.lease.leading and c2.lease.term == 2
+        assert set(c2.router.endpoints()) == eps     # EXACT managed set
+        inner = c2._spawner.inner
+        assert not inner.servers             # adopted, not respawned
+        assert inner.adopted == eps
+        adopts = [x for x in c2.decisions() if x["action"] == "adopt"]
+        assert {x["endpoint"] for x in adopts} == eps
+        # registry survived through the journal: warm pin and all
+        spec = c2.registered_models()["m"]
+        assert spec["warm"] and spec["path"] == mlp_path
+        # adopted replicas serve — streams/requests untouched
+        (y,) = c2.infer("m", np.ones((2, 4), np.float32))
+        assert y.shape == (2, 3)
+
+        # the zombie leader: deposed on its next tick, fenced at the
+        # actuator on its queued scale-up — a typed decision, no spawn
+        d = c1.tick()
+        assert d.action == "deposed" and "'B'" in d.reason
+        n_before = len(c1._spawner.inner.servers)
+        d = c1._scale_up("zombie queued action", {})
+        assert d.action == "fenced" and "epoch fence" in d.reason
+        assert c1.decisions()[-1]["action"] == "fenced"
+        assert len(c1._spawner.inner.servers) == n_before
+        assert set(c2.router.endpoints()) == eps     # fleet untouched
+
+        # deposed close must not stop the successor's replicas
+        c1.close(stop_replicas=True)
+        healths = c2.router.health()
+        assert set(healths) == eps
+        assert all(h.get("status") == "ok" for h in healths.values())
+    finally:
+        c1.close()
+        c2.close()
+
+
+def test_takeover_replaces_dead_and_surfaces_lost_spawns(mlp_path,
+                                                         tmp_path):
+    """Journaled replicas that prove dead at takeover are replaced (a
+    ``replace`` decision plus a fresh spawn), and spawn intents that
+    never reported an endpoint are surfaced, not silently forgotten."""
+    j = FleetJournal(str(tmp_path), compact_records=0)
+    j.append("spawn", ep="127.0.0.1:9", pid=None)     # nothing there
+    j.append("spawn_intent")                          # died mid-spawn
+    j.close()
+    ctl = _ctl(tmp_path, "C", min_replicas=1)
+    try:
+        ctl.start()
+        ctl.tick()
+        acts = [d["action"] for d in ctl.decisions()]
+        assert "replace" in acts and "scale_up" in acts
+        assert "adopt" not in acts
+        eps = ctl.router.endpoints()
+        assert len(eps) == 1 and "127.0.0.1:9" not in eps
+        # the takeover checkpoint reflects the repaired fleet
+        st = FleetJournal(str(tmp_path), compact_records=0).replay()
+        assert set(st.managed) == set(eps)
+        assert st.lost_spawns == 0       # folded into the checkpoint
+    finally:
+        ctl.close()
+
+
+def test_takeover_resumes_journaled_drain(mlp_path, tmp_path):
+    """An unfinished sticky drain journaled by the previous leader is
+    resumed by the new one: the victim is adopted, drained clean, and
+    removed — then the fleet is bootstrapped back to min_replicas."""
+    srv = InferenceServer({"m": mlp_path}).start()   # the orphan victim
+    try:
+        j = FleetJournal(str(tmp_path), compact_records=0)
+        j.append("spawn", ep=srv.endpoint, pid=None)
+        j.append("drain_begin", ep=srv.endpoint)
+        j.close()
+        ctl = _ctl(tmp_path, "D", min_replicas=1)
+        try:
+            ctl.start()
+            ctl.tick()
+            acts = [d["action"] for d in ctl.decisions()]
+            assert "adopt" in acts and "drain_resume" in acts
+            assert "scale_down" in acts
+            eps = ctl.router.endpoints()
+            assert srv.endpoint not in eps and len(eps) == 1
+            assert ctl._draining is None
+            st = FleetJournal(str(tmp_path), compact_records=0).replay()
+            assert st.draining is None and set(st.managed) == set(eps)
+        finally:
+            ctl.close()
+    finally:
+        srv.stop()
+
+
+def test_control_dump_over_wire(mlp_path, tmp_path):
+    """The decision ring, managed set, registry, and leader/term are
+    scrapeable over the ``control_dump`` frame op — decisions no longer
+    die with the controller process."""
+    ctl = _ctl(tmp_path, "E", min_replicas=1)
+    try:
+        ctl.start()
+        ctl.register_model("m", mlp_path)
+        ctl.tick()
+        ep = ctl.serve()
+        assert ctl.serve() == ep         # idempotent: one service
+        doc = control_dump(ep)
+        assert doc["leader"] == {"leading": True, "holder": "E",
+                                 "term": 1}
+        assert doc["managed"] == sorted(ctl.router.endpoints())
+        assert doc["registry"]["m"]["path"] == mlp_path
+        assert any(d["action"] == "scale_up" for d in doc["decisions"])
+        # last=N truncates the ring server-side
+        assert len(control_dump(ep, last=1)["decisions"]) == 1
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# defaults: hard-off, construction-only flag reads, jitter band
+# ---------------------------------------------------------------------------
+
+def test_ha_defaults_hard_off_and_construction_only(mlp_path,
+                                                    monkeypatch):
+    """Flag defaults: no lease, no journal, no fencing wrapper, no
+    wire service — and NO flag (HA or otherwise) is read after
+    construction: ticks, infer, and close run entirely on captured
+    config."""
+    assert get_flags(["control_ha_lease_dir", "control_ha_lease_ttl_s",
+                      "control_ha_holder",
+                      "control_ha_compact_records"]) == {
+        "control_ha_lease_dir": "", "control_ha_lease_ttl_s": 3.0,
+        "control_ha_holder": "", "control_ha_compact_records": 256}
+    ctl = ServingController(InProcSpawner(_mlp_factory), interval_s=0,
+                            min_replicas=1)
+    try:
+        assert ctl.lease is None and ctl._journal is None
+        assert ctl._service is None
+        assert isinstance(ctl._spawner, InProcSpawner)   # unwrapped
+        ctl.start()
+        ctl.register_model("m", mlp_path)
+
+        def spy(name):
+            raise AssertionError(
+                f"flag({name!r}) read after construction")
+
+        monkeypatch.setattr(control_mod, "flag", spy)
+        monkeypatch.setattr(ha_mod, "flag", spy)
+        for _ in range(3):
+            ctl.tick()
+        assert ctl.infer("m", np.ones((1, 4), np.float32))[0].shape \
+            == (1, 3)
+        assert "leader" not in ctl.control_dump()
+        monkeypatch.undo()
+    finally:
+        ctl.close()
+
+
+def test_tick_and_probe_jitter_band():
+    """Controller tick and router probe cadences are jittered
+    U[0.9, 1.1)x base — decorrelated fleets, same mean period."""
+    for fn in (control_mod._jittered, router_mod._jittered):
+        vals = [fn(2.0) for _ in range(400)]
+        assert all(1.8 <= v < 2.2 for v in vals), (fn, min(vals),
+                                                   max(vals))
+        assert max(vals) - min(vals) > 0.1           # actually jitters

@@ -197,6 +197,31 @@ def test_unreachable_docs_are_skipped_and_endpoints_pruned():
     assert snap["tick"] == 6 and list(snap["endpoints"]) == ["a"]
 
 
+def test_readopted_endpoint_rebaselines_after_slow_gap():
+    """An endpoint absent a full slow window then re-added — the HA
+    takeover adoption path, or an operator re-adding a bounced replica
+    — must re-baseline. Its ingest refreshes ``last_tick`` BEFORE the
+    prune sweep runs, so without the explicit re-baseline it would
+    dodge its own prune and difference the whole gap's cumulative
+    counters against the stale pre-gap snapshot: one giant bogus
+    window delta."""
+    hub = MetricsHub(fast_ticks=2, slow_ticks=3)
+    hub.ingest({"ep": _doc([0.1], stats={"gen/streams": 10.0})})
+    # gone for > slow_ticks while another member keeps the hub ticking
+    for _ in range(4):
+        hub.ingest({"other": _doc([])})
+    # returns with a much larger lifetime total: first sight is a
+    # baseline (no delta), not a 990-event window spike
+    hub.ingest({"ep": _doc([0.1] * 100,
+                           stats={"gen/streams": 1000.0})})
+    assert hub.rate("gen/streams") == 0.0
+    assert hub.window_histogram("gen/ttft_s") is None
+    # deltas resume normally from the new baseline
+    hub.ingest({"ep": _doc([0.1] * 104,
+                           stats={"gen/streams": 1002.0})})
+    assert hub.rate("gen/streams") > 0.0
+
+
 def test_gauges_track_latest_per_model_engine_stats():
     hub = MetricsHub()
     doc = _doc([])

@@ -98,6 +98,17 @@ Scenarios (all CPU-only, single process):
     lifecycle check and a no-hot-path-flag-reads defaults check.
     ``--campaign N [--seed S]`` runs an N-scenario campaign standalone
     (defaults checks + campaign only).
+16. **control-ha**: the ACTIVE controller of an HA pair dies silently
+    mid-flight (its last acts: a journaled-but-unfinished sticky drain
+    and a spawn intent that never reported an endpoint) while a
+    subprocess replica holds a LIVE token stream — the standby holds
+    while the lease is live, claims it within one TTL of the silence
+    (term bumped), replays the journal to the EXACT managed set,
+    ADOPTS the live orphans (zero double-spawns; the in-flight stream
+    rides through the takeover byte-identical to solo ``generate()``),
+    surfaces the lost spawn intent, resumes the journaled drain clean,
+    and fences the zombie leader's queued scale-up as a typed
+    ``fenced`` decision that never executes.
 
 Also asserts the production posture: every fault/retry/overload flag
 defaults to hard-off/zero-cost (including the ``gen_spec_*`` family:
@@ -107,7 +118,9 @@ default, the engine's device layout is the identity and every compiled
 entry point is the plain single-device jit).
 
 Usage: ``JAX_PLATFORMS=cpu python tools/chaos_check.py`` for the full
-suite, or ``... chaos_check.py --campaign N [--seed S]`` for an
+suite, ``... chaos_check.py NAME [NAME ...]`` (e.g. ``control-ha``)
+for the named scenarios only (defaults checks always run), or
+``... chaos_check.py --campaign N [--seed S]`` for an
 N-scenario randomized KV campaign standalone. Exits nonzero
 (with a JSON report on stdout) if any recovery path or stat fails — a
 scenario that raises is recorded as a failed check, never a bare
@@ -265,6 +278,52 @@ def check_defaults_off() -> None:
         check("defaults/gen_kv_hardening_threadfree",
               not spawned and got == b"x" * 8 and miss is None,
               f"spawned={spawned}")
+
+    haf = get_flags(["control_ha_lease_dir", "control_ha_lease_ttl_s",
+                     "control_ha_holder", "control_ha_compact_records"])
+    # behavior at defaults: the flag-default controller constructs NO
+    # lease, NO journal, NO fencing wrapper, NO wire service, spawns no
+    # thread, and writes no HA file — the pre-HA controller, byte for
+    # byte (the HA flags are read once, at construction)
+    from paddle_tpu.serving import InProcSpawner as _IPS
+    from paddle_tpu.serving import ServingController as _SC
+    from paddle_tpu.serving.ha import FencedSpawner as _FS
+
+    spawned = []
+    real_thread = _threading.Thread
+
+    def _spy_thread(*a, **k):
+        spawned.append(k.get("name", "?"))
+        return real_thread(*a, **k)
+
+    from paddle_tpu.serving import RoutedClient as _RC
+
+    # probe-less router: its health-probe thread is default serving
+    # behavior, not HA's — the spy must only see what HA would add
+    router = _RC(probe_interval_s=0)
+    _threading.Thread = _spy_thread
+    try:
+        ctl = _SC(_IPS(io.InferenceServer), router=router,
+                  interval_s=0, min_replicas=0)
+        ctl.start()
+        for _ in range(3):
+            d = ctl.tick()
+        dump = ctl.control_dump()
+        ctl.close()
+    finally:
+        _threading.Thread = real_thread
+        router.close()
+    check("defaults/control_ha_off",
+          haf["control_ha_lease_dir"] == ""
+          and haf["control_ha_holder"] == ""
+          and haf["control_ha_lease_ttl_s"] == 3.0    # sane opt-in TTL
+          and haf["control_ha_compact_records"] == 256
+          and ctl._lease is None and ctl._journal is None
+          and ctl._service is None
+          and not isinstance(ctl._spawner, _FS)       # unwrapped
+          and d.action == "hold" and "leader" not in dump
+          and not spawned,
+          f"flags={haf} spawned={spawned}")
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -903,6 +962,145 @@ def scenario_control_plane(tmp: str) -> None:
               and np.array_equal(np.asarray(toks2, np.int32), refs))
     finally:
         ctl2.close()
+
+
+def scenario_control_ha(tmp: str) -> None:
+    """The active controller of an HA pair dies silently (SIGKILL
+    emulated in-process: it never ticks, renews, or closes again) with
+    a live token stream on a subprocess replica, an unfinished
+    journaled drain, and a spawn intent that never reported an
+    endpoint. Asserts: standby holds while the lease is live; takeover
+    within one TTL at term+1; journal replay reconstructs the EXACT
+    managed set; live orphans adopted (zero double-spawns, the stream
+    byte-identical to solo ``generate()`` across the takeover); the
+    lost spawn surfaced; the drain resumed clean; the zombie's queued
+    scale-up fenced at the actuator as a typed decision."""
+    import time
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import ServingController, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)          # == every replica's weights
+    monitor.reset_stats("control/")
+    ha_root = os.path.join(tmp, "ha_root")
+    ttl = 1.0
+    # the rider stream deliberately goes unpolled across the whole
+    # takeover (standby wait + adoption + a fresh subprocess spawn);
+    # keep the replicas' poll TTL above that so "client paused" is not
+    # mistaken for "client gone"
+    os.environ["FLAGS_gen_poll_ttl_s"] = "300"
+    gen_args = ("--gen", "llm", "--gen-seed", "7", "--gen-slots", "2",
+                "--gen-max-len", "32", "--gen-step-wait-s", "0.05")
+
+    def _ctl(holder):
+        return ServingController(
+            SubprocessSpawner(extra_args=gen_args), interval_s=0,
+            min_replicas=2, max_replicas=4, drain_s=20.0,
+            ha_lease_dir=ha_root, ha_lease_ttl_s=ttl, ha_holder=holder)
+
+    c1, c2 = _ctl("primary"), _ctl("standby")
+    try:
+        c1.start()
+        c1.tick()                          # claims term 1, bootstraps 2
+        live = set(c1.router.endpoints())
+        check("control/ha_leader_bootstrapped",
+              c1.lease.leading and c1.lease.term == 1 and len(live) == 2,
+              f"term={c1.lease.term} eps={sorted(live)}")
+
+        rs = np.random.RandomState(61)
+        prompt = rs.randint(0, 96, (5,)).astype(np.int32)
+        ref = np.asarray(generate(model, prompt[None], 14))[0, 5:]
+        sess = c1.router.session("ha-rider")
+        it = sess.generate("llm", prompt, 14, poll_wait_s=0.05,
+                           resume_budget=2)
+        toks = [next(it), next(it)]        # the stream is live
+        victim = next(ep for ep in live if ep != sess.endpoint)
+
+        c1.tick()                          # one last renewal, then the
+        #                                    leader dies silently. Its
+        # final journaled acts: a drain begun but not finished, and a
+        # spawn intent whose endpoint no one will ever learn
+        c1._journal_rec("drain_begin", ep=victim)
+        c1._journal_rec("spawn_intent")
+
+        c2.start()
+        d = c2.tick()
+        check("control/ha_standby_holds_while_leader_live",
+              d.action == "hold" and "standby" in d.reason
+              and not c2.router.endpoints(), d.reason)
+
+        time.sleep(ttl + 0.2)              # one TTL of silence
+        t0 = time.monotonic()
+        c2.tick()                          # claim + replay + adopt
+        took = time.monotonic() - t0
+        adopted = {x["endpoint"] for x in c2.decisions()
+                   if x["action"] == "adopt"}
+        check("control/ha_takeover_replays_exact_managed_set",
+              c2.lease.leading and c2.lease.term == 2
+              and adopted == live, f"term={c2.lease.term} "
+              f"adopted={sorted(adopted)} expected={sorted(live)} "
+              f"takeover_s={took:.2f}")
+        # zero double-spawns: every live orphan was ADOPTED, never
+        # respawned — the only process c2 started is the post-drain
+        # bootstrap replacement, a fresh endpoint outside the old fleet
+        check("control/ha_zero_double_spawns",
+              set(c2._spawner.inner.procs).isdisjoint(live)
+              and len(c2._spawner.inner.procs) == 1
+              and sess.endpoint in c2._spawner.inner.adopted_pids
+              and monitor.get_stat("control/ha_adopted") == 2,
+              f"procs={list(c2._spawner.inner.procs)} "
+              f"adopted={list(c2._spawner.inner.adopted_pids)}")
+        acts = [x["action"] for x in c2.decisions()]
+        check("control/ha_drain_resumed_clean",
+              "drain_resume" in acts
+              and any(x["action"] == "scale_down" and x.get("clean")
+                      and x["endpoint"] == victim
+                      for x in c2.decisions())
+              and victim not in c2.router.endpoints()
+              and monitor.get_stat("control/drain_forced") == 0,
+              str(acts))
+        check("control/ha_lost_spawn_surfaced",
+              monitor.get_stat("control/ha_lost_spawns") == 1,
+              str(monitor.get_stat("control/ha_lost_spawns")))
+
+        err = None
+        try:
+            toks += list(it)               # rides through the takeover
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("control/ha_stream_byte_identical_across_takeover",
+              err is None
+              and np.array_equal(np.asarray(toks, np.int32), ref),
+              f"err={err} toks={len(toks)}")
+
+        # the zombie: next tick deposes it; its queued scale-up is
+        # fenced at the actuator — typed decision, never executed
+        d = c1.tick()
+        n_before = len(c1._spawner.inner.procs)
+        f = c1._scale_up("zombie queued scale-up", {})
+        check("control/ha_zombie_deposed_and_fenced",
+              d.action == "deposed" and f.action == "fenced"
+              and len(c1._spawner.inner.procs) == n_before
+              and c1.decisions()[-1]["action"] == "fenced",
+              f"tick={d.action} scale_up={f.action}")
+
+        # durable truth: a fresh replay names exactly the live fleet
+        from paddle_tpu.serving import FleetJournal
+        st = FleetJournal(ha_root, compact_records=0).replay()
+        check("control/ha_journal_names_live_fleet",
+              set(st.managed) == set(c2.router.endpoints())
+              and st.draining is None, str(st.as_dict()))
+    finally:
+        os.environ.pop("FLAGS_gen_poll_ttl_s", None)
+        c1.close(stop_replicas=False)      # the corpse: fleet is c2's
+        c2.close()
+        for sp in (c1._spawner.inner, c2._spawner.inner):
+            for ep in list(sp.procs):
+                sp.kill(ep)
 
 
 def scenario_gen_resilience(tmp: str) -> None:
@@ -1766,6 +1964,19 @@ def _report() -> int:
     return 0 if ok else 1
 
 
+SCENARIOS = (scenario_serving_wire, scenario_checkpoint,
+             scenario_elastic_resume, scenario_overload,
+             scenario_obs, scenario_serving_routed,
+             scenario_gen_engine, scenario_gen_paged,
+             scenario_control_plane, scenario_control_ha,
+             scenario_gen_resilience,
+             scenario_gen_spec, scenario_gen_sharded,
+             scenario_obs_fleet, scenario_ledger,
+             scenario_gen_disagg,
+             scenario_gen_hotloop,
+             scenario_kv_campaign)
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     campaign_n = None
@@ -1774,6 +1985,23 @@ def main(argv: list[str] | None = None) -> int:
         campaign_n = int(argv[argv.index("--campaign") + 1])
     if "--seed" in argv:
         seed = int(argv[argv.index("--seed") + 1])
+    # positional args name scenarios to run (e.g. ``control-ha``); the
+    # defaults checks always run
+    by_name = {fn.__name__[len("scenario_"):].replace("_", "-"): fn
+               for fn in SCENARIOS}
+    names, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+        elif a in ("--campaign", "--seed"):
+            skip = True
+        elif not a.startswith("-"):
+            if a not in by_name:
+                print(f"unknown scenario {a!r}; one of "
+                      f"{', '.join(sorted(by_name))}", file=sys.stderr)
+                return 2
+            names.append(a)
+    scenarios = [by_name[n] for n in names] if names else SCENARIOS
     check_defaults_off()
     with tempfile.TemporaryDirectory(prefix="ptpu_chaos_") as tmp:
         os.environ["PADDLE_CKPT_CACHE_ROOT"] = os.path.join(tmp, "cache")
@@ -1784,16 +2012,7 @@ def main(argv: list[str] | None = None) -> int:
                 check("run_campaign/completed", False,
                       f"{type(e).__name__}: {e}")
             return _report()
-        for scenario in (scenario_serving_wire, scenario_checkpoint,
-                         scenario_elastic_resume, scenario_overload,
-                         scenario_obs, scenario_serving_routed,
-                         scenario_gen_engine, scenario_gen_paged,
-                         scenario_control_plane, scenario_gen_resilience,
-                         scenario_gen_spec, scenario_gen_sharded,
-                         scenario_obs_fleet, scenario_ledger,
-                         scenario_gen_disagg,
-                         scenario_gen_hotloop,
-                         scenario_kv_campaign):
+        for scenario in scenarios:
             try:
                 scenario(tmp)
             except Exception as e:   # a crash is a failed check, not a

@@ -17,7 +17,17 @@ from profile dumps).
 Usage::
 
     python tools/obs_dump.py HOST:PORT [HOST:PORT ...] \
-        [-o fleet_trace.json] [--clear] [--stats-prefix wire/] [--prom]
+        [-o fleet_trace.json] [--clear] [--stats-prefix wire/] [--prom] \
+        [--control HOST:PORT]
+
+``--control`` additionally scrapes a :class:`~paddle_tpu.serving.ha.
+ControlService` (``ServingController.serve()``) over its
+``control_dump`` op and adds a ``control`` block to the report: WHY
+the fleet scaled (the typed decision ring — scale/evict/replace/adopt/
+fenced with reasons), the managed set and registry, and the
+leader/term when control-plane HA is on — so the report explains the
+membership changes the trace merge shows, even across a controller
+takeover.
 
 Exits nonzero if every endpoint is unreachable; unreachable endpoints
 are reported and skipped (a fleet dump must not die because one node
@@ -53,6 +63,31 @@ def scrape(endpoint: str, *, clear: bool, stats_prefix: str | None,
             "health": health,
             "histograms": health.pop("histograms", {}),
             "spans": dump.get("spans", [])}
+
+
+def scrape_control(endpoint: str, *, last: int | None = None,
+                   timeout: float = 10.0) -> dict:
+    """Scrape a ``ControlService`` into the report's ``control`` block:
+    the decision ring (why the fleet scaled), managed set, registry,
+    and — with HA on — the leader/term the decisions were made under."""
+    from paddle_tpu.serving.ha import control_dump
+
+    doc = control_dump(endpoint, last=last, timeout=timeout)
+    block = {
+        "endpoint": endpoint,
+        "managed": doc.get("managed", []),
+        "members": doc.get("endpoints", []),
+        "registry": doc.get("registry", {}),
+        "decisions": [{k: d.get(k) for k in
+                       ("action", "endpoint", "reason", "clean")
+                       if d.get(k) is not None
+                       and (k != "clean"
+                            or d.get("action") == "scale_down")}
+                      for d in doc.get("decisions", [])],
+    }
+    if "leader" in doc:
+        block["leader"] = doc["leader"]
+    return block
 
 
 def merge_fleet_histograms(scrapes: list[dict]) -> dict[str, dict]:
@@ -182,6 +217,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="also print THIS process' registry as Prometheus "
                          "text (remote stats ride the health snapshots)")
     ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--control", default=None, metavar="HOST:PORT",
+                    help="also scrape a ServingController's "
+                         "control_dump service: the typed decision "
+                         "ring (why the fleet scaled), managed set, "
+                         "and leader/term when HA is on")
+    ap.add_argument("--control-last", type=int, default=None,
+                    metavar="N", help="only the last N decisions")
     args = ap.parse_args(argv)
 
     scrapes, failed = [], []
@@ -201,6 +243,14 @@ def main(argv: list[str] | None = None) -> int:
     with open(args.out, "w") as f:
         json.dump(doc, f)
     report = build_report(scrapes, failed=failed, out=args.out, doc=doc)
+    if args.control:
+        try:
+            report["control"] = scrape_control(args.control,
+                                               last=args.control_last,
+                                               timeout=args.timeout)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            report["control"] = {"endpoint": args.control,
+                                 "error": f"{type(e).__name__}: {e}"}
     print(json.dumps(report, indent=2))
     if args.prom:
         from paddle_tpu.core.monitor import export_prometheus
