@@ -413,6 +413,55 @@ define_flag("gen_async_depth", 0,
             "steps write only pad tokens; greedy AND sampled streams "
             "stay byte-identical to the sync loop. Read only at "
             "engine construction")
+define_flag("gen_sched", False,
+            "SLO-aware tenant-fair scheduler (serving/scheduler.py): one "
+            "admission/preemption brain for the engine loop. Owns queue "
+            "ordering (priority classes + weighted-fair queueing across "
+            "tenants), SLO-aware preemption of batch decode slots by "
+            "interactive streams (park via prompt-fold, byte-identical "
+            "resume), and per-iteration budgets for prefill-chunk size, "
+            "spec-k, page admission and KV-fetch admission driven by "
+            "MetricsHub burn rates and the goodput meter. Hard-off by "
+            "default: the engine keeps its FIFO loop byte-identical and "
+            "reads no sched flags on the hot path. Read only at engine "
+            "construction")
+define_flag("gen_sched_w_interactive", 4.0,
+            "Class weight for 'interactive' priority streams under "
+            "gen_sched weighted-fair queueing. Interactive also ranks "
+            "strictly ahead of lower classes for admission and may "
+            "preempt batch decode slots. Read only at engine "
+            "construction, only while gen_sched is on")
+define_flag("gen_sched_w_batch", 2.0,
+            "Class weight for 'batch' priority streams (the default "
+            "class when a request carries no priority header) under "
+            "gen_sched weighted-fair queueing. Read only at engine "
+            "construction, only while gen_sched is on")
+define_flag("gen_sched_w_best_effort", 1.0,
+            "Class weight for 'best_effort' priority streams under "
+            "gen_sched weighted-fair queueing; best-effort is shed "
+            "earliest under load and never preempts. Read only at "
+            "engine construction, only while gen_sched is on")
+define_flag("gen_sched_quotas", "",
+            "Per-tenant quota hints for the gen_sched scheduler as "
+            "'tenant=share' pairs, comma-separated (e.g. "
+            "'alice=2,bob=1'). Shares scale each tenant's fair-queue "
+            "weight; tenants running over their share (by TenantBook "
+            "chip-seconds) are throttled, not starved. Empty = all "
+            "tenants weighted equally. Read only at engine "
+            "construction, only while gen_sched is on")
+define_flag("gen_sched_chunk", 32,
+            "Prefill-chunk budget the scheduler clamps to when "
+            "interactive streams are queued or the TTFT burn rate runs "
+            "hot, so a long batch prefill cannot monopolize an "
+            "iteration. <= 0 leaves the engine's gen_prefill_chunk "
+            "untouched. Read only at engine construction, only while "
+            "gen_sched is on")
+define_flag("gen_sched_headroom", 2,
+            "Extra queue/inflight slots granted to interactive streams "
+            "past the configured shed caps (gen_queue_max, "
+            "wire_max_inflight) before the scheduler sheds them too; "
+            "best-effort is shed at half the cap. Read only at engine "
+            "construction, only while gen_sched is on")
 # --- serving control plane (serving/control.py ServingController) ---
 define_flag("control_interval_s", 1.0,
             "Cadence of the ServingController reconcile loop (signal "

@@ -34,7 +34,7 @@ from paddle_tpu.core.monitor import (
 
 __all__ = ["send_frame", "recv_frame", "FrameService", "FrameClient",
            "MAX_HEADER_BYTES", "MAX_PAYLOAD_BYTES", "CODE_SHED",
-           "HEALTH_OP", "TRACE_OP", "WireShedError"]
+           "HEALTH_OP", "TRACE_OP", "WireShedError", "PRIORITY_HEADER"]
 
 
 class WireShedError(RuntimeError):
@@ -64,6 +64,13 @@ TRACE_OP = -1
 # wire (kept short: they ride every traced request frame).
 _TRACE_ID_KEY = "tr"
 _TRACE_PARENT_KEY = "sp"
+
+# Request-header key carrying the scheduling priority class (next to the
+# tenant header "tn"): "interactive" / "batch" / "best_effort". Consulted
+# by admission control only when a shed gate is installed
+# (FLAGS_gen_sched routes FrameService shed decisions through the
+# engine's scheduler); inert metadata otherwise.
+PRIORITY_HEADER = "pc"
 
 # Hard caps on request frames arriving at a server. Header/payload lengths
 # come from the (untrusted) peer; without a bound a single corrupt frame
@@ -212,7 +219,7 @@ class FrameService:
                             send_frame(sock, 0, outer.trace_dump(
                                 bool(header.get("clear"))))
                             continue
-                        admitted, reason = outer._try_admit()
+                        admitted, reason = outer._try_admit(header)
                         if not admitted:
                             stat_add("wire/shed_server")
                             outer._shed_frame(sock, reason)
@@ -242,6 +249,11 @@ class FrameService:
         self._conns_lock = threading.Lock()
         self._load_cv = threading.Condition()
         self._inflight = 0
+        # optional admission gate consulted on the WOULD-SHED path only
+        # (set_shed_gate): lets one policy object (the gen scheduler)
+        # own both wire- and engine-level shed decisions, so a request
+        # is never double-shed. None (default) = plain cap behavior.
+        self._shed_gate = None
         self._draining = False
         self._stopping = False
         self._started: float | None = None
@@ -262,15 +274,28 @@ class FrameService:
         return self
 
     # -- admission control -------------------------------------------------
-    def _try_admit(self) -> tuple[bool, str | None]:
+    def set_shed_gate(self, gate) -> None:
+        """Install ``gate(header, inflight, cap) -> bool`` consulted
+        when admission WOULD shed on the in-flight cap (never on
+        drain/stop): True admits past the cap — how interactive-class
+        traffic gets bounded headroom under FLAGS_gen_sched. Pass None
+        to restore the plain cap."""
+        with self._load_cv:
+            self._shed_gate = gate
+
+    def _try_admit(self, header: dict | None = None
+                   ) -> tuple[bool, str | None]:
         """Atomic admit-or-shed decision: check and increment under one
-        lock, so the in-flight count can never overshoot the cap."""
+        lock, so the in-flight count can never overshoot the cap (plus
+        whatever bounded headroom an installed shed gate grants)."""
         with self._load_cv:
             if self._draining or self._stopping:
                 return False, "draining"
             cap = int(flag("wire_max_inflight"))
             if cap > 0 and self._inflight >= cap:
-                return False, "overloaded"
+                gate = self._shed_gate
+                if gate is None or not gate(header, self._inflight, cap):
+                    return False, "overloaded"
             self._inflight += 1
             return True, None
 

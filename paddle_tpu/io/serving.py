@@ -222,6 +222,14 @@ class InferenceServer(FrameService):
             self._generators[name] = engine
         if old is not None and old is not engine:
             old.close()
+        sched = engine.sched
+        if sched is not None:
+            # one shed brain (FLAGS_gen_sched): FrameService's
+            # would-shed path and the dynamic batcher's coalescing
+            # bypass consult the engine's scheduler, so a request is
+            # never double-shed and class headroom applies consistently
+            self.set_shed_gate(sched.wire_gate)
+            self._batcher.set_sched(sched)
 
     def _generator(self, name: str):
         with self._lock:
@@ -358,7 +366,11 @@ class InferenceServer(FrameService):
                         # carried by failover resume so quarantine
                         # recognizes resumed poison even though the
                         # replay prompt grew by the delivered tokens
-                        fingerprint=header.get("fp"))
+                        fingerprint=header.get("fp"),
+                        # priority class ("pc"): the scheduler's
+                        # admission/preemption input (FLAGS_gen_sched;
+                        # ignored by default engines)
+                        priority=header.get("pc"))
                 except EngineOverloaded as e:
                     # full engine: shed, not error — the status is
                     # retryable for every client (the start never ran)
@@ -520,7 +532,8 @@ class InferenceClient(FrameClient):
                        seed: int = 0, rng_skip: int = 0,
                        trace_id: str | None = None,
                        tenant: str | None = None,
-                       fingerprint: str | None = None) -> str:
+                       fingerprint: str | None = None,
+                       priority: str | None = None) -> str:
         """Admit a generation into ``model``'s engine; returns its id.
         A full engine surfaces as the retryable shed status (the client
         backs off per ``retry_after_s`` and retries within its budget,
@@ -538,7 +551,10 @@ class InferenceClient(FrameClient):
         ``fingerprint`` (header ``fp``) is the ORIGINAL stream's crash
         fingerprint: a resuming caller passes it so the engine's
         quarantine matches the stream's history instead of hashing the
-        grown replay prompt."""
+        grown replay prompt. ``priority`` (header ``pc``) is the
+        stream's scheduling class (interactive / batch / best_effort)
+        — consulted by replicas running ``FLAGS_gen_sched``; inert
+        metadata elsewhere."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         header = {"model": model, "prompt": prompt.tolist(),
                   "max_new_tokens": int(max_new_tokens),
@@ -556,6 +572,8 @@ class InferenceClient(FrameClient):
             header["tn"] = str(tenant)
         if fingerprint:
             header["fp"] = str(fingerprint)
+        if priority:
+            header["pc"] = str(priority)
         try:
             return self._request("generate_start", header)[0]["gen_id"]
         except RuntimeError as e:

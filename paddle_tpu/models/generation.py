@@ -58,6 +58,9 @@ POOL_KV_SPEC = _P(None, None, "tp")
 PAGE_TABLE_SPEC = _P()
 
 
+_advance_key_jit = None
+
+
 def advance_key(key, steps):
     """Advance a PRNG key by ``steps`` split-and-keep-first operations —
     exactly the per-emitted-token key schedule of the serving
@@ -67,10 +70,17 @@ def advance_key(key, steps):
     ``advance_key(PRNGKey(seed), tokens_already_delivered)``: token
     ``k`` of the resumed stream then draws from the same subkey as
     token ``k`` of the uninterrupted one. ``steps`` may be traced (the
-    loop is a ``lax.fori_loop``); 0 returns the key unchanged."""
-    return jax.lax.fori_loop(
-        0, jnp.asarray(steps, jnp.int32),
-        lambda i, k: jax.random.split(k)[0], key)
+    loop is a ``lax.fori_loop``); 0 returns the key unchanged.
+
+    The loop is jitted once per process: the engine calls this eagerly
+    on every preemption resume and failover replay, and an un-jitted
+    ``fori_loop`` re-traces on each call — tens of milliseconds on the
+    hot resume path for what is microseconds of device work."""
+    global _advance_key_jit
+    if _advance_key_jit is None:
+        _advance_key_jit = jax.jit(lambda k, n: jax.lax.fori_loop(
+            0, n, lambda i, kk: jax.random.split(kk)[0], k))
+    return _advance_key_jit(key, jnp.asarray(steps, jnp.int32))
 
 
 def sample_logits(logits, key=None, *, temperature: float = 1.0,

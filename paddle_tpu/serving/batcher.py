@@ -114,6 +114,16 @@ class DynamicBatcher:
         # run's wall clock splits evenly across its riders. None — the
         # default — books nothing and costs one is-None check per run.
         self._book = tenant_book
+        # the replica's GenScheduler (FLAGS_gen_sched, installed by
+        # InferenceServer.add_generator): consulted per submit for a
+        # coalescing bypass while interactive SLO burn runs hot. None —
+        # the default — costs one is-None check.
+        self._sched = None
+
+    def set_sched(self, sched) -> None:
+        """Route this batcher's shed/bypass hints through the replica's
+        generation scheduler (the one-shed-brain contract)."""
+        self._sched = sched
 
     @staticmethod
     def can_batch(pred) -> bool:
@@ -139,6 +149,13 @@ class DynamicBatcher:
             # coalescing window entirely — idle traffic must not pay the
             # timeout tax for a batch that is never coming
             solo = min_q > 0 and q.inflight < min_q and not q.items
+        if (not solo and self._sched is not None
+                and self._sched.infer_bypass(tenant)):
+            # scheduler hint: interactive TTFT burn is hot — skip the
+            # coalescing window so this request does not pay the
+            # batching tax while latency budget is being spent
+            solo = True
+            stat_add("serving/batch_sched_bypass")
         try:
             if solo:
                 stat_add("serving/batch_bypass")

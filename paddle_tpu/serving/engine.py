@@ -222,7 +222,8 @@ class Generation:
                  "prefill_pos", "prefill_t0", "delivered", "fingerprint",
                  "rng_skip", "spec_proposed", "spec_accepted", "trace_id",
                  "tenant", "admitted_ts", "first_tok_ts", "done_ts",
-                 "chip_s", "ledgered", "dev_ops")
+                 "chip_s", "ledgered", "dev_ops", "pclass", "folded",
+                 "queue_booked", "sched_seq", "sched_vft", "sched_ts")
 
     def __init__(self, gen_id: str, prompt: np.ndarray,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -282,6 +283,16 @@ class Generation:
         # immutable for the generation's lifetime, so chunked prefill
         # stops re-materializing them every chunk
         self.dev_ops: tuple | None = None
+        # scheduler books (FLAGS_gen_sched; inert defaults otherwise):
+        # priority class, tokens already folded into the prompt by a
+        # preemption park, queue wait booked live at admission, and the
+        # fair-queue tag/sequence/admission-stamp the scheduler assigns
+        self.pclass = "batch"
+        self.folded = 0
+        self.queue_booked = 0.0
+        self.sched_seq = 0
+        self.sched_vft = 0.0
+        self.sched_ts = 0.0
 
 
 class _PagePool:
@@ -529,7 +540,8 @@ class GenerationEngine:
                  mesh_tp: int | None = None, ledger=None,
                  kv_store=None, role: str | None = None,
                  device_pt: bool | None = None,
-                 async_depth: int | None = None):
+                 async_depth: int | None = None,
+                 sched=None):
         if slots is None:
             slots = int(flag("gen_slots"))
         if slots <= 0:
@@ -698,6 +710,25 @@ class GenerationEngine:
             self._kv_owned = False
             self._kv_fetch = False
             self._kv_admit_s = 0.0
+        # SLO-aware tenant-fair scheduler (hard-off by default:
+        # gen_sched=False builds none, and every hot-path gate below is
+        # a single is-None attribute check — the ledger discipline.
+        # Flags are read HERE only, never per iteration). sched=
+        # accepts True/False to force, or a GenScheduler to share one —
+        # how the serving layer routes FrameService/DynamicBatcher shed
+        # decisions through the same policy object as the loop.
+        sc = flag("gen_sched") if sched is None else sched
+        if sc:
+            from paddle_tpu.serving.scheduler import GenScheduler
+            self._sched = (sc if isinstance(sc, GenScheduler)
+                           else GenScheduler())
+            if self._ledger is not None:
+                self._sched.attach_book(self._ledger.book)
+        else:
+            self._sched = None
+        # the scheduler's decision for the CURRENT loop iteration
+        # (None whenever gen_sched is off — hot paths gate on it)
+        self._plan = None
 
         if self._paged:
             P = int(flag("gen_page_tokens") if page_tokens is None
@@ -1247,13 +1278,22 @@ class GenerationEngine:
                         e2e_s=round(rec["e2e_s"], 6),
                         resumed=int(gen.rng_skip > 0))
 
+    @property
+    def sched(self):
+        """The engine's :class:`~paddle_tpu.serving.scheduler.
+        GenScheduler`, or None with ``FLAGS_gen_sched`` off — how the
+        serving layer routes FrameService/batcher shed decisions
+        through the same policy object."""
+        return self._sched
+
     # -- public surface ----------------------------------------------------
     def start(self, prompt, max_new_tokens: int, *, temperature: float = 0.0,
               top_k: int = 0, top_p: float = 1.0, eos_token_id=_UNSET,
               seed: int = 0, rng_skip: int = 0,
               trace_id: str | None = None,
               tenant: str | None = None,
-              fingerprint: str | None = None) -> str:
+              fingerprint: str | None = None,
+              priority: str | None = None) -> str:
         """Enqueue a generation; returns its id immediately. Raises
         :class:`EngineOverloaded` (retryable) when every slot is busy and
         the admit queue is at ``queue_max``, and the typed
@@ -1272,7 +1312,11 @@ class GenerationEngine:
         from the request itself: a resumed stream's replay prompt grew
         by the delivered tokens, so the resuming client passes the
         ORIGINAL stream's fingerprint — quarantine then recognizes
-        resumed poison instead of admitting it under a fresh hash."""
+        resumed poison instead of admitting it under a fresh hash.
+        ``priority`` (wire header ``pc``) is the request's scheduling
+        class (interactive / batch / best_effort) — consulted only when
+        ``FLAGS_gen_sched`` built a scheduler; ignored (recorded but
+        inert) otherwise."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -1315,6 +1359,8 @@ class GenerationEngine:
             gen.trace_id = str(trace_id)
         if tenant:
             gen.tenant = str(tenant)
+        if self._sched is not None:
+            gen.pclass = self._sched.classify(priority)
         with self._cond:
             if self._stopping:
                 raise RuntimeError("GenerationEngine is stopped")
@@ -1340,8 +1386,13 @@ class GenerationEngine:
                     f"(gen_watchdog_s={self._watchdog_s:g}); retry "
                     "elsewhere", retry_after_s=_jittered(0.5))
             free = sum(g is None for g in self._slot_gen)
-            if (self._queue_max > 0
-                    and len(self._queue) - free >= self._queue_max):
+            pending = len(self._queue) - free
+            shed = (self._sched.shed_start(gen.pclass, pending,
+                                           self._queue_max)
+                    if self._sched is not None
+                    else (self._queue_max > 0
+                          and pending >= self._queue_max))
+            if shed:
                 stat_add("gen/shed")
                 pool = ("" if not self._paged else
                         f", {self._pool.free_count}/"
@@ -1351,6 +1402,8 @@ class GenerationEngine:
                     f"{len(self._queue)} queued (queue_max="
                     f"{self._queue_max}){pool}",
                     retry_after_s=_jittered(0.25))
+            if self._sched is not None:
+                self._sched.on_enqueue(gen)
             self._queue.append(gen)
             self._gens[gen.gen_id] = gen
             stat_set("gen/queue_depth", len(self._queue))
@@ -1509,6 +1562,11 @@ class GenerationEngine:
                 doc["goodput"] = self._goodput.snapshot()
             if self._ledger is not None:
                 doc["tenants"] = self._ledger.tenants()
+            # scheduler books (FLAGS_gen_sched only): preemption/shed/
+            # quota counters + class weights. Absent with the scheduler
+            # off so the default health doc is byte-identical.
+            if self._sched is not None:
+                doc["sched"] = self._sched.snapshot()
             # disaggregated serving (FLAGS_gen_kv_store only): store
             # tiers + this engine's produce/consume counters. Absent
             # with the store off so the default health doc is
@@ -1651,6 +1709,16 @@ class GenerationEngine:
                     raise _EpochChanged("watchdog marked the engine "
                                         "stuck")
                 self._reap_expired()
+                if self._sched is not None:
+                    # one brain, once per iteration: re-order the wait
+                    # queue (class rank + fair tags) and fix this
+                    # iteration's budgets; park victims when an
+                    # interactive head is waiting on a full engine
+                    with self._cond:
+                        self._plan = self._sched.plan(self._queue,
+                                                      self._slot_gen)
+                    if self._plan.preempt:
+                        self._preempt_tick()
                 if self._paged:
                     progressed = self._admit_paged()
                     progressed |= self._prefill_tick()
@@ -1959,6 +2027,9 @@ class GenerationEngine:
                 gen.slot = slot
                 if self._ledger is not None:
                     gen.admitted_ts = time.monotonic()
+                    self._ledger.book_admission(gen, gen.admitted_ts)
+                if self._sched is not None:
+                    self._sched.note_admitted(gen)
                 stat_set("gen/slots_active",
                          sum(g is not None for g in self._slot_gen))
                 self._gen_event(gen, "gen/admitted", slot=slot,
@@ -1991,8 +2062,12 @@ class GenerationEngine:
                 # scatter may touch one page past the declared worst
                 # case (rejected offsets are null-page-masked, but the
                 # ACCEPTED prefix must land in owned pages)
+                # a parked (preempted) generation folded its emitted
+                # tokens into the prompt: max_new shrinks by the same
+                # amount, so its reservation never grows past the
+                # original worst case (folded is 0 for fresh requests)
                 need = -(-(gen.prompt.size + gen.max_new_tokens
-                           + self._spec_k) // P)
+                           - gen.folded + self._spec_k) // P)
                 matched: list[int] = []
                 if self._prefix is not None:
                     matched = self._prefix.match(gen.prompt, self._pool)
@@ -2032,6 +2107,10 @@ class GenerationEngine:
                 if need - len(matched) > self._pool.free_count:
                     for pid in matched:     # give the hits back; retry
                         self._pool.release(pid)   # when pages free up
+                    if (self._plan is not None
+                            and self._plan.hol_window > 0
+                            and self._hol_bypass_locked()):
+                        continue        # a smaller request jumped ahead
                     stat_set("gen/queue_depth", len(self._queue))
                     stat_set("gen/pages_free", self._pool.free_count)
                     return progressed
@@ -2043,6 +2122,9 @@ class GenerationEngine:
                 gen.slot = slot
                 if self._ledger is not None:
                     gen.admitted_ts = time.monotonic()
+                    self._ledger.book_admission(gen, gen.admitted_ts)
+                if self._sched is not None:
+                    self._sched.note_admitted(gen)
                 gen.prefilling = True
                 gen.prefill_pos = len(matched) * P
                 gen.prefill_t0 = time.perf_counter()
@@ -2060,6 +2142,85 @@ class GenerationEngine:
                                 prompt_len=int(gen.prompt.size),
                                 pages=len(gen.pages), shared=gen.shared)
                 progressed = True
+
+    # -- scheduler mechanics (FLAGS_gen_sched; never run otherwise) --------
+    def _hol_bypass_locked(self) -> bool:
+        """The queue head is blocked on pages: scan the plan's bounded
+        window past it for a request whose worst case fits the free
+        pool RIGHT NOW and rotate it to the front. The scheduler
+        re-orders the queue every iteration, so the bypassed head
+        returns to the front as soon as pages free up — bounded, not
+        starvation. Caller holds the lock; True when a candidate
+        moved (the admit loop then retries)."""
+        P = self._page_tokens
+        limit = min(len(self._queue), self._plan.hol_window + 1)
+        for i in range(1, limit):
+            g = self._queue[i]
+            if g.done:
+                continue
+            need = -(-(g.prompt.size + g.max_new_tokens - g.folded
+                       + self._spec_k) // P)
+            if need <= self._pool.free_count:
+                del self._queue[i]
+                self._queue.appendleft(g)
+                stat_add("gen/sched_hol_bypass")
+                return True
+        return False
+
+    def _preempt_tick(self) -> None:
+        """An interactive request heads the queue with every slot busy:
+        park the scheduler's chosen victim (strictly lower class, most
+        recently admitted) so the next admit tick seats the interactive
+        stream. Paged engines only — parking releases pages, and resume
+        rides the chunked-prefill path. Loop thread only."""
+        if not self._paged:
+            return
+        # flush the async dispatch lookahead first: no in-flight step
+        # may hold a snapshot of a slot this tick is about to clear
+        # (their lagged tokens would hit the identity guard anyway, but
+        # draining keeps every parked stream's token list final)
+        self._drain_pending()
+        with self._cond:
+            if not self._queue:
+                return
+            head = self._queue[0]
+            if head.done or head.slot is not None:
+                return
+            if any(g is None for g in self._slot_gen):
+                return                  # a slot freed meanwhile
+            cands = [(s, g) for s, g in enumerate(self._slot_gen)
+                     if g is not None and not g.prefilling
+                     and not g.done]
+            for _s, victim in self._sched.choose_victims(
+                    cands, head.pclass, 1):
+                self._park_locked(victim)
+
+    def _park_locked(self, gen: Generation) -> None:
+        """Preempt a decoding generation without losing a byte: fold
+        the tokens it has emitted into its prompt and advance
+        ``rng_skip`` by the same count (one sampling split per emitted
+        token — exactly the cross-replica resume contract the
+        determinism tests pin), release its slot and pages, and
+        re-queue it. Re-admission chunk-prefills the folded prompt —
+        the prefix cache turns that into a table rebuild when the pages
+        survived — and decode continues byte-identically. Delivered
+        tokens stay on ``gen.tokens``; pollers never notice beyond the
+        pause. Caller holds the lock."""
+        new = np.asarray(gen.tokens[gen.folded:], np.int32)
+        if new.size:
+            gen.prompt = np.concatenate([gen.prompt, new])
+            gen.rng_skip += int(new.size)
+            gen.folded = len(gen.tokens)
+            gen.dev_ops = None          # PRNG start moved with rng_skip
+        gen.prefill_pos = 0
+        self._release_slot_locked(gen)
+        self._sched.note_parked(gen)
+        self._sched.on_enqueue(gen)     # re-tag at current virtual time
+        self._queue.append(gen)
+        stat_add("gen/preemptions")
+        stat_set("gen/queue_depth", len(self._queue))
+        self._gen_event(gen, "gen/parked", tokens=len(gen.tokens),
+                        folded=int(gen.folded))
 
     def _page_frame(self, pid: int) -> bytes:
         """Serialize pool page ``pid`` (one device->host fetch per
@@ -2130,6 +2291,12 @@ class GenerationEngine:
         if start >= cap:
             return []
         t0 = time.perf_counter()
+        kv_budget = self._kv_admit_s
+        if self._plan is not None:
+            # scheduler budget: tighten the fetch window under
+            # interactive SLO pressure (the miss degrades to local
+            # recompute — byte-identical, just compute instead of I/O)
+            kv_budget *= self._plan.kv_scale
         keys = page_chain_keys(gen.prompt, P, limit=cap)
         shapes = [(tuple(pl.shape[1:]), pl.dtype)
                   for pl in self._state["cache"]]
@@ -2142,8 +2309,8 @@ class GenerationEngine:
             for key in keys[start:]:
                 if gen.done or self._stuck or self._stopping:
                     break
-                if (self._kv_admit_s > 0
-                        and time.perf_counter() - t0 > self._kv_admit_s):
+                if (kv_budget > 0
+                        and time.perf_counter() - t0 > kv_budget):
                     # admission-level budget across the whole chain:
                     # the rest is recompute debt, not a wedge
                     degraded = True
@@ -2248,6 +2415,11 @@ class GenerationEngine:
             T0 = gen.prompt.size
             a = gen.prefill_pos
             C = self._prefill_chunk if self._prefill_chunk > 0 else T0 - a
+            if self._plan is not None and self._plan.prefill_chunk:
+                # scheduler budget: clamp this iteration's chunk so a
+                # long batch prefill cannot monopolize the loop while
+                # interactive work waits
+                C = min(C, self._plan.prefill_chunk)
             b = min(T0, a + C)
             final = b >= T0
             smax = self._maxp * self._page_tokens
@@ -2301,12 +2473,21 @@ class GenerationEngine:
                 if self._kv is not None:
                     self._kv_publish(gen)
                 gen.tokens.append(tok0)
-                if self._ledger is not None:
+                if self._ledger is not None and gen.first_tok_ts == 0.0:
                     gen.first_tok_ts = time.monotonic()
-                # TTFT = enqueue -> first token (queue wait included):
-                # the latency an interactive SLO is actually about, and
-                # the signal the serving control plane autoscales on
-                observe("gen/ttft_s", time.monotonic() - gen.created)
+                if gen.folded == 0:
+                    # TTFT = enqueue -> first token (queue wait
+                    # included): the latency an interactive SLO is
+                    # actually about, and the signal the serving
+                    # control plane autoscales on. A parked stream's
+                    # resume-prefill is NOT a first token — its TTFT
+                    # was observed before the preemption.
+                    observe("gen/ttft_s", time.monotonic() - gen.created)
+                    if self._sched is not None and gen.tenant:
+                        # per-tenant split: the fairness input
+                        # MetricsHub.burn_rates(tenant=) reads
+                        observe(f"gen/ttft_s/{gen.tenant}",
+                                time.monotonic() - gen.created)
                 stat_add("gen/tokens")
                 if ((gen.eos_token_id is not None
                      and tok0 == gen.eos_token_id)
@@ -2361,6 +2542,9 @@ class GenerationEngine:
             if self._ledger is not None:
                 gen.first_tok_ts = time.monotonic()
             observe("gen/ttft_s", time.monotonic() - gen.created)
+            if self._sched is not None and gen.tenant:
+                observe(f"gen/ttft_s/{gen.tenant}",
+                        time.monotonic() - gen.created)
             stat_add("gen/tokens")
             if ((gen.eos_token_id is not None
                  and tok0 == gen.eos_token_id)
@@ -2391,7 +2575,14 @@ class GenerationEngine:
                       if self._paged and stepped else None)
             epoch0 = self._epoch
             specable: list[tuple[int, np.ndarray, int]] = []
-            if self._spec_k > 0:
+            spec_k = self._spec_k
+            if (spec_k > 0 and self._plan is not None
+                    and self._plan.spec_budget is not None):
+                # scheduler budget: 0 sheds speculation outright this
+                # iteration (interactive work is waiting — the verify
+                # step's extra width would delay it); otherwise a cap
+                spec_k = min(spec_k, self._plan.spec_budget)
+            if spec_k > 0:
                 # load-adaptive shedding: above the occupancy threshold
                 # batched decode already fills the device — speculative
                 # FLOPs would only starve co-tenant slots, so the whole
@@ -2404,7 +2595,7 @@ class GenerationEngine:
                          np.concatenate(
                              [g.prompt,
                               np.asarray(g.tokens, np.int32)]),
-                         min(self._spec_k,
+                         min(spec_k,
                              g.max_new_tokens - len(g.tokens) - 1))
                         for s, g in stepped]
         if not stepped:

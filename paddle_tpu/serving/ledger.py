@@ -184,9 +184,29 @@ class RequestLedger:
         self._records: deque[dict] = deque(maxlen=max(int(records), 1))
         self._book = TenantBook()
 
+    @property
+    def book(self) -> TenantBook:
+        """The live tenant book (scheduler quota input)."""
+        return self._book
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._records)
+
+    def book_admission(self, gen, now: float | None = None) -> None:
+        """Book the generation's queue wait into the tenant book LIVE
+        at admission time, so in-flight scheduler decisions see current
+        per-tenant waits instead of only finalized ones. Finalize stays
+        authoritative: it books the (clamped) remainder, so per-tenant
+        ``queue_wait_s`` totals match the finalize-only path exactly."""
+        ts = time.monotonic() if now is None else float(now)
+        wait = max(ts - gen.created, 0.0)
+        # a preempted-and-readmitted generation books here twice: only
+        # the delta past the previous booking is added, so the running
+        # total never double counts
+        self._book.add(gen.tenant,
+                       queue_wait_s=max(wait - gen.queue_booked, 0.0))
+        gen.queue_booked = wait
 
     def finalize(self, gen, outcome: str,
                  now: float | None = None) -> dict:
@@ -223,9 +243,14 @@ class RequestLedger:
                            "accepted": int(gen.spec_accepted)}
         with self._lock:
             self._records.append(rec)
+        # queue wait may have been booked live at admission
+        # (book_admission); finalize books only the remainder so the
+        # per-tenant total is exactly the authoritative admit_wait_s
         self._book.add(rec["tenant"], tokens=len(gen.tokens),
                        chip_s=gen.chip_s,
-                       queue_wait_s=phases["admit_wait_s"], requests=1)
+                       queue_wait_s=(phases["admit_wait_s"]
+                                     - getattr(gen, "queue_booked", 0.0)),
+                       requests=1)
         observe("gen/e2e_s", e2e)
         for ph, v in phases.items():
             observe(f"gen/phase/{ph}", v)

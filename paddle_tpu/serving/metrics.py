@@ -250,10 +250,19 @@ class MetricsHub:
         return total / (t_hi - t_lo)
 
     def burn_rates(self, name: str, threshold: float,
-                   budget: float) -> tuple[float, float]:
+                   budget: float, tenant: str | None = None
+                   ) -> tuple[float, float]:
         """(fast, slow) SLO burn rates for histogram ``name`` against
         ``threshold``: violating-fraction / ``budget`` per window.  No
-        observations in a window → 0.0 (no traffic burns no budget)."""
+        observations in a window → 0.0 (no traffic burns no budget).
+
+        ``tenant=`` narrows to the per-tenant split of the histogram
+        (``<name>/<tenant>`` — the engine observes e.g.
+        ``gen/ttft_s/<tn>`` next to the fleet-wide series when a
+        tenant header rode the request), so fairness decisions can
+        cite per-tenant SLO burn rather than only fleet-wide."""
+        if tenant:
+            name = f"{name}/{tenant}"
         burns = []
         for w in (self.fast_ticks, self.slow_ticks):
             h = self.window_histogram(name, w)

@@ -129,6 +129,21 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated peer replica endpoints for "
                          "the KV store's peer tier "
                          "(FLAGS_gen_kv_peers per replica)")
+    ap.add_argument("--gen-sched", action="store_true",
+                    help="enable the SLO-aware tenant-fair scheduler "
+                         "for the --gen engine (FLAGS_gen_sched per "
+                         "replica): priority classes on the 'pc' "
+                         "header, weighted-fair queueing across "
+                         "tenants, interactive-over-batch preemption "
+                         "with byte-identical resume")
+    ap.add_argument("--gen-sched-quotas", default=None,
+                    help="per-tenant quota shares for the scheduler as "
+                         "'tenant=share,...' (FLAGS_gen_sched_quotas "
+                         "per replica)")
+    ap.add_argument("--gen-sched-headroom", type=int, default=None,
+                    help="interactive shed headroom past the queue/"
+                         "inflight caps (FLAGS_gen_sched_headroom per "
+                         "replica)")
     args = ap.parse_args(argv)
 
     if args.mesh_tp > 0:
@@ -161,6 +176,12 @@ def main(argv: list[str] | None = None) -> int:
         "gen_kv_peers": args.kv_peers,
     }
     kv_flags = {k: v for k, v in kv_flags.items() if v is not None}
+    if args.gen_sched:
+        kv_flags["gen_sched"] = True
+    if args.gen_sched_quotas is not None:
+        kv_flags["gen_sched_quotas"] = args.gen_sched_quotas
+    if args.gen_sched_headroom is not None:
+        kv_flags["gen_sched_headroom"] = args.gen_sched_headroom
     if kv_flags:
         set_flags(kv_flags)
 

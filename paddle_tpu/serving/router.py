@@ -689,7 +689,8 @@ class StickySession:
                  top_p: float = 1.0, eos_token_id: int | None = None,
                  seed: int = 0, poll_wait_s: float = 0.25,
                  resume_budget: int | None = None,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 priority: str | None = None):
         """Streaming generation pinned to the session's replica: start,
         every poll, and the close-time cancel all hit the replica
         holding the slot. Returns an iterator of token ids.
@@ -720,7 +721,7 @@ class StickySession:
         kw = dict(temperature=temperature, top_k=top_k, top_p=top_p,
                   eos_token_id=eos_token_id, seed=seed,
                   poll_wait_s=poll_wait_s, trace_id=trace_id,
-                  tenant=tenant)
+                  tenant=tenant, priority=priority)
         if self._router._kv_locality:
             self._kv_place(prompt)
         if budget <= 0:
@@ -734,7 +735,8 @@ class StickySession:
                      poll_wait_s: float, rng_skip: int = 0,
                      trace_id: str | None = None,
                      tenant: str | None = None,
-                     fingerprint: str | None = None):
+                     fingerprint: str | None = None,
+                     priority: str | None = None):
         """One pinned stream attempt (the pre-resumption ``generate``
         body). Server-side failures that lost the slot state but left
         the replica up — the ``engine reset:`` marker — surface as
@@ -747,7 +749,8 @@ class StickySession:
                 model, prompt, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
                 seed=seed, rng_skip=rng_skip, trace_id=trace_id,
-                tenant=tenant, fingerprint=fingerprint),
+                tenant=tenant, fingerprint=fingerprint,
+                priority=priority),
             during_generation=True)
         with self._lock:
             self._active += 1
@@ -798,7 +801,8 @@ class StickySession:
                          eos_token_id: int | None, seed: int,
                          poll_wait_s: float, budget: int,
                          trace_id: str | None = None,
-                         tenant: str | None = None):
+                         tenant: str | None = None,
+                         priority: str | None = None):
         """Drive :meth:`_stream_once` attempts, replaying
         ``prompt + delivered`` onto a freshly pinned replica after each
         mid-flight loss, until the stream completes or the budget is
@@ -824,7 +828,8 @@ class StickySession:
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, eos_token_id=eos_token_id,
                         seed=seed, poll_wait_s=poll_wait_s,
-                        trace_id=trace_id, tenant=tenant)
+                        trace_id=trace_id, tenant=tenant,
+                        priority=priority)
                 else:
                     replay = np.concatenate(
                         [prompt, np.asarray(delivered, np.int32)])
@@ -840,7 +845,7 @@ class StickySession:
                         top_p=top_p, eos_token_id=eos_token_id,
                         seed=seed, poll_wait_s=poll_wait_s, rng_skip=n0,
                         trace_id=trace_id, tenant=tenant,
-                        fingerprint=fp)
+                        fingerprint=fp, priority=priority)
                 for tok in inner:
                     delivered.append(int(tok))
                     yield int(tok)

@@ -1,0 +1,393 @@
+"""SLO-aware, tenant-fair generation scheduler (``FLAGS_gen_sched``,
+hard-off).
+
+One admission/preemption brain for the serving loop. Before this module,
+scheduling policy was smeared across four places — FrameService's
+inflight cap, the DynamicBatcher leader/follower, the GenerationEngine
+slot loop (FIFO queue + ad-hoc knobs: page admission, prefill chunking,
+spec shedding, KV-fetch budget), and the router. :class:`GenScheduler`
+centralizes every per-iteration policy decision, in the Orca (OSDI '22)
+iteration-level idiom the engine loop already follows mechanically:
+
+- **Priority classes.** Requests carry ``interactive`` / ``batch`` /
+  ``best_effort`` on the wire (header ``"pc"``, next to ``"tn"``);
+  unclassed traffic is ``batch``. Interactive ranks strictly first for
+  admission, gets shed headroom past the queue/inflight caps, and may
+  preempt batch decode slots; best-effort is shed earliest and never
+  preempts.
+- **Weighted-fair queueing across tenants.** Start-time fair queueing
+  (virtual-time tags) over the engine's wait queue: each (tenant,
+  class) stream accrues virtual finish tags at a rate inversely
+  proportional to its effective weight — class weight × tenant quota
+  share, throttled when :class:`~paddle_tpu.serving.ledger.TenantBook`
+  shows the tenant running over its chip-second share. Tags are
+  assigned at enqueue and the queue is re-ordered (stable) each
+  iteration, so a hot tenant cannot starve the others regardless of
+  arrival order.
+- **SLO-aware preemption.** When an interactive request is waiting and
+  the engine has no free capacity, the scheduler picks victim slots
+  (strictly lower class, most recently admitted first). The engine
+  *parks* the victim by folding its emitted tokens into the prompt
+  (the same prompt-replay + ``rng_skip`` contract the cross-replica
+  resume path pins), releasing its slot/pages, and re-queueing it —
+  resume is an ordinary re-admission whose chunked prefill recomputes
+  the folded prefix, byte-identical for greedy and sampled streams.
+- **Per-iteration budgets.** Each loop iteration asks
+  :meth:`GenScheduler.plan` for an :class:`IterationPlan`: prefill
+  chunk clamp, spec-k budget, KV-fetch admission scale, and a
+  head-of-line bypass window — driven by whether interactive work is
+  queued and by ``gen/ttft_s`` burn rates from an attached
+  :class:`~paddle_tpu.serving.metrics.MetricsHub`.
+- **One shed brain.** FrameService routes its would-shed decisions
+  through :meth:`wire_gate` and the engine's ``start()`` through
+  :meth:`shed_start`, so a request is never double-shed and class
+  headroom is applied consistently; the DynamicBatcher consults
+  :meth:`infer_bypass` to skip coalescing while interactive SLO burn
+  runs hot.
+
+Hard-off discipline: all flags are read here, at construction, once.
+With ``gen_sched`` off the engine holds no scheduler and every hot-path
+gate is a single ``is None`` attribute check — the default loop is
+byte-identical (spy-pinned by ``tests/test_scheduler.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import observe
+
+__all__ = ["GenScheduler", "IterationPlan", "INTERACTIVE", "BATCH",
+           "BEST_EFFORT", "CLASSES", "classify"]
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+BEST_EFFORT = "best_effort"
+CLASSES = (INTERACTIVE, BATCH, BEST_EFFORT)
+
+# admission rank: lower runs first; preemption is allowed only against
+# strictly greater (worse) ranks
+_RANK = {INTERACTIVE: 0, BATCH: 1, BEST_EFFORT: 2}
+
+# accepted spellings of the "pc" wire header, normalized
+_ALIASES = {
+    "interactive": INTERACTIVE, "rt": INTERACTIVE, "realtime": INTERACTIVE,
+    "0": INTERACTIVE,
+    "batch": BATCH, "1": BATCH,
+    "best_effort": BEST_EFFORT, "best-effort": BEST_EFFORT,
+    "be": BEST_EFFORT, "2": BEST_EFFORT,
+}
+
+#: default TTFT SLO threshold (seconds) the burn-rate probe uses when
+#: none is supplied to :meth:`GenScheduler.attach_hub`
+DEFAULT_TTFT_SLO_S = 0.5
+#: error budget (violating fraction) the burn-rate probe divides by
+DEFAULT_TTFT_BUDGET = 0.1
+#: recompute the (hub-backed) pressure signal at most every N plans —
+#: keeps the per-iteration cost of an attached hub to a counter bump
+_HUB_SAMPLE_EVERY = 64
+
+
+def classify(priority: Any) -> str:
+    """Map a wire ``"pc"`` header value to a priority class; anything
+    unrecognized (including absent) is ``batch``."""
+    if priority is None:
+        return BATCH
+    return _ALIASES.get(str(priority).strip().lower(), BATCH)
+
+
+class IterationPlan:
+    """What the scheduler decided for ONE engine-loop iteration.
+
+    Every field has a "leave the engine's own policy alone" value so the
+    loop applies the plan with cheap truthiness checks:
+
+    - ``prefill_chunk``: clamp for this iteration's prefill chunk
+      (tokens), or ``None`` to keep the engine's configured chunking.
+    - ``spec_budget``: cap on speculative draft length this iteration
+      (``0`` sheds speculation entirely), or ``None`` for the engine's
+      own occupancy-based shedding.
+    - ``kv_scale``: multiplier on the KV-fetch admission time budget
+      (``1.0`` = unchanged; tightened under interactive pressure).
+    - ``hol_window``: how many queue entries past a page-blocked head
+      admission may scan for one that fits (head-of-line bypass);
+      ``0`` keeps strict head-only admission.
+    - ``preempt``: whether an interactive request is waiting and may
+      claim a slot from a lower class this iteration.
+    """
+
+    __slots__ = ("prefill_chunk", "spec_budget", "kv_scale",
+                 "hol_window", "preempt")
+
+    def __init__(self, prefill_chunk: int | None = None,
+                 spec_budget: int | None = None, kv_scale: float = 1.0,
+                 hol_window: int = 0, preempt: bool = False):
+        self.prefill_chunk = prefill_chunk
+        self.spec_budget = spec_budget
+        self.kv_scale = kv_scale
+        self.hol_window = hol_window
+        self.preempt = preempt
+
+
+class GenScheduler:
+    """The admission/preemption brain. One instance per engine; the
+    serving layer shares it with FrameService and the DynamicBatcher so
+    every shed/bypass decision flows through the same policy object.
+
+    Thread-safety: the engine calls :meth:`plan` / :meth:`on_enqueue` /
+    :meth:`note_admitted` under its own lock; the wire/batcher hooks
+    (:meth:`wire_gate`, :meth:`infer_bypass`, :meth:`shed_start`) may
+    race them, so all mutable scheduler state sits behind an internal
+    lock of its own.
+    """
+
+    def __init__(self, tenant_book=None):
+        self._lock = threading.Lock()
+        self._w = {
+            INTERACTIVE: max(float(flag("gen_sched_w_interactive")), 1e-6),
+            BATCH: max(float(flag("gen_sched_w_batch")), 1e-6),
+            BEST_EFFORT: max(float(flag("gen_sched_w_best_effort")), 1e-6),
+        }
+        self._quotas = self._parse_quotas(flag("gen_sched_quotas"))
+        self._chunk = int(flag("gen_sched_chunk"))
+        self._headroom = max(int(flag("gen_sched_headroom")), 0)
+        self._book = tenant_book      # TenantBook (may be None)
+        self._hub = None              # MetricsHub (attach_hub)
+        self._slo_s = DEFAULT_TTFT_SLO_S
+        self._slo_budget = DEFAULT_TTFT_BUDGET
+        # start-time fair queueing state: global virtual time + the last
+        # virtual finish tag per (tenant, class) backlog
+        self._vt = 0.0
+        self._tags: dict[tuple[str, str], float] = {}
+        self._seq = 0
+        # hub-pressure cache (recomputed every _HUB_SAMPLE_EVERY plans)
+        self._plans = 0
+        self._hot = False
+        # policy counters (shipped in the engine's stats "sched" block)
+        self._preemptions = 0
+        self._quota_throttles = 0
+        self._admitted = {c: 0 for c in CLASSES}
+        self._sheds = {c: 0 for c in CLASSES}
+
+    # -- construction-time wiring -----------------------------------------
+    @staticmethod
+    def _parse_quotas(spec: str) -> dict[str, float]:
+        """``'alice=2,bob=1'`` → ``{'alice': 2.0, 'bob': 1.0}``; junk
+        entries are dropped rather than raised (flags may come from
+        operators' CLIs)."""
+        out: dict[str, float] = {}
+        for part in str(spec or "").split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            name, _, val = part.partition("=")
+            try:
+                share = float(val)
+            except ValueError:
+                continue
+            if name.strip() and share > 0:
+                out[name.strip()] = share
+        return out
+
+    def attach_hub(self, hub, slo_s: float | None = None,
+                   budget: float | None = None) -> None:
+        """Give the scheduler a MetricsHub to read ``gen/ttft_s`` burn
+        rates from (fleet-wide and per-tenant)."""
+        with self._lock:
+            self._hub = hub
+            if slo_s is not None:
+                self._slo_s = float(slo_s)
+            if budget is not None:
+                self._slo_budget = float(budget)
+
+    def attach_book(self, book) -> None:
+        with self._lock:
+            self._book = book
+
+    # -- classification / fair-queue tagging -------------------------------
+    classify = staticmethod(classify)
+
+    def _weight(self, tenant: str | None, pclass: str) -> float:
+        """Effective WFQ weight: class weight × tenant quota share,
+        throttled (not zeroed) when the tenant is consuming chip-seconds
+        beyond its share. Caller holds self._lock."""
+        w = self._w[pclass] * self._quotas.get(tenant or "", 1.0)
+        if self._book is not None and self._quotas:
+            snap = self._book.snapshot()
+            total = sum(t.get("chip_seconds", 0.0) for t in snap.values())
+            mine = snap.get(tenant or "", {}).get("chip_seconds", 0.0)
+            if total > 0.0 and mine > 0.0:
+                qsum = sum(self._quotas.values()) or 1.0
+                fair = self._quotas.get(tenant or "", 1.0) / qsum
+                frac = mine / total
+                if fair > 0.0 and frac > 2.0 * fair:
+                    # running at >2x share: scale the weight down by the
+                    # overuse ratio (bounded so the tenant is throttled,
+                    # never starved)
+                    w /= min(frac / fair, 8.0)
+                    self._quota_throttles += 1
+        return max(w, 1e-6)
+
+    def on_enqueue(self, gen) -> None:
+        """Assign the generation its priority rank + virtual finish tag
+        at enqueue (and again on re-queue after a park — a parked stream
+        re-enters the fair queue at current virtual time, so victims
+        cannot be starved by a steady interactive trickle)."""
+        with self._lock:
+            self._seq += 1
+            gen.sched_seq = self._seq
+            cost = float(gen.prompt.size + gen.max_new_tokens)
+            key = (gen.tenant or "", gen.pclass)
+            start = max(self._vt, self._tags.get(key, 0.0))
+            gen.sched_vft = start + cost / self._weight(gen.tenant,
+                                                        gen.pclass)
+            self._tags[key] = gen.sched_vft
+
+    def order_key(self, gen):
+        """Sort key for the engine's wait queue: class rank first
+        (interactive strictly ahead), then virtual finish tag, then
+        arrival order."""
+        return (_RANK[gen.pclass], gen.sched_vft, gen.sched_seq)
+
+    # -- per-iteration planning --------------------------------------------
+    def _pressure(self) -> bool:
+        """TTFT SLO pressure from the attached hub, sampled at most
+        every ``_HUB_SAMPLE_EVERY`` plans. Caller holds self._lock."""
+        self._plans += 1
+        if self._hub is None:
+            return False
+        if self._plans % _HUB_SAMPLE_EVERY == 1:
+            try:
+                fast, _slow = self._hub.burn_rates(
+                    "gen/ttft_s", self._slo_s, self._slo_budget)
+                self._hot = fast > 1.0
+            except Exception:
+                self._hot = False
+        return self._hot
+
+    def plan(self, queue, slot_gen) -> IterationPlan:
+        """Decide this iteration: re-order the wait queue (in place,
+        stable) and return the iteration's budget plan. Called by the
+        engine loop under the engine lock, once per iteration."""
+        with self._lock:
+            if len(queue) > 1:
+                ordered = sorted(queue, key=self.order_key)
+                queue.clear()
+                queue.extend(ordered)
+            head_interactive = bool(queue) and \
+                queue[0].pclass == INTERACTIVE
+            hot = self._pressure() or head_interactive
+            free = sum(g is None for g in slot_gen)
+            preempt = head_interactive and free == 0 and any(
+                g is not None and _RANK[g.pclass] > _RANK[INTERACTIVE]
+                for g in slot_gen)
+        return IterationPlan(
+            prefill_chunk=(self._chunk if hot and self._chunk > 0
+                           else None),
+            spec_budget=(0 if head_interactive else None),
+            kv_scale=(0.5 if hot else 1.0),
+            hol_window=8,
+            preempt=preempt,
+        )
+
+    def choose_victims(self, candidates, pclass: str, need: int):
+        """Pick up to ``need`` preemption victims for a waiting
+        ``pclass`` stream from ``candidates`` — ``(slot, gen)`` pairs
+        the ENGINE already screened for mechanical eligibility (decode
+        phase, not mid-prefill). Policy here: strictly lower class
+        only, most recently admitted first (least sunk work lost)."""
+        rank = _RANK[pclass]
+        eligible = [(s, g) for s, g in candidates
+                    if _RANK[g.pclass] > rank]
+        eligible.sort(key=lambda sg: -sg[1].sched_ts)
+        return eligible[:max(int(need), 0)]
+
+    # -- lifecycle notes (counters + fairness advancement) -----------------
+    def note_admitted(self, gen, now: float | None = None) -> None:
+        """A queued generation took a slot: advance virtual time to its
+        start tag (SFQ service rule) and book its class queue-wait."""
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            gen.sched_ts = ts
+            self._admitted[gen.pclass] += 1
+            cost = float(gen.prompt.size + gen.max_new_tokens)
+            self._vt = max(self._vt,
+                           gen.sched_vft - cost / self._weight(
+                               gen.tenant, gen.pclass))
+        observe(f"gen/sched/wait_s/{gen.pclass}", max(ts - gen.created,
+                                                      0.0))
+
+    def note_parked(self, gen) -> None:
+        with self._lock:
+            self._preemptions += 1
+
+    def note_shed(self, pclass: str) -> None:
+        with self._lock:
+            self._sheds[pclass] += 1
+
+    # -- the one shed brain ------------------------------------------------
+    def shed_start(self, pclass: str, pending: int,
+                   queue_max: int) -> bool:
+        """Engine ``start()`` admission: should this enqueue be shed?
+        Class-aware caps around the engine's ``gen_queue_max``:
+        interactive gets headroom past the cap, best-effort is shed at
+        half of it. ``queue_max <= 0`` keeps the unlimited-queue
+        semantics for every class."""
+        if queue_max <= 0:
+            return False
+        rank = _RANK[pclass]
+        if rank == 0:
+            cap = queue_max + self._headroom
+        elif rank == 2:
+            cap = max(queue_max // 2, 1)
+        else:
+            cap = queue_max
+        if pending >= cap:
+            self.note_shed(pclass)
+            return True
+        return False
+
+    def wire_gate(self, header, inflight: int, cap: int) -> bool:
+        """FrameService consult on its WOULD-SHED path (inflight already
+        at cap): return True to admit anyway. Only interactive traffic
+        is let past the cap, and only within the configured headroom —
+        the engine-side queue policy (same object) then decides its
+        fate, so the request is never double-shed."""
+        pclass = classify((header or {}).get("pc"))
+        if pclass == INTERACTIVE and inflight < cap + self._headroom:
+            return True
+        self.note_shed(pclass)
+        return False
+
+    def infer_bypass(self, tenant: str | None = None) -> bool:
+        """DynamicBatcher consult: skip the coalescing wait (leader
+        dispatches solo) while interactive TTFT burn runs hot — trading
+        batching efficiency for latency exactly when the SLO needs it."""
+        with self._lock:
+            if self._hub is None:
+                return False
+            try:
+                fast, _slow = self._hub.burn_rates(
+                    "gen/ttft_s", self._slo_s, self._slo_budget,
+                    tenant=tenant)
+                return fast > 1.0
+            except Exception:
+                return False
+
+    # -- observability -----------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """The engine's ``stats()["sched"]`` block."""
+        with self._lock:
+            return {
+                "preemptions": self._preemptions,
+                "quota_throttles": self._quota_throttles,
+                "admitted": dict(self._admitted),
+                "sheds": dict(self._sheds),
+                "weights": dict(self._w),
+                "quotas": dict(self._quotas),
+                "virtual_time": self._vt,
+                "hot": self._hot,
+            }

@@ -1,0 +1,436 @@
+"""SLO-aware, tenant-fair scheduler (``FLAGS_gen_sched``, hard-off).
+
+The load-bearing contracts: with the flag off the engine holds no
+scheduler and the default loop is byte-identical with zero hot-path
+flag reads (spy-pinned); with it on, weighted-fair queueing converges
+per-tenant admission shares to the configured quotas, interactive never
+queues behind batch (priority-inversion regression), and a preempted
+stream parks via the prompt-fold + ``rng_skip`` replay contract and
+resumes byte-identically — greedy and sampled — through the ordinary
+re-admission path.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.serving.scheduler as sched_mod
+from paddle_tpu.core import monitor
+from paddle_tpu.core.flags import flag
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.serving import GenerationEngine
+from paddle_tpu.serving.ledger import RequestLedger
+from paddle_tpu.serving.metrics import MetricsHub
+from paddle_tpu.serving.scheduler import (BATCH, BEST_EFFORT, INTERACTIVE,
+                                          GenScheduler, classify)
+
+pytestmark = [pytest.mark.gen, pytest.mark.sched]
+
+VOCAB = 96
+SAMPLE_KW = dict(temperature=0.8, top_k=7, top_p=0.9, seed=42)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    return LlamaForCausalLM(cfg)
+
+
+def _drain(engine, gen_id, wait_s=0.5):
+    toks, n = [], 0
+    while True:
+        doc = engine.poll(gen_id, start=n, wait_s=wait_s)
+        toks += doc["tokens"]
+        n = len(toks)
+        if doc["done"]:
+            return toks, doc["error"]
+
+
+def _sampled_ref(model, prompt, n):
+    import jax
+    return np.asarray(generate(
+        model, prompt[None], n, temperature=SAMPLE_KW["temperature"],
+        top_k=SAMPLE_KW["top_k"], top_p=SAMPLE_KW["top_p"],
+        key=jax.random.PRNGKey(SAMPLE_KW["seed"])))[0, prompt.size:]
+
+
+def _mk_sched(monkeypatch, **overrides):
+    """A GenScheduler whose construction-time flag reads see
+    ``overrides`` (name -> value) instead of the registry defaults."""
+    real = sched_mod.flag
+    monkeypatch.setattr(
+        sched_mod, "flag",
+        lambda name: overrides[name] if name in overrides else real(name))
+    return GenScheduler()
+
+
+class _FakeGen:
+    """Just the attributes the scheduler reads/writes."""
+
+    def __init__(self, tenant, pclass, prompt_len=8, new=8):
+        self.prompt = np.zeros(prompt_len, np.int32)
+        self.max_new_tokens = new
+        self.tenant = tenant
+        self.pclass = pclass
+        self.created = time.monotonic()
+        self.sched_seq = 0
+        self.sched_vft = 0.0
+        self.sched_ts = 0.0
+
+
+def _cum_hist(values):
+    h = monitor._Histogram()
+    for v in values:
+        h.observe(v)
+    return h.summary(raw=True)
+
+
+def _doc(hists):
+    return {"status": "ok", "inflight": 0, "generators": {}, "stats": {},
+            "histograms": {n: _cum_hist(v) for n, v in hists.items()}}
+
+
+# -- classification ---------------------------------------------------------
+
+def test_classify_aliases_and_default():
+    assert classify("interactive") == INTERACTIVE
+    assert classify("rt") == INTERACTIVE
+    assert classify(" Realtime ") == INTERACTIVE
+    assert classify(0) == INTERACTIVE
+    assert classify("batch") == BATCH
+    assert classify("best-effort") == BEST_EFFORT
+    assert classify("be") == BEST_EFFORT
+    assert classify(2) == BEST_EFFORT
+    # absent / unknown traffic is batch, never dropped
+    assert classify(None) == BATCH
+    assert classify("???") == BATCH
+
+
+# -- weighted-fair queueing -------------------------------------------------
+
+def test_wfq_admission_converges_to_quota_shares(monkeypatch):
+    """Saturating 2-tenant load, quotas 3:1, identical costs: the
+    admission order the virtual-finish tags induce gives alice ~3 slots
+    for every bob slot — regardless of (alternating) arrival order."""
+    sched = _mk_sched(monkeypatch,
+                      gen_sched_quotas="alice=3,bob=1")
+    gens = []
+    for _ in range(20):                      # saturating backlog
+        for tenant in ("alice", "bob"):
+            g = _FakeGen(tenant, BATCH)
+            sched.on_enqueue(g)
+            gens.append(g)
+    served, first16 = [], []
+    while gens:
+        gens.sort(key=sched.order_key)
+        head = gens.pop(0)
+        sched.note_admitted(head, now=time.monotonic())
+        served.append(head.tenant)
+    first16 = served[:16]
+    assert first16.count("alice") >= 11      # ~12 expected at 3:1
+    assert first16.count("bob") >= 3         # throttled, never starved
+    snap = sched.snapshot()
+    assert snap["admitted"][BATCH] == 40
+    assert snap["virtual_time"] > 0.0
+
+
+def test_wfq_tags_are_backlog_local_not_global():
+    """A tenant arriving late starts at CURRENT virtual time, not at
+    zero — it cannot claim the whole engine to 'catch up'."""
+    sched = GenScheduler()
+    old = [_FakeGen("early", BATCH) for _ in range(4)]
+    for g in old:
+        sched.on_enqueue(g)
+    for g in old[:2]:
+        sched.note_admitted(g)
+    late = _FakeGen("late", BATCH)
+    sched.on_enqueue(late)
+    # late's finish tag sits at/after the already-served frontier
+    assert late.sched_vft >= min(g.sched_vft for g in old)
+
+
+def test_quota_throttle_scales_weight_down_not_to_zero(monkeypatch):
+    """A tenant holding chip-seconds far past its quota share gets its
+    WFQ weight divided by the (capped) overuse ratio — later finish
+    tags — but still makes progress."""
+    class _Book:
+        @staticmethod
+        def snapshot():
+            return {"hog": {"chip_seconds": 90.0},
+                    "meek": {"chip_seconds": 10.0}}
+
+    sched = _mk_sched(monkeypatch, gen_sched_quotas="hog=1,meek=3")
+    sched.attach_book(_Book())
+    hog, meek = _FakeGen("hog", BATCH), _FakeGen("meek", BATCH)
+    sched.on_enqueue(hog)
+    sched.on_enqueue(meek)
+    assert hog.sched_vft > meek.sched_vft    # throttled behind meek
+    assert np.isfinite(hog.sched_vft)        # but never starved
+    assert sched.snapshot()["quota_throttles"] >= 1
+
+
+# -- priority classes / inversion regression --------------------------------
+
+def test_priority_inversion_interactive_sorts_ahead_of_backlog():
+    """An interactive arrival behind a deep batch/best-effort backlog
+    sorts strictly first — class rank dominates every fair-queue tag."""
+    from collections import deque
+    sched = GenScheduler()
+    q = deque()
+    for _ in range(10):
+        g = _FakeGen("bulk", BATCH)
+        sched.on_enqueue(g)
+        q.append(g)
+    be = _FakeGen("scav", BEST_EFFORT)
+    sched.on_enqueue(be)
+    q.append(be)
+    it = _FakeGen("live", INTERACTIVE)
+    sched.on_enqueue(it)
+    q.append(it)                             # arrives LAST
+    plan = sched.plan(q, [_FakeGen("busy", BATCH)])   # no free slot
+    assert q[0] is it
+    assert q[-1] is be                       # best-effort drains last
+    assert plan.spec_budget == 0             # speculation shed for TTFT
+    assert plan.prefill_chunk is not None    # chunk clamp while hot
+    assert plan.kv_scale < 1.0
+
+
+def test_plan_preempts_only_lower_class_occupants():
+    from collections import deque
+    sched = GenScheduler()
+    it = _FakeGen("live", INTERACTIVE)
+    sched.on_enqueue(it)
+    q = deque([it])
+    # occupied by batch -> preempt; occupied by interactive -> never
+    assert sched.plan(q, [_FakeGen("bulk", BATCH)]).preempt is True
+    assert sched.plan(q, [_FakeGen("live2", INTERACTIVE)]).preempt is False
+    assert sched.plan(q, [None]).preempt is False   # free slot: admit
+    # nothing interactive waiting: no preemption at all
+    q2 = deque([_FakeGen("bulk", BATCH)])
+    sched.on_enqueue(q2[0])
+    assert sched.plan(q2, [_FakeGen("x", BEST_EFFORT)]).preempt is False
+
+
+def test_choose_victims_strictly_lower_class_most_recent_first():
+    sched = GenScheduler()
+    b1, b2 = _FakeGen("t", BATCH), _FakeGen("t", BATCH)
+    be = _FakeGen("t", BEST_EFFORT)
+    it = _FakeGen("t", INTERACTIVE)
+    b1.sched_ts, b2.sched_ts, be.sched_ts, it.sched_ts = 1.0, 3.0, 2.0, 4.0
+    cands = [(0, b1), (1, b2), (2, be), (3, it)]
+    # an interactive claimant never evicts a peer interactive
+    v = sched.choose_victims(cands, INTERACTIVE, 2)
+    assert [g for _s, g in v] == [b2, be]    # most recent eligible first
+    # batch claims only best-effort
+    v = sched.choose_victims(cands, BATCH, 5)
+    assert [g for _s, g in v] == [be]
+    assert sched.choose_victims(cands, BEST_EFFORT, 1) == []
+
+
+# -- the one shed brain -----------------------------------------------------
+
+def test_shed_start_class_aware_caps():
+    sched = GenScheduler()                   # headroom default: 2
+    qm = 4
+    assert sched.shed_start(BATCH, 3, qm) is False
+    assert sched.shed_start(BATCH, 4, qm) is True
+    # interactive rides the headroom past the cap
+    assert sched.shed_start(INTERACTIVE, 4, qm) is False
+    assert sched.shed_start(INTERACTIVE, 5, qm) is False
+    assert sched.shed_start(INTERACTIVE, 6, qm) is True
+    # best-effort sheds at half the cap
+    assert sched.shed_start(BEST_EFFORT, 1, qm) is False
+    assert sched.shed_start(BEST_EFFORT, 2, qm) is True
+    # unlimited queue stays unlimited for every class
+    for c in (INTERACTIVE, BATCH, BEST_EFFORT):
+        assert sched.shed_start(c, 10_000, 0) is False
+    sheds = sched.snapshot()["sheds"]
+    assert sheds[BATCH] == 1 and sheds[INTERACTIVE] == 1
+    assert sheds[BEST_EFFORT] == 1
+
+
+def test_wire_gate_admits_interactive_within_headroom_only():
+    sched = GenScheduler()                   # headroom default: 2
+    assert sched.wire_gate({"pc": "interactive"}, 4, 4) is True
+    assert sched.wire_gate({"pc": "interactive"}, 5, 4) is True
+    assert sched.wire_gate({"pc": "interactive"}, 6, 4) is False
+    assert sched.wire_gate({"pc": "batch"}, 4, 4) is False
+    assert sched.wire_gate({}, 4, 4) is False
+    assert sched.wire_gate(None, 4, 4) is False
+    assert sched.snapshot()["sheds"][BATCH] >= 3
+
+
+# -- SLO burn plumbing ------------------------------------------------------
+
+def test_burn_rates_per_tenant_dimension_reads_the_split_series():
+    """``burn_rates(..., tenant=)`` narrows to the ``<name>/<tn>``
+    histogram the engine observes next to the fleet-wide one — a hot
+    tenant's burn is visible even while the fleet looks healthy."""
+    hub = MetricsHub(fast_ticks=2, slow_ticks=4)
+    hub.ingest({"ep": _doc({"gen/ttft_s": [0.01] * 5,
+                            "gen/ttft_s/hot": [0.01]})})
+    hub.ingest({"ep": _doc({"gen/ttft_s": [0.01] * 10,
+                            "gen/ttft_s/hot": [0.01] + [2.0] * 5})})
+    assert hub.burn_rates("gen/ttft_s", 0.5, 0.1) == (0.0, 0.0)
+    fast, slow = hub.burn_rates("gen/ttft_s", 0.5, 0.1, tenant="hot")
+    assert fast == pytest.approx(10.0) and slow == pytest.approx(10.0)
+    # an unknown tenant has no series: no traffic burns no budget
+    assert hub.burn_rates("gen/ttft_s", 0.5, 0.1, tenant="cold") == \
+        (0.0, 0.0)
+
+
+def test_infer_bypass_fires_on_per_tenant_burn():
+    hub = MetricsHub(fast_ticks=2, slow_ticks=4)
+    hub.ingest({"ep": _doc({"gen/ttft_s": [0.01] * 5,
+                            "gen/ttft_s/hot": [0.01]})})
+    hub.ingest({"ep": _doc({"gen/ttft_s": [0.01] * 10,
+                            "gen/ttft_s/hot": [0.01] + [2.0] * 5})})
+    sched = GenScheduler()
+    assert sched.infer_bypass("hot") is False   # no hub: never bypass
+    sched.attach_hub(hub, slo_s=0.5, budget=0.1)
+    assert sched.infer_bypass("hot") is True
+    assert sched.infer_bypass(None) is False    # fleet-wide is clean
+    assert sched.infer_bypass("cold") is False
+
+
+# -- live queue-wait booking (satellite: ledger) ----------------------------
+
+class _LedgerGen:
+    """Just the attributes RequestLedger reads."""
+
+    def __init__(self, created):
+        self.gen_id, self.tenant = "g1", "t"
+        self.created = created
+        self.admitted_ts = self.first_tok_ts = self.done_ts = 0.0
+        self.prompt = np.zeros(4, np.int32)
+        self.tokens = [1, 2]
+        self.chip_s = 0.0
+        self.rng_skip = 0
+        self.spec_proposed = self.spec_accepted = 0
+        self.queue_booked = 0.0
+
+
+def test_book_admission_books_live_delta_finalize_stays_exact():
+    """Queue wait lands in the tenant book AT admission; a park +
+    re-admission books only the delta; finalize books the remainder so
+    the total equals the authoritative admit_wait_s exactly."""
+    led = RequestLedger()
+    t0 = time.monotonic()
+    gen = _LedgerGen(t0)
+    led.book_admission(gen, now=t0 + 1.0)
+    assert led.book.snapshot()["t"]["queue_wait_s"] == pytest.approx(1.0)
+    # parked, re-queued, re-admitted 2s later: only the delta books
+    led.book_admission(gen, now=t0 + 3.0)
+    assert led.book.snapshot()["t"]["queue_wait_s"] == pytest.approx(3.0)
+    gen.admitted_ts = t0 + 3.0
+    gen.first_tok_ts = t0 + 3.5
+    gen.done_ts = t0 + 4.0
+    rec = led.finalize(gen, "ok", now=t0 + 4.0)
+    assert rec["phases"]["admit_wait_s"] == pytest.approx(3.0)
+    # finalize's remainder is ~0: the live bookings already covered it
+    assert led.book.snapshot()["t"]["queue_wait_s"] == pytest.approx(3.0)
+
+
+# -- preempt / park / resume byte-identity ----------------------------------
+
+def _run_preempt(model, eng, sample=False):
+    """Saturate the 1-slot engine with a batch stream, preempt it with
+    an interactive arrival, return (interactive_toks, batch_toks)."""
+    kw = dict(SAMPLE_KW) if sample else {}
+    p_batch = np.arange(1, 9, dtype=np.int32)
+    p_inter = np.arange(10, 14, dtype=np.int32)
+    gb = eng.start(p_batch, 16, tenant="bulk", priority="batch", **kw)
+    # wait for the batch stream to be decoding (>=1 token emitted) so
+    # the interactive arrival finds the slot occupied mid-stream
+    doc = eng.poll(gb, start=0, wait_s=5.0)
+    assert doc["tokens"], "batch stream never started decoding"
+    gi = eng.start(p_inter, 6, tenant="live", priority="interactive", **kw)
+    ti, ei = _drain(eng, gi, wait_s=0.2)
+    tb, eb = _drain(eng, gb, wait_s=0.2)
+    assert ei is None and eb is None
+    return (np.asarray(ti, np.int32), np.asarray(tb, np.int32),
+            p_inter, p_batch)
+
+
+def test_preempt_park_resume_greedy_byte_identity(model):
+    """Interactive preempts the only slot; the parked batch stream
+    resumes through ordinary re-admission and BOTH streams match solo
+    ``generate()`` byte-for-byte."""
+    ref_b = np.asarray(generate(model, np.arange(1, 9, dtype=np.int32)[None],
+                                16))[0, 8:]
+    ref_i = np.asarray(generate(model, np.arange(10, 14,
+                                                 dtype=np.int32)[None],
+                                6))[0, 4:]
+    with GenerationEngine(model, slots=1, max_len=64, paged=True,
+                          page_tokens=8, pages=24, prefill_chunk=8,
+                          step_wait_s=0.02, sched=True,
+                          ledger=True) as eng:
+        ti, tb, _pi, _pb = _run_preempt(model, eng)
+        np.testing.assert_array_equal(ti, ref_i)
+        np.testing.assert_array_equal(tb, ref_b)
+        st = eng.stats()
+        assert st["sched"]["preemptions"] >= 1
+        assert st["sched"]["admitted"][INTERACTIVE] == 1
+        # initial admission + at least one re-admission after the park
+        assert st["sched"]["admitted"][BATCH] >= 2
+        # every page not free is held by the prefix cache — none leaked
+        assert st["pages"] - st["pages_free"] <= st["prefix_entries"]
+        # live queue-wait attribution reached the tenant book
+        tenants = eng.stats()["tenants"]
+        assert "live" in tenants and "bulk" in tenants
+
+
+def test_preempt_park_resume_sampled_byte_identity(model):
+    """Same preemption, sampled decoding: the fold advances
+    ``rng_skip`` by the folded tokens, so the resumed stream replays
+    the per-token sampling-key schedule exactly."""
+    ref_b = _sampled_ref(model, np.arange(1, 9, dtype=np.int32), 16)
+    ref_i = _sampled_ref(model, np.arange(10, 14, dtype=np.int32), 6)
+    with GenerationEngine(model, slots=1, max_len=64, paged=True,
+                          page_tokens=8, pages=24, prefill_chunk=8,
+                          step_wait_s=0.02, sched=True) as eng:
+        ti, tb, _pi, _pb = _run_preempt(model, eng, sample=True)
+        np.testing.assert_array_equal(ti, ref_i)
+        np.testing.assert_array_equal(tb, ref_b)
+        assert eng.stats()["sched"]["preemptions"] >= 1
+
+
+# -- hard-off defaults ------------------------------------------------------
+
+def test_defaults_off_no_scheduler_no_hot_path_flag_reads(model,
+                                                          monkeypatch):
+    """gen_sched defaults off: the engine builds NO scheduler, stats
+    ship no "sched" block, the flag is read at construction only, and
+    the default loop's tokens are byte-identical — a priority= hint is
+    recorded-but-inert."""
+    assert flag("gen_sched") is False
+    import paddle_tpu.serving.engine as engine_mod
+
+    reads: list[str] = []
+    real_flag = engine_mod.flag
+
+    def spy(name):
+        reads.append(name)
+        return real_flag(name)
+
+    monkeypatch.setattr(engine_mod, "flag", spy)
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, VOCAB, (6,)).astype(np.int32)
+    ref = np.asarray(generate(model, prompt[None], 6))[0, 6:]
+    with GenerationEngine(model, slots=2, max_len=32, paged=True,
+                          page_tokens=8) as eng:
+        assert "gen_sched" in reads
+        assert eng._sched is None and eng._plan is None
+        assert eng.sched is None
+        assert "sched" not in eng.stats()
+        reads.clear()
+        toks, err = _drain(eng, eng.start(prompt, 6,
+                                          priority="interactive"))
+        assert err is None
+        np.testing.assert_array_equal(np.asarray(toks, np.int32), ref)
+        assert not [r for r in reads if r.startswith("gen_sched")]

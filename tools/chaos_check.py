@@ -324,6 +324,19 @@ def check_defaults_off() -> None:
           and d.action == "hold" and "leader" not in dump
           and not spawned,
           f"flags={haf} spawned={spawned}")
+    sc = get_flags(["gen_sched", "gen_sched_w_interactive",
+                    "gen_sched_w_batch", "gen_sched_w_best_effort",
+                    "gen_sched_quotas", "gen_sched_chunk",
+                    "gen_sched_headroom"])
+    check("defaults/gen_sched_off",
+          not sc["gen_sched"]                     # no scheduler object
+          and sc["gen_sched_quotas"] == ""        # no quota map
+          # sane class-weight ordering when opted in
+          and sc["gen_sched_w_interactive"] >= sc["gen_sched_w_batch"]
+          >= sc["gen_sched_w_best_effort"] > 0
+          and sc["gen_sched_chunk"] > 0
+          and sc["gen_sched_headroom"] >= 0,
+          str(sc))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -1588,6 +1601,112 @@ def scenario_ledger(tmp: str) -> None:
         set_flags(saved)
 
 
+def scenario_gen_sched(tmp: str) -> None:
+    """SIGKILL a scheduler-on replica mid-preempted-stream pair: a
+    1-slot replica is decoding a batch stream when an interactive
+    arrival preempts it (the batch stream parks via the prompt-fold
+    contract); the replica is then SIGKILLed with BOTH streams live.
+    Both resume on the survivor byte-identical, the survivor leaks no
+    pages, and no parked slot is stranded anywhere."""
+    import time
+    import zlib
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    paddle_tpu.seed(7)
+    cfg = LlamaConfig.tiny(vocab_size=96, hidden_size=32, num_layers=2,
+                           num_heads=2, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+
+    monitor.reset_stats("serving/router/")
+    spawner = SubprocessSpawner(extra_args=(
+        "--gen", "llm", "--gen-seed", "7", "--gen-slots", "1",
+        "--gen-max-len", "32", "--gen-step-wait-s", "0.05",
+        "--gen-paged", "--gen-page-tokens", "8", "--gen-sched"))
+    eps = [spawner.spawn() for _ in range(2)]
+    router = RoutedClient(eps, probe_interval_s=0)
+    try:
+        victim = sorted(eps)[0]
+        vidx = sorted(eps).index(victim)
+
+        def _sid(prefix):
+            # sticky pin is crc32(sid) % len(healthy) over the sorted
+            # membership: mint a session id that pins to the victim so
+            # the interactive arrival actually contends with the batch
+            # stream for its single slot
+            for i in range(64):
+                sid = f"{prefix}{i}"
+                if zlib.crc32(sid.encode()) % len(eps) == vidx:
+                    return sid
+            raise AssertionError("no session id pinned to victim")
+
+        p_batch = np.arange(1, 9, dtype=np.int32)
+        p_inter = np.arange(10, 14, dtype=np.int32)
+        ref_b = np.asarray(generate(model, p_batch[None], 16))[0, 8:]
+        ref_i = np.asarray(generate(model, p_inter[None], 10))[0, 4:]
+
+        sess_b = router.session(_sid("bulk-"))
+        it_b = sess_b.generate("llm", p_batch, 16, poll_wait_s=0.05,
+                               resume_budget=2, tenant="bulk",
+                               priority="batch")
+        toks_b = [next(it_b), next(it_b)]       # decoding mid-stream
+        sess_i = router.session(_sid("live-"))
+        it_i = sess_i.generate("llm", p_inter, 10, poll_wait_s=0.05,
+                               resume_budget=2, tenant="live",
+                               priority="interactive")
+        # an interactive token on a 1-slot replica means the batch
+        # stream was parked first — read the scheduler's own counter
+        toks_i = [next(it_i)]
+        with io.InferenceClient(victim, timeout=5.0) as c:
+            sched = c.health()["generators"]["llm"].get("sched") or {}
+        check("gensched/preempted_before_kill",
+              sched.get("preemptions", 0) >= 1
+              and sched.get("admitted", {}).get("interactive", 0) >= 1,
+              json.dumps(sched))
+
+        spawner.kill(victim)          # SIGKILL: interactive mid-stream,
+        err = None                    # batch parked on the dead replica
+        try:
+            toks_i += list(it_i)
+            toks_b += list(it_b)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        check("gensched/preempted_interactive_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks_i, np.int32), ref_i),
+              f"err={err} toks={len(toks_i)}")
+        check("gensched/parked_batch_byte_identical_through_kill",
+              err is None
+              and np.array_equal(np.asarray(toks_b, np.int32), ref_b),
+              f"err={err} toks={len(toks_b)}")
+        check("gensched/resumes_counted",
+              monitor.get_stat("serving/router/stream_resumes") >= 2
+              and monitor.get_stat("serving/router/resume_exhausted")
+              == 0,
+              str(monitor.export_stats("serving/router/")))
+        survivor = next(ep for ep in eps if ep != victim)
+        g = {}
+        with io.InferenceClient(survivor, timeout=5.0) as c:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                g = c.health()["generators"]["llm"]
+                if (g.get("active") == 0 and g.get("queued") == 0
+                        and g.get("pages_free", 0)
+                        + g.get("prefix_entries", 0) == g.get("pages")):
+                    break
+                time.sleep(0.1)
+        check("gensched/no_leaked_pages_no_stranded_slots_on_survivor",
+              g.get("active") == 0 and g.get("queued") == 0
+              and g.get("pages_free", -1) + g.get("prefix_entries", 0)
+              == g.get("pages"), str(g))
+    finally:
+        router.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+
+
 def scenario_gen_disagg(tmp: str) -> None:
     """SIGKILL a decode-tier replica holding a live stream with the
     tiered KV store on (two ``--role decode --kv-store`` replicas, one
@@ -1974,6 +2093,7 @@ SCENARIOS = (scenario_serving_wire, scenario_checkpoint,
              scenario_obs_fleet, scenario_ledger,
              scenario_gen_disagg,
              scenario_gen_hotloop,
+             scenario_gen_sched,
              scenario_kv_campaign)
 
 

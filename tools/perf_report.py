@@ -48,6 +48,11 @@ from paddle_tpu.serving.ledger import (  # noqa: E402
 #: latency-decomposition percentiles
 PHASE_HISTOGRAMS = ("gen/e2e_s",) + tuple(f"gen/phase/{p}" for p in PHASES)
 
+#: priority classes the scheduler books queue waits under
+#: (``gen/sched/wait_s/<class>``, FLAGS_gen_sched)
+SCHED_CLASSES = ("interactive", "batch", "best_effort")
+SCHED_HISTOGRAMS = tuple(f"gen/sched/wait_s/{c}" for c in SCHED_CLASSES)
+
 
 def goodput_rollup(docs: list[dict]) -> dict | None:
     """Merge engine ``goodput`` snapshots by summing per-bucket seconds
@@ -159,6 +164,44 @@ def kv_rollup(docs: list[dict]) -> dict | None:
     }
 
 
+def sched_rollup(docs: list[dict],
+                 wait_hists: dict[str, dict] | None = None) -> dict | None:
+    """Merge engine ``sched`` policy blocks (the SLO-aware scheduler's
+    counters, ``FLAGS_gen_sched``) into the fleet scoreboard:
+    preemptions, quota throttles, per-class admissions and sheds, plus
+    per-class queue-wait percentiles from the merged
+    ``gen/sched/wait_s/<class>`` histograms. None when no engine runs
+    the scheduler."""
+    docs = [d for d in docs if isinstance(d, dict)]
+    if not docs:
+        return None
+    admitted = {c: 0 for c in SCHED_CLASSES}
+    sheds = {c: 0 for c in SCHED_CLASSES}
+    preemptions = throttles = 0
+    for d in docs:
+        preemptions += int(d.get("preemptions", 0))
+        throttles += int(d.get("quota_throttles", 0))
+        for c in SCHED_CLASSES:
+            admitted[c] += int((d.get("admitted") or {}).get(c, 0))
+            sheds[c] += int((d.get("sheds") or {}).get(c, 0))
+    out = {
+        "engines": len(docs),
+        "preemptions": preemptions,
+        "quota_throttles": throttles,
+        "admitted": admitted,
+        "sheds": sheds,
+    }
+    waits = {}
+    for c in SCHED_CLASSES:
+        h = (wait_hists or {}).get(f"gen/sched/wait_s/{c}")
+        if h and h.get("count"):
+            waits[c] = {k: round(float(h[k]), 6)
+                        for k in ("count", "p50", "p95", "p99")}
+    if waits:
+        out["wait_s"] = waits
+    return out
+
+
 def scrape(endpoint: str, *, limit: int | None,
            timeout: float) -> dict:
     """One endpoint → {endpoint, health, ledger}; raises on wire
@@ -183,6 +226,7 @@ def build_report(scrapes: list[dict], *,
     records: list[dict] = []
     tenant_docs: list[dict] = []
     kv_docs: list[dict] = []
+    sched_docs: list[dict] = []
     hists: dict[str, list[dict]] = {}
     per_endpoint = []
     for s in scrapes:
@@ -197,7 +241,9 @@ def build_report(scrapes: list[dict], *,
         for g in (s["health"].get("generators") or {}).values():
             if isinstance(g, dict) and isinstance(g.get("kv"), dict):
                 kv_docs.append(g["kv"])
-        for name in PHASE_HISTOGRAMS:
+            if isinstance(g, dict) and isinstance(g.get("sched"), dict):
+                sched_docs.append(g["sched"])
+        for name in PHASE_HISTOGRAMS + SCHED_HISTOGRAMS:
             h = (s["health"].get("histograms") or {}).get(name)
             if h and h.get("buckets"):
                 hists.setdefault(name, []).append(h)
@@ -207,6 +253,8 @@ def build_report(scrapes: list[dict], *,
             "ledger": s.get("ledger") is not None,
             "engines": sorted(dump.get("generators") or ()),
         })
+    merged = {name: merge_histograms(docs)
+              for name, docs in hists.items()}
     return {
         "ok": True,
         "endpoints": per_endpoint,
@@ -216,10 +264,12 @@ def build_report(scrapes: list[dict], *,
         "phase_percentiles": {
             name: {k: round(float(h[k]), 6)
                    for k in ("count", "p50", "p95", "p99")}
-            for name, docs in sorted(hists.items())
-            for h in (merge_histograms(docs),)},
+            for name in sorted(merged)
+            if name in PHASE_HISTOGRAMS
+            for h in (merged[name],)},
         "tenants": tenant_rollup(tenant_docs),
         "kv": kv_rollup(kv_docs),
+        "sched": sched_rollup(sched_docs, merged),
     }
 
 
@@ -277,6 +327,21 @@ def render(report: dict) -> str:
         lines.append(f"  demotions {int(kv['demotions'])}  dropped "
                      f"{int(kv['dropped'])}  prefill recomputed "
                      f"{int(kv['prefill_recomputed'])} tok")
+    sc = report.get("sched")
+    if sc:
+        lines.append("")
+        lines.append(f"scheduler: {sc['engines']} engine(s)  "
+                     f"preemptions {sc['preemptions']}  "
+                     f"quota throttles {sc['quota_throttles']}")
+        waits = sc.get("wait_s") or {}
+        for c in SCHED_CLASSES:
+            adm, shd = sc["admitted"].get(c, 0), sc["sheds"].get(c, 0)
+            w = waits.get(c)
+            wtxt = (f"  wait p50 {w['p50'] * 1e3:8.2f}ms "
+                    f"p95 {w['p95'] * 1e3:8.2f}ms "
+                    f"p99 {w['p99'] * 1e3:8.2f}ms" if w else "")
+            lines.append(f"  {c:<12} admitted {adm:>6}  shed {shd:>5}"
+                         f"{wtxt}")
     tens = report.get("tenants")
     if tens:
         lines.append("")
