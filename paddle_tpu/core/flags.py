@@ -587,6 +587,27 @@ define_flag("ckpt_manifest", True,
             "Write + verify per-step checkpoint manifests (leaf names and "
             "checksums); corrupt steps then fall back to the newest "
             "verifiable one instead of crashing the resume")
+define_flag("serving_emb", False,
+            "PS-backed sparse embedding serving "
+            "(serving/sparse.py EmbeddingServingTier): inference "
+            "replicas pull/cache hot embedding rows from the parameter-"
+            "server fleet, batched CTR lookups ride the DynamicBatcher, "
+            "and trainer-published table versions roll over online with "
+            "no restart. Hard-off default: the server never constructs "
+            "the tier and the serving path is byte-identical (the "
+            "FLAGS_trace pattern). Read only at server construction")
+define_flag("serving_emb_cache_rows", 4096,
+            "Per-table hot-row LRU capacity (rows) for the embedding "
+            "serving tier; misses pull de-duplicated batches from the "
+            "PS. Read only at tier construction, only while serving_emb "
+            "is on")
+define_flag("serving_emb_ttl_s", 0.0,
+            "Seconds a cached embedding row stays servable before it is "
+            "re-pulled (bounds staleness against async trainer pushes "
+            "between version rollovers). <=0 — the default — never "
+            "expires rows within a version; rollover still invalidates "
+            "the whole generation. Read only at tier construction, only "
+            "while serving_emb is on")
 
 
 # --- observability (core/trace.py, core/monitor.py, core/logging.py) ---

@@ -11,11 +11,15 @@ fast path). Multi-server sharding: ids are routed to servers by
 
 from __future__ import annotations
 
+import json
+import os
 import threading
+import time
 
 import numpy as np
 
 from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import observe
 from paddle_tpu.core.wire import FrameClient
 from paddle_tpu.distributed.ps.server import OPS
 from paddle_tpu.native import NativeSparseTable
@@ -23,11 +27,28 @@ from paddle_tpu.native import NativeSparseTable
 __all__ = ["PSClient", "InProcClient"]
 
 
+def _write_manifest(vdir: str, table: str, version: int, shards: int,
+                    rows: int) -> None:
+    """Atomic MANIFEST.json inside a version dir — written AFTER every
+    shard file, so a manifest's presence certifies the version's
+    artifacts are complete (the publish-ordering contract the rollover
+    readers rely on)."""
+    doc = {"table": table, "version": int(version), "shards": int(shards),
+           "rows": int(rows)}
+    tmp = os.path.join(vdir, "MANIFEST.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(vdir, "MANIFEST.json"))
+
+
 class InProcClient:
     """Direct table access for single-process (tests, single-host)."""
 
     def __init__(self):
         self._tables: dict[str, NativeSparseTable] = {}
+        self._versions: dict[str, int] = {}
 
     def create_table(self, name: str, dim: int, *, optimizer="sgd",
                      lr=0.01, init_scale=0.01, seed=0) -> None:
@@ -37,6 +58,28 @@ class InProcClient:
 
     def pull(self, name, ids):
         return self._tables[name].pull(ids)
+
+    def pull_versioned(self, name, ids):
+        return self._tables[name].pull(ids), self._versions.get(name, 0)
+
+    def versions(self, server: int = 0) -> dict[str, int]:
+        return dict(self._versions)
+
+    def table_version(self, name: str) -> int:
+        return int(self._versions.get(name, 0))
+
+    def publish_version(self, name: str, root: str | None = None) -> int:
+        """Publish the table's next version: save its rows under
+        ``{root}/v{N}/`` + manifest (when ``root`` is given), then bump
+        the advertised version — same ordering contract as PSClient."""
+        v = self._versions.get(name, 0) + 1
+        if root is not None:
+            vdir = os.path.join(root, f"v{v}")
+            os.makedirs(vdir, exist_ok=True)
+            self._tables[name].save(os.path.join(vdir, name))
+            _write_manifest(vdir, name, v, 1, len(self._tables[name]))
+        self._versions[name] = v
+        return v
 
     def push_grad(self, name, ids, grads):
         self._tables[name].push_grad(ids, grads)
@@ -87,8 +130,10 @@ class InProcClient:
 # replayable PS ops: reads plus naturally idempotent mutations.
 # push_grad/push_delta are NOT here (a replayed push double-applies) and
 # neither is barrier (a replay could double-count the rendezvous).
+# publish IS: it max-merges server-side, so a replay cannot move a
+# table's version backwards (or double-bump it).
 _IDEMPOTENT = ("create", "pull", "size", "keys", "save", "load",
-               "heartbeat", "lost")
+               "heartbeat", "lost", "versions", "publish")
 
 
 class _Conn(FrameClient):
@@ -144,46 +189,107 @@ class PSClient:
         for c in self._conns:
             c.request("create", header)
 
+    @staticmethod
+    def _fanout(fn, shards) -> None:
+        """Issue per-shard requests CONCURRENTLY: each shard has its own
+        connection (one FrameClient per endpoint), so the slowest shard
+        — not the sum over shards — bounds the op's latency. A lone
+        shard runs inline (no thread tax on the common small-batch
+        case)."""
+        if len(shards) == 1:
+            fn(*shards[0])
+            return
+        threads = [threading.Thread(target=fn, args=sh, daemon=True)
+                   for sh in shards]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
     def pull(self, name: str, ids) -> np.ndarray:
+        return self._pull(name, ids)[0]
+
+    def pull_versioned(self, name: str, ids) -> tuple[np.ndarray, int]:
+        """Rows plus the highest table version stamped on the shard
+        replies — the serving tier's rollover signal rides every pull
+        for free (no extra round-trip)."""
+        return self._pull(name, ids)
+
+    def _pull(self, name: str, ids) -> tuple[np.ndarray, int]:
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        t0 = time.perf_counter()
         if self.n == 1:
             h, payload = self._conns[0].request(
                 "pull", {"name": name, "nbytes": ids.nbytes}, ids.tobytes())
-            return np.frombuffer(payload, np.float32).reshape(h["shape"])
+            observe("ps/pull_s", time.perf_counter() - t0)
+            return (np.frombuffer(payload, np.float32).reshape(h["shape"]),
+                    int(h.get("version", 0)))
         route = self._route(ids)
-        out = None
-        for s in range(self.n):
-            mask = route == s
-            if not mask.any():
-                continue
-            h, payload = self._conns[s].request(
-                "pull", {"name": name, "nbytes": ids[mask].nbytes},
-                ids[mask].tobytes())
-            rows = np.frombuffer(payload, np.float32).reshape(h["shape"])
-            if out is None:
-                out = np.empty((ids.shape[0], rows.shape[1]), np.float32)
-            out[mask] = rows
-        return out
+        shards = [(s, m) for s in range(self.n)
+                  for m in (route == s,) if m.any()]
+        out: np.ndarray | None = None
+        version = 0
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def one(s, mask):
+            nonlocal out, version
+            try:
+                sel = np.ascontiguousarray(ids[mask])
+                h, payload = self._conns[s].request(
+                    "pull", {"name": name, "nbytes": sel.nbytes},
+                    sel.tobytes())
+                rows = np.frombuffer(payload, np.float32).reshape(h["shape"])
+                with lock:
+                    if out is None:
+                        out = np.empty((ids.shape[0], rows.shape[1]),
+                                       np.float32)
+                    out[mask] = rows
+                    version = max(version, int(h.get("version", 0)))
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+        self._fanout(one, shards)
+        if errors:
+            raise errors[0]
+        observe("ps/pull_s", time.perf_counter() - t0)
+        return out, version
 
     def _push(self, op: str, name: str, ids, values) -> None:
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         values = np.ascontiguousarray(values, np.float32).reshape(
             ids.shape[0], -1)
-        route = self._route(ids) if self.n > 1 else None
-        for s in range(self.n):
-            if route is None:
-                sel_ids, sel_vals = ids, values
-            else:
-                mask = route == s
-                if not mask.any():
-                    continue
-                sel_ids, sel_vals = ids[mask], values[mask]
-            payload = sel_ids.tobytes() + sel_vals.tobytes()
-            self._conns[s].request(
-                op, {"name": name, "n": int(sel_ids.shape[0]),
+        t0 = time.perf_counter()
+        if self.n == 1:
+            payload = ids.tobytes() + values.tobytes()
+            self._conns[0].request(
+                op, {"name": name, "n": int(ids.shape[0]),
                      "nbytes": len(payload)}, payload)
-            if route is None:
-                break
+            observe("ps/push_s", time.perf_counter() - t0)
+            return
+        route = self._route(ids)
+        shards = [(s, m) for s in range(self.n)
+                  for m in (route == s,) if m.any()]
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def one(s, mask):
+            try:
+                sel_ids = np.ascontiguousarray(ids[mask])
+                sel_vals = np.ascontiguousarray(values[mask])
+                payload = sel_ids.tobytes() + sel_vals.tobytes()
+                self._conns[s].request(
+                    op, {"name": name, "n": int(sel_ids.shape[0]),
+                         "nbytes": len(payload)}, payload)
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+        self._fanout(one, shards)
+        if errors:
+            raise errors[0]
+        observe("ps/push_s", time.perf_counter() - t0)
 
     def push_grad(self, name, ids, grads):
         self._push("push_grad", name, ids, grads)
@@ -213,6 +319,32 @@ class PSClient:
             c.request("load", {"name": name,
                                "path": f"{path}.shard{i}" if self.n > 1
                                else path})
+
+    def versions(self, server: int = 0) -> dict[str, int]:
+        """Published table versions as advertised by one server (the
+        chief by default — publish broadcasts fleet-wide, so any server
+        converges to the same monotonic map)."""
+        h, _ = self._conns[server].request("versions", {})
+        return {k: int(v) for k, v in h.get("versions", {}).items()}
+
+    def table_version(self, name: str) -> int:
+        return self.versions().get(name, 0)
+
+    def publish_version(self, name: str, root: str | None = None) -> int:
+        """Publish the table's next version, geo-async style. With
+        ``root`` set, first save every shard under ``{root}/v{N}/`` and
+        write the version's MANIFEST.json — only THEN bump the version
+        on every server, so no reader ever observes a version whose
+        artifacts are incomplete. Returns the published version."""
+        v = self.table_version(name) + 1
+        if root is not None:
+            vdir = os.path.join(root, f"v{v}")
+            os.makedirs(vdir, exist_ok=True)
+            self.save(name, os.path.join(vdir, name))
+            _write_manifest(vdir, name, v, self.n, self.size(name))
+        for c in self._conns:
+            c.request("publish", {"name": name, "version": int(v)})
+        return v
 
     def barrier(self, world: int):
         """Block until ``world`` workers reach this point (role-maker

@@ -27,7 +27,7 @@ __all__ = ["ParameterServer", "HeartBeatMonitor", "OPS"]
 
 OPS = {"create": 1, "pull": 2, "push_grad": 3, "push_delta": 4, "size": 5,
        "save": 6, "load": 7, "keys": 8, "stop": 9, "barrier": 10,
-       "heartbeat": 11, "lost": 12}
+       "heartbeat": 11, "lost": 12, "versions": 13, "publish": 14}
 _OP_NAMES = {v: k for k, v in OPS.items()}
 
 
@@ -193,6 +193,13 @@ class ParameterServer(FrameService):
                  heartbeat_interval: float = 900.0, on_lost=None):
         self.registry = _TableRegistry()
         self.monitor = HeartBeatMonitor(heartbeat_interval, on_lost=on_lost)
+        # Published table versions (serving/sparse.py rollover): bumped
+        # by the "publish" op AFTER the trainer has saved the version's
+        # shard files + manifest, so a reader that sees version N can
+        # always resolve N's artifacts. Monotonic per table (publish is
+        # a max-merge — replays and races can only move forward).
+        self._versions: dict[str, int] = {}
+        self._vlock = threading.Lock()
         super().__init__(host, port)
 
     def start(self) -> "ParameterServer":
@@ -237,13 +244,28 @@ class ParameterServer(FrameService):
             if name == "lost":
                 send_frame(sock, 0, self.monitor.status())
                 return True
+            if name == "versions":
+                with self._vlock:
+                    send_frame(sock, 0, {"versions": dict(self._versions)})
+                return True
+            if name == "publish":
+                with self._vlock:
+                    v = max(self._versions.get(header["name"], 0),
+                            int(header["version"]))
+                    self._versions[header["name"]] = v
+                stat_add("ps/publishes")
+                send_frame(sock, 0, {"version": v})
+                return True
 
             table = self.registry.get(header["name"])
             if name == "pull":
                 ids = np.frombuffer(payload, np.int64)
                 rows = table.pull(ids)
+                with self._vlock:
+                    v = self._versions.get(header["name"], 0)
                 send_frame(sock, 0, {"nbytes": rows.nbytes,
-                                     "shape": list(rows.shape)},
+                                     "shape": list(rows.shape),
+                                     "version": v},
                            rows.tobytes())
             elif name in ("push_grad", "push_delta"):
                 n = int(header["n"])

@@ -52,7 +52,8 @@ __all__ = ["InferenceServer", "InferenceClient", "ModelBusyError"]
 SERVING_OPS = {"infer": 1, "list_models": 2, "load_model": 3, "stop": 4,
                "generate_start": 5, "generate_poll": 6,
                "generate_cancel": 7, "unload_model": 8, "ledger_dump": 9,
-               "kv_put": 10, "kv_get": 11, "kv_probe": 12}
+               "kv_put": 10, "kv_get": 11, "kv_probe": 12,
+               "sched_quotas": 13}
 _OP_NAMES = {v: k for k, v in SERVING_OPS.items()}
 
 # Marker prefix for the typed busy error as it crosses the wire (the
@@ -137,6 +138,12 @@ class InferenceServer(FrameService):
         # per-server coalescer; consulted only when FLAGS_serving_batch_max
         # enables batching (one flag read per infer otherwise)
         self._batcher = DynamicBatcher(tenant_book=self._ledger_infer)
+        # PS-backed embedding serving (FLAGS_serving_emb, read at
+        # construction ONLY): hard-off leaves attach_embeddings a no-op
+        # and every serving path byte-identical — the health tick's
+        # rollover hook below is an is-None check, nothing more
+        self._emb_enabled = bool(flag("serving_emb"))
+        self._emb_tier = None
         for name, m in (models or {}).items():
             self.add_model(name, m)
         if admin_ops is None:
@@ -240,6 +247,23 @@ class InferenceServer(FrameService):
                            "add_generator; FLAGS_gen_slots enables)")
         return eng
 
+    def attach_embeddings(self, ps_client):
+        """Construct this replica's PS-backed embedding serving tier
+        (``FLAGS_serving_emb``; ``serving/sparse.py``) over ``ps_client``
+        and return it — callers then register
+        :class:`~paddle_tpu.serving.sparse.SparseCTRPredictor` endpoints
+        via :meth:`add_model`. With the flag off (the default) this is a
+        no-op returning None: no tier, no version polling, the serving
+        path stays byte-identical."""
+        if not self._emb_enabled:
+            return None
+        from paddle_tpu.serving.sparse import EmbeddingServingTier
+
+        tier = EmbeddingServingTier(ps_client)
+        with self._lock:
+            self._emb_tier = tier
+        return tier
+
     def _kv_store(self):
         """This replica's KV page store: the first registered engine's
         (engines sharing a replica share its store), or None with
@@ -297,6 +321,12 @@ class InferenceServer(FrameService):
         if gens:
             doc["generators"] = gens
         doc["models"] = models
+        if self._emb_tier is not None:
+            # the health tick IS the rollover tick: every prober /
+            # controller scrape gives the tier a (rate-limited) chance
+            # to notice a newly published table version and flip
+            self._emb_tier.maybe_rollover()
+            doc["emb"] = self._emb_tier.stats()
         return doc
 
     def stop(self, drain_s: float | None = None) -> None:
@@ -309,7 +339,8 @@ class InferenceServer(FrameService):
     def _dispatch(self, sock, op: int, header: dict, payload: bytes) -> bool:
         name = _OP_NAMES.get(op)
         try:
-            if (name in ("stop", "load_model", "unload_model")
+            if (name in ("stop", "load_model", "unload_model",
+                         "sched_quotas")
                     and not self._admin_ops):
                 send_frame(sock, 1, {"error": f"admin op {name!r} disabled "
                                      "on this server (admin_ops=False)"})
@@ -422,6 +453,23 @@ class InferenceServer(FrameService):
                 send_frame(sock, 0, {"match": (0 if store is None
                                                else store.probe(keys))})
                 return True
+            if name == "sched_quotas":
+                # live tenant-share reconfig (the controller's push over
+                # the control channel): applied to every engine running
+                # FLAGS_gen_sched; a replica with no scheduler answers
+                # with an empty list rather than erroring, so a fleet
+                # broadcast sweeps mixed fleets cleanly
+                quotas = header.get("quotas") or {}
+                updated = []
+                with self._lock:
+                    engines = dict(self._generators)
+                for n, e in engines.items():
+                    sched = getattr(e, "sched", None)
+                    if sched is not None and hasattr(sched, "set_quotas"):
+                        sched.set_quotas(quotas)
+                        updated.append(n)
+                send_frame(sock, 0, {"updated": sorted(updated)})
+                return True
             if name == "ledger_dump":
                 # performance-attribution dump (FLAGS_gen_ledger): each
                 # engine's finalized phase records + tenant book +
@@ -504,7 +552,8 @@ class InferenceClient(FrameClient):
                          idempotent=("infer", "list_models", "load_model",
                                      "unload_model", "generate_poll",
                                      "generate_cancel", "ledger_dump",
-                                     "kv_put", "kv_get", "kv_probe"))
+                                     "kv_put", "kv_get", "kv_probe",
+                                     "sched_quotas"))
 
     def infer(self, model: str, *inputs,
               tenant: str | None = None) -> list[np.ndarray]:
@@ -682,6 +731,16 @@ class InferenceClient(FrameClient):
         if limit is not None:
             header["limit"] = int(limit)
         return self._request("ledger_dump", header)[0]
+
+    def sched_quotas(self, quotas: dict[str, float]) -> list[str]:
+        """Push a live tenant-share map to the replica's schedulers
+        (``FLAGS_gen_sched``; satellite of the controller's
+        ``set_quotas`` broadcast). Returns the generator names whose
+        scheduler applied it — empty on replicas running without the
+        scheduler (idempotent: sets-to-value, safe to retry)."""
+        q = {str(k): float(v) for k, v in (quotas or {}).items()}
+        return self._request("sched_quotas",
+                             {"quotas": q})[0]["updated"]
 
     def load_model(self, name: str, path: str) -> None:
         self._request("load_model", {"name": name, "path": path})

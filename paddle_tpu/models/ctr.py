@@ -1,0 +1,45 @@
+"""CTR dense tower: the tiny on-device half of a PS-backed recommender.
+
+Reference role: the dense scoring network of the reference's distributed
+CTR recipes (wide&deep / DeepFM-style towers over ``lookup_table``
+embeddings) — the embedding half lives in parameter-server sparse
+tables (``distributed/ps``), this is everything that runs on the chip.
+Deliberately ``init_cache``-free: it is a pure feed-forward scorer over
+pooled embedding rows, exactly the shape the embedding serving tier's
+batched sparse endpoint (``serving/sparse.py``) compiles once per batch
+bucket and reuses across coalesced requests.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu import nn
+from paddle_tpu.core.module import Module
+
+__all__ = ["CTRTower"]
+
+
+class CTRTower(Module):
+    """Pooled-embedding scorer: ``(B, emb_dim) -> (B, 1)`` logits.
+
+    Matches the shape trained by ``examples/ps_recommender.py``
+    (Linear → ReLU → Linear over sum-pooled sparse rows). ``seed``
+    makes construction deterministic — a serving replica rebuilding the
+    tower gets the same weights as its peers without shipping a
+    checkpoint (tests and benches rely on this; production would load
+    exported weights instead).
+    """
+
+    def __init__(self, emb_dim: int = 16, hidden: int = 32, *,
+                 seed: int = 0):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(int(seed)))
+        self.net = nn.Sequential(
+            nn.Linear(int(emb_dim), int(hidden), key=k1),
+            nn.ReLU(),
+            nn.Linear(int(hidden), 1, key=k2))
+        self.emb_dim = int(emb_dim)
+        self.hidden = int(hidden)
+
+    def __call__(self, pooled):
+        return self.net(pooled)

@@ -41,6 +41,7 @@ from paddle_tpu.serving.router import (
     GenerationFailed, ReplicaState, RoutedClient, StickySession,
     StreamResumeExhausted,
 )
+from paddle_tpu.serving.sparse import EmbeddingServingTier, SparseCTRPredictor
 
 __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "GenerationEngine", "Generation", "EngineOverloaded",
@@ -50,4 +51,5 @@ __all__ = ["DynamicBatcher", "RoutedClient", "ReplicaState",
            "StreamResumeExhausted", "MetricsHub", "hist_delta",
            "DeviceLayout", "RequestLedger", "GoodputMeter", "TenantBook",
            "LeaderLease", "FleetJournal", "FleetState", "FencedSpawner",
-           "StaleEpochError", "ControlService", "control_dump"]
+           "StaleEpochError", "ControlService", "control_dump",
+           "EmbeddingServingTier", "SparseCTRPredictor"]

@@ -650,6 +650,35 @@ class ServingController:
             raise ConnectionError(f"{ep} is not a member")
         return self._router._client(r)
 
+    def set_quotas(self, quotas: dict[str, float]) -> dict[str, list[str]]:
+        """Push a live tenant-share map to every healthy replica's
+        schedulers over the existing control channel (the
+        ``sched_quotas`` wire op) — quota shares reconfigure without a
+        replica restart (the PR-18 residue). Best-effort per replica:
+        unreachable or scheduler-less members are recorded, not fatal.
+        Returns ``endpoint -> generator names updated``; the push lands
+        in the decision log with the applied map as its evidence."""
+        q = {str(k): float(v) for k, v in (quotas or {}).items()}
+        applied: dict[str, list[str]] = {}
+        errors: list[str] = []
+        for m in self._router.members():
+            if not m["healthy"] or m["cordoned"]:
+                continue
+            ep = m["endpoint"]
+            try:
+                applied[ep] = self._client_for(ep).sched_quotas(q)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                errors.append(f"{ep}: {type(e).__name__}: {e}")
+        stat_add("control/quota_pushes")
+        self._record(ControlDecision(
+            action="set_quotas", ts=time.time(),
+            reason=(f"pushed tenant quotas to {len(applied)} replica(s)"
+                    + (f"; failed: {'; '.join(errors)}" if errors else "")),
+            clean=not errors,
+            signals={"quotas": q,
+                     "updated": {ep: list(g) for ep, g in applied.items()}}))
+        return applied
+
     # -- control-plane HA --------------------------------------------------
     @property
     def lease(self) -> LeaderLease | None:
@@ -1027,6 +1056,23 @@ class ServingController:
                 "fetch_degraded": kv["fetch_degraded"],
                 "timeouts": kv["timeouts"],
                 "breaker_opens": kv["breaker_opens"],
+            }
+        emb = self._hub.fleet_emb()
+        if emb is not None:
+            # sparse-serving visibility (FLAGS_serving_emb): the fleet
+            # hot-row hit rate, PS pull volume, and per-table version
+            # spread travel with every decision's evidence — stale
+            # serves or a version spread wider than one explain a tail
+            # regression as PS trouble / a propagating rollover, not
+            # capacity shortfall
+            out["emb"] = {
+                "replicas": emb["replicas"],
+                "hit_rate": emb["hit_rate"],
+                "pulled_rows": emb["pulled_rows"],
+                "pulled_bytes": emb["pulled_bytes"],
+                "stale_serves": emb["stale_serves"],
+                "rollovers": emb["rollovers"],
+                "versions": emb["versions"],
             }
         return out
 

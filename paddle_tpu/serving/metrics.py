@@ -127,7 +127,7 @@ class _EndpointSeries:
     baselines), a ring of per-tick deltas, and latest per-model gauges.
     Mutated only under the owning hub's lock."""
 
-    __slots__ = ("prev_hists", "prev_stats", "ticks", "gauges",
+    __slots__ = ("prev_hists", "prev_stats", "ticks", "gauges", "emb",
                  "last_tick")
 
     def __init__(self, slow_ticks: int):
@@ -137,6 +137,9 @@ class _EndpointSeries:
         self.ticks: deque[tuple[int, float, dict, dict]] = deque(
             maxlen=max(slow_ticks, 1))
         self.gauges: dict[str, dict[str, Any]] = {}
+        # latest embedding-tier gauge block (FLAGS_serving_emb replicas
+        # ship it in health as "emb"); None on replicas without the tier
+        self.emb: dict[str, Any] | None = None
         self.last_tick = 0
 
     def ingest(self, tick: int, ts: float, doc: dict) -> None:
@@ -163,6 +166,9 @@ class _EndpointSeries:
         if isinstance(gens, dict):
             self.gauges = {m: dict(g) for m, g in gens.items()
                            if isinstance(g, dict)}
+        emb = doc.get("emb")
+        if isinstance(emb, dict):
+            self.emb = dict(emb)
         self.ticks.append((tick, ts, h_deltas, s_deltas))
 
     def window(self, tick: int, ticks: int):
@@ -417,6 +423,48 @@ class MetricsHub:
             "fetch_degraded": counters.get("fetch_degraded", 0.0),
             "timeouts": counters.get("timeouts", 0.0),
             "breaker_opens": counters.get("breaker_opens", 0.0),
+        }
+
+    def fleet_emb(self) -> dict[str, Any] | None:
+        """Fleet embedding-serving rollup (``FLAGS_serving_emb``): every
+        replica's ``emb`` health block summed — cache hits/misses with
+        the derived fleet hit rate, pulled rows/bytes, stale serves,
+        rollovers — plus each served table's per-replica version spread
+        (``versions``: table -> sorted unique versions; more than one
+        entry means a rollover is still propagating).  None when no
+        replica reports the tier (flag off fleet-wide)."""
+        counters: dict[str, float] = {}
+        versions: dict[str, set] = {}
+        replicas = 0
+        with self._lock:
+            for s in self._series.values():
+                emb = s.emb
+                if not isinstance(emb, dict):
+                    continue
+                replicas += 1
+                for k, v in emb.items():
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        counters[k] = counters.get(k, 0.0) + float(v)
+                tables = emb.get("tables")
+                if isinstance(tables, dict):
+                    for name, t in tables.items():
+                        if isinstance(t, dict) and "version" in t:
+                            versions.setdefault(str(name), set()).add(
+                                int(t["version"]))
+        if replicas == 0:
+            return None
+        hits = counters.get("hits", 0.0)
+        lookups = hits + counters.get("misses", 0.0)
+        return {
+            "replicas": replicas,
+            "counters": counters,
+            "hit_rate": hits / lookups if lookups > 0 else 0.0,
+            "pulled_rows": counters.get("pulled_rows", 0.0),
+            "pulled_bytes": counters.get("pulled_bytes", 0.0),
+            "stale_serves": counters.get("stale_serves", 0.0),
+            "rollovers": counters.get("rollovers", 0.0),
+            "versions": {n: sorted(vs) for n, vs in versions.items()},
         }
 
     def endpoints(self) -> list[str]:

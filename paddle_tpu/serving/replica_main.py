@@ -144,6 +144,29 @@ def main(argv: list[str] | None = None) -> int:
                     help="interactive shed headroom past the queue/"
                          "inflight caps (FLAGS_gen_sched_headroom per "
                          "replica)")
+    ap.add_argument("--emb-ps", default=None, metavar="ENDPOINTS",
+                    help="comma-separated parameter-server endpoints: "
+                         "attach the embedding serving tier "
+                         "(FLAGS_serving_emb per replica) and register "
+                         "a CTR model whose sparse tables live on the "
+                         "PS fleet (tools/chaos_check.py sparse-serve)")
+    ap.add_argument("--emb-table", default="emb:16:4",
+                    metavar="NAME:DIM[:SLOTS]",
+                    help="PS table the --emb-ps CTR model looks up "
+                         "(default emb:16:4)")
+    ap.add_argument("--emb-model", default="ctr",
+                    help="model name the --emb-ps predictor serves "
+                         "under (default ctr)")
+    ap.add_argument("--emb-seed", type=int, default=0,
+                    help="dense-tower seed for --emb-ps (same seed => "
+                         "byte-identical tower on every replica)")
+    ap.add_argument("--emb-cache-rows", type=int, default=None,
+                    help="hot-row cache capacity per table "
+                         "(FLAGS_serving_emb_cache_rows per replica)")
+    ap.add_argument("--emb-ttl-s", type=float, default=None,
+                    help="hot-row TTL within a table version "
+                         "(FLAGS_serving_emb_ttl_s per replica; <=0 "
+                         "never expires)")
     args = ap.parse_args(argv)
 
     if args.mesh_tp > 0:
@@ -182,6 +205,12 @@ def main(argv: list[str] | None = None) -> int:
         kv_flags["gen_sched_quotas"] = args.gen_sched_quotas
     if args.gen_sched_headroom is not None:
         kv_flags["gen_sched_headroom"] = args.gen_sched_headroom
+    if args.emb_ps:
+        kv_flags["serving_emb"] = True
+        if args.emb_cache_rows is not None:
+            kv_flags["serving_emb_cache_rows"] = args.emb_cache_rows
+        if args.emb_ttl_s is not None:
+            kv_flags["serving_emb_ttl_s"] = args.emb_ttl_s
     if kv_flags:
         set_flags(kv_flags)
 
@@ -224,6 +253,20 @@ def main(argv: list[str] | None = None) -> int:
                           mesh_tp=args.mesh_tp,
                           kv_store=(True if args.kv_store else None),
                           role=args.role)
+    if args.emb_ps:
+        from paddle_tpu.distributed.ps.client import PSClient
+        from paddle_tpu.serving.sparse import SparseCTRPredictor
+
+        spec = args.emb_table.split(":")
+        tname = spec[0]
+        dim = int(spec[1]) if len(spec) > 1 else 16
+        slots = int(spec[2]) if len(spec) > 2 else 4
+        ps = PSClient([e.strip() for e in args.emb_ps.split(",")
+                       if e.strip()])
+        tier = srv.attach_embeddings(ps)
+        srv.add_model(args.emb_model,
+                      SparseCTRPredictor(tier, tname, slots,
+                                         emb_dim=dim, seed=args.emb_seed))
     srv.start()
     print(f"ENDPOINT {srv.endpoint}", flush=True)
     # after ENDPOINT (the line SubprocessSpawner blocks on): lets an

@@ -58,7 +58,7 @@ import time
 from typing import Any
 
 from paddle_tpu.core.flags import flag
-from paddle_tpu.core.monitor import observe
+from paddle_tpu.core.monitor import observe, stat_add
 
 __all__ = ["GenScheduler", "IterationPlan", "INTERACTIVE", "BATCH",
            "BEST_EFFORT", "CLASSES", "classify"]
@@ -206,6 +206,28 @@ class GenScheduler:
     def attach_book(self, book) -> None:
         with self._lock:
             self._book = book
+
+    def set_quotas(self, quotas) -> dict[str, float]:
+        """Live quota reconfig (the controller's ``sched_quotas`` push):
+        replace the tenant share map without a replica restart. Accepts
+        a mapping or the flag's ``'alice=2,bob=1'`` string; non-positive
+        shares are dropped (same hygiene as construction parsing).
+        Returns the shares now in force."""
+        if isinstance(quotas, str):
+            q = self._parse_quotas(quotas)
+        else:
+            q = {}
+            for name, share in (quotas or {}).items():
+                try:
+                    share = float(share)
+                except (TypeError, ValueError):
+                    continue
+                if str(name).strip() and share > 0:
+                    q[str(name).strip()] = share
+        with self._lock:
+            self._quotas = q
+        stat_add("gen/sched/quota_reconfigs")
+        return dict(q)
 
     # -- classification / fair-queue tagging -------------------------------
     classify = staticmethod(classify)

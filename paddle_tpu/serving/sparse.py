@@ -1,0 +1,346 @@
+"""PS-backed sparse embedding serving (``FLAGS_serving_emb``).
+
+Reference role: the inference half of the reference's distributed
+``lookup_table`` stack — CTR/recommender models whose embedding tables
+are too big for one host keep them on the parameter-server fleet
+(``distributed/ps``), and inference replicas pull rows on demand. The
+workload class is the PS stack's reason to exist: tiny dense compute,
+huge sparse state, extreme QPS.
+
+Three pieces:
+
+- :class:`EmbeddingServingTier` — per-table hot-row LRU
+  (``FLAGS_serving_emb_cache_rows`` capacity, ``FLAGS_serving_emb_ttl_s``
+  row TTL) over ``PSClient.pull``; misses are batched and de-duplicated
+  so a coalesced request pays ONE pull. Rows are stamped with the
+  table's published **version** via generation snapshots: each version
+  owns its own cache (:class:`_TableGen`), a lookup resolves entirely
+  against the generation it grabbed, and a rollover swaps the whole
+  generation atomically — no response ever mixes rows of two versions.
+- :class:`SparseCTRPredictor` — a DynamicBatcher-compatible endpoint
+  (symbolic batch axis) running one de-duplicated lookup + one compiled
+  dense-tower step per coalesced batch, and emitting a version column
+  alongside the scores so every wire response row is traceable to
+  exactly one table version.
+- **Online version rollover** — the trainer publishes a new version
+  (``PSClient.publish_version``: versioned save dirs + MANIFEST.json
+  written BEFORE the version bump, geo-async style); serving replicas
+  notice on the existing health tick (:meth:`maybe_rollover`, rate-
+  limited internally) or on the version stamped into any pull reply,
+  and flip generations in place — in-flight requests finish on the old
+  generation, nothing restarts, nothing drops
+  (``serving/emb/rollovers``).
+
+Resilience: a PS pull failure serves TTL-expired cached rows as a
+last-resort fallback (``serving/emb/stale_serves`` — zero in a healthy
+fleet, which ``chaos_check.py sparse-serve`` pins); ids with no cached
+row at all re-raise the pull error.
+
+Hard-off: with ``FLAGS_serving_emb`` at the default the server never
+constructs the tier and the serving path is byte-identical (the
+``FLAGS_trace`` pattern — flags are read at construction only, hot-path
+gates are is-None checks).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core.flags import flag
+from paddle_tpu.core.monitor import stat_add
+
+__all__ = ["EmbeddingServingTier", "SparseCTRPredictor"]
+
+# Minimum seconds between published-version polls on the health tick —
+# a constant, not a flag: it bounds control-channel chatter against
+# fast probers, it is not a tuning surface.
+_ROLLOVER_POLL_MIN_S = 0.25
+
+_STAT_KEYS = ("hits", "misses", "pulled_rows", "pulled_bytes",
+              "stale_serves", "rollovers", "evictions")
+
+
+class _TableGen:
+    """One table version's generation: the version label plus the LRU
+    cache of rows pulled WHILE that version was current. Rollover swaps
+    the whole generation object atomically, so a request that snapshot
+    the old one keeps resolving against a single version — there is no
+    moment where one response mixes rows of two versions."""
+
+    __slots__ = ("version", "cache")
+
+    def __init__(self, version: int):
+        self.version = int(version)
+        # id -> (row ndarray, monotonic insert ts); OrderedDict is the
+        # LRU (move_to_end on hit, popitem(last=False) to evict)
+        self.cache: OrderedDict[int, tuple[np.ndarray, float]] = \
+            OrderedDict()
+
+
+class _TableState:
+    __slots__ = ("name", "gen", "lock", "stats")
+
+    def __init__(self, name: str, version: int = 0):
+        self.name = name
+        self.gen = _TableGen(version)
+        self.lock = threading.Lock()
+        self.stats = {k: 0 for k in _STAT_KEYS}
+
+
+class EmbeddingServingTier:
+    """Hot-row cache + version rollover between inference replicas and
+    the PS fleet.
+
+    ``client`` is a :class:`~paddle_tpu.distributed.ps.client.PSClient`
+    (or ``InProcClient``) with the serving tables already created/loaded
+    server-side. ``cache_rows``/``ttl_s`` default to their flags — read
+    HERE, at construction, only.
+    """
+
+    def __init__(self, client, *, cache_rows: int | None = None,
+                 ttl_s: float | None = None):
+        self._client = client
+        self._cap = max(int(flag("serving_emb_cache_rows")
+                            if cache_rows is None else cache_rows), 1)
+        self._ttl = float(flag("serving_emb_ttl_s")
+                          if ttl_s is None else ttl_s)
+        self._lock = threading.Lock()
+        self._tables: dict[str, _TableState] = {}
+        self._poll_lock = threading.Lock()
+        self._last_poll = 0.0
+
+    # -- lookup (the hot path) ---------------------------------------------
+    def lookup(self, table: str, ids) -> tuple[np.ndarray, int]:
+        """Resolve ``ids`` (any shape, int64) to embedding rows of shape
+        ``ids.shape + (dim,)``, every row from ONE table version (the
+        returned int). Cache misses are de-duplicated into one batched
+        PS pull; a pull whose reply is stamped with a NEWER published
+        version flips the generation and re-resolves the whole request
+        there, so the single-version guarantee survives a rollover
+        landing mid-request (converges in one retry per flip — versions
+        are monotonic)."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        st = self._table(table)
+        while True:
+            with st.lock:
+                gen = st.gen
+            out = self._resolve(st, gen, flat)
+            if out is not None:
+                rows = out
+                return (rows.reshape(ids.shape + (rows.shape[-1],)),
+                        gen.version)
+            # _resolve flipped to a newer published generation while
+            # pulling; loop re-resolves everything at the new version
+
+    def _table(self, name: str) -> _TableState:
+        with self._lock:
+            st = self._tables.get(name)
+            if st is None:
+                st = self._tables[name] = _TableState(name)
+            return st
+
+    def _resolve(self, st: _TableState, gen: _TableGen,
+                 flat: np.ndarray) -> np.ndarray | None:
+        """One attempt to resolve ``flat`` entirely against ``gen``.
+        Returns the (n, dim) rows, or None when a newer version was
+        discovered mid-pull (the caller re-resolves)."""
+        now = time.monotonic()
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows_by_id: dict[int, np.ndarray] = {}
+        missing: list[int] = []
+        with st.lock:
+            if st.gen is not gen:
+                return None          # raced a rollover before starting
+            for i in uniq.tolist():
+                e = gen.cache.get(i)
+                if e is not None and (self._ttl <= 0
+                                      or now - e[1] <= self._ttl):
+                    gen.cache.move_to_end(i)
+                    rows_by_id[i] = e[0]
+                else:
+                    missing.append(i)
+            st.stats["hits"] += len(rows_by_id)
+            st.stats["misses"] += len(missing)
+        if missing:
+            marr = np.asarray(missing, np.int64)
+            try:
+                pulled, pver = self._pull(st.name, marr)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                pulled = self._stale_fallback(st, gen, marr, e)
+            else:
+                with st.lock:
+                    st.stats["pulled_rows"] += int(marr.shape[0])
+                    st.stats["pulled_bytes"] += int(pulled.nbytes)
+                if pver > gen.version:
+                    # the trainer published while we pulled: these rows
+                    # are already the NEW version's — flip, seed them,
+                    # and re-resolve the request there
+                    self._flip(st, pver, seed=(marr, pulled))
+                    return None
+                self._insert(st, gen, marr, pulled, now)
+            for i, r in zip(missing, pulled):
+                rows_by_id[i] = np.asarray(r, np.float32)
+        if not uniq.size:
+            return np.zeros((0, 0), np.float32)
+        uniq_rows = np.stack([rows_by_id[i] for i in uniq.tolist()])
+        return uniq_rows[inverse]
+
+    def _pull(self, name: str, ids: np.ndarray) -> tuple[np.ndarray, int]:
+        pv = getattr(self._client, "pull_versioned", None)
+        if pv is not None:
+            rows, version = pv(name, ids)
+        else:                        # duck-typed clients without versions
+            rows, version = self._client.pull(name, ids), 0
+        return np.asarray(rows, np.float32), int(version)
+
+    def _insert(self, st: _TableState, gen: _TableGen, ids: np.ndarray,
+                rows: np.ndarray, now: float) -> None:
+        with st.lock:
+            if st.gen is not gen:
+                return               # rolled over meanwhile: drop, the
+            #                          next request re-pulls at the new gen
+            for i, r in zip(ids.tolist(), rows):
+                gen.cache[i] = (np.array(r, np.float32), now)
+                gen.cache.move_to_end(i)
+            while len(gen.cache) > self._cap:
+                gen.cache.popitem(last=False)
+                st.stats["evictions"] += 1
+
+    def _stale_fallback(self, st: _TableState, gen: _TableGen,
+                        ids: np.ndarray, err: BaseException) -> np.ndarray:
+        """PS unreachable: serve TTL-expired cached rows rather than
+        fail requests whose rows we still hold (counted
+        ``serving/emb/stale_serves`` — zero in a healthy fleet). An id
+        with no cached row at all re-raises the pull error."""
+        out = []
+        with st.lock:
+            if st.gen is not gen:
+                raise err
+            for i in ids.tolist():
+                e = gen.cache.get(i)
+                if e is None:
+                    raise err
+                out.append(e[0])
+            st.stats["stale_serves"] += len(out)
+        stat_add("serving/emb/stale_serves", len(out))
+        return np.stack(out)
+
+    # -- version rollover ---------------------------------------------------
+    def _flip(self, st: _TableState, version: int, seed=None) -> None:
+        now = time.monotonic()
+        with st.lock:
+            if st.gen.version >= version:
+                return               # publish is monotonic; never go back
+            new = _TableGen(version)
+            if seed is not None:
+                ids, rows = seed
+                for i, r in zip(ids.tolist(),
+                                np.asarray(rows, np.float32)):
+                    new.cache[i] = (np.array(r, np.float32), now)
+            st.gen = new
+            st.stats["rollovers"] += 1
+        stat_add("serving/emb/rollovers")
+
+    def maybe_rollover(self) -> dict[str, int] | None:
+        """Poll the PS's published-version map and flip any table whose
+        generation is behind. Driven by the server's health tick (the
+        router-prober / controller scrape cadence), rate-limited
+        internally to ``_ROLLOVER_POLL_MIN_S`` so fast probers cost
+        nothing extra. Returns the version map consulted, or None when
+        rate-limited or the PS was unreachable (best-effort — the next
+        tick, or any pull reply, catches the flip)."""
+        now = time.monotonic()
+        with self._poll_lock:
+            if now - self._last_poll < _ROLLOVER_POLL_MIN_S:
+                return None
+            self._last_poll = now
+        try:
+            versions = self._client.versions()
+        except (ConnectionError, TimeoutError, OSError, RuntimeError):
+            return None
+        for name, v in versions.items():
+            with self._lock:
+                st = self._tables.get(name)
+            if st is not None and int(v) > st.gen.version:
+                self._flip(st, int(v))
+        return versions
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        """Per-table + rolled-up counters (the ``emb`` health block):
+        hits/misses/hit_rate, pulled rows/bytes, stale serves,
+        rollovers, evictions, and each table's live version +
+        cached-row count."""
+        with self._lock:
+            tables = dict(self._tables)
+        out: dict[str, Any] = {"tables": {}}
+        total = {k: 0 for k in _STAT_KEYS}
+        for name, st in tables.items():
+            with st.lock:
+                d: dict[str, Any] = dict(st.stats)
+                d["version"] = st.gen.version
+                d["cached_rows"] = len(st.gen.cache)
+            seen = d["hits"] + d["misses"]
+            d["hit_rate"] = d["hits"] / seen if seen else 0.0
+            out["tables"][name] = d
+            for k in total:
+                total[k] += d[k]
+        out.update(total)
+        seen = total["hits"] + total["misses"]
+        out["hit_rate"] = total["hits"] / seen if seen else 0.0
+        return out
+
+
+class SparseCTRPredictor:
+    """DynamicBatcher-compatible sparse CTR endpoint: one de-duplicated
+    PS lookup + one compiled dense-tower step per (coalesced) batch.
+
+    Input: one ``(B, slots)`` int64 array of per-example sparse feature
+    ids. Outputs: ``(B, 1)`` float32 scores AND a ``(B, 1)`` int64
+    version column stamping the exact table version every row resolved
+    at — the wire response itself carries the rollover traceability.
+    The batch axis is symbolic (``supports_batching``), so concurrent
+    requests coalesce server-side into one lookup + one tower step;
+    the batcher's zero-padding rows (id 0) score harmlessly and are
+    sliced away before replies. Slot embeddings are sum-pooled in
+    numpy, so the jitted tower only ever sees ``(B, emb_dim)`` — XLA
+    recompiles stay bounded by the batcher's power-of-two buckets.
+    """
+
+    supports_batching = True
+
+    def __init__(self, tier: EmbeddingServingTier, table: str,
+                 slots: int, tower=None, *, emb_dim: int = 16,
+                 seed: int = 0):
+        import jax
+
+        from paddle_tpu.models.ctr import CTRTower
+
+        self._tier = tier
+        self._table = str(table)
+        self._slots = int(slots)
+        self._tower = (CTRTower(emb_dim=emb_dim, seed=seed)
+                       if tower is None else tower)
+        self._step = jax.jit(lambda m, pooled: m(pooled))
+        self.input_specs = [{"shape": [None, self._slots],
+                             "dtype": "int64"}]
+        self.output_specs = [{"shape": [None, 1], "dtype": "float32"},
+                             {"shape": [None, 1], "dtype": "int64"}]
+        # warm-tier residency signal for the control plane's LRU: the
+        # hot-row cache's worst-case footprint
+        self.resident_bytes = int(tier._cap) * int(emb_dim) * 4
+
+    def run(self, ids) -> list[np.ndarray]:
+        ids = np.ascontiguousarray(ids, np.int64)
+        rows, version = self._tier.lookup(self._table, ids)  # (B, S, D)
+        pooled = rows.sum(axis=1)
+        scores = np.asarray(self._step(self._tower, pooled),
+                            np.float32).reshape(-1, 1)
+        ver = np.full((scores.shape[0], 1), int(version), np.int64)
+        return [scores, ver]

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Sparse embedding serving benchmark: batched CTR inference over a
+real PS fleet (``FLAGS_serving_emb``), on the wire, on CPU.
+
+Two measurements:
+
+1. **Hot-row QPS** — concurrency-16 clients stream zipfian-distributed
+   sparse ids (the CTR serving distribution: a small hot set dominates)
+   at a ``SparseCTRPredictor`` behind the DynamicBatcher, with the
+   embedding table on a TCP ``ParameterServer``. Reports requests/sec
+   and examples/sec; the acceptance floor is a **hot-row cache hit rate
+   >= 0.9** — below that the tier would be hammering the PS fleet per
+   request, which is exactly what the cache exists to prevent.
+2. **Rollover under load** — the same fleet keeps serving while the
+   trainer publishes a new table version. The run asserts **zero
+   dropped/failed requests**, **every response stamped with exactly one
+   version** (the version column is constant within each response),
+   both versions actually observed (old in-flight requests finish on
+   the old generation), exactly one rollover counted, and zero stale
+   serves (the PS stayed healthy).
+
+Writes ``BENCH_sparse.json`` (repo root by default). The headline
+``parsed`` metric is the concurrency-16 QPS.
+
+Usage: ``JAX_PLATFORMS=cpu python tools/bench_sparse.py [-o OUT.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.core.flags import set_flags                  # noqa: E402
+from paddle_tpu.distributed.ps import ParameterServer, PSClient  # noqa: E402
+from paddle_tpu.io.serving import InferenceClient, InferenceServer  # noqa: E402
+from paddle_tpu.serving.sparse import SparseCTRPredictor     # noqa: E402
+
+VOCAB = 50_000          # id space on the PS fleet
+ZIPF_A = 1.3            # zipfian skew of the request stream
+CACHE_ROWS = 4096       # the FLAGS_serving_emb_cache_rows default
+DIM, SLOTS, BATCH = 16, 4, 8
+CONC = 16
+
+
+def _zipf_ids(rs: np.random.RandomState, n: int) -> np.ndarray:
+    """(n, SLOTS) zipfian ids clipped into the table's id space."""
+    return np.minimum(rs.zipf(ZIPF_A, size=(n, SLOTS)),
+                      VOCAB - 1).astype(np.int64)
+
+
+def _concurrent(n: int, fn) -> list:
+    gate = threading.Barrier(n)
+    errs: list = []
+
+    def run(i):
+        try:
+            gate.wait()
+            fn(i)
+        except Exception as e:
+            errs.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errs
+
+
+def bench_qps(endpoint: str, n_per: int, reps: int) -> dict:
+    """Concurrency-16 zipfian stream -> median requests/sec."""
+    clients = [InferenceClient(endpoint) for _ in range(CONC)]
+    streams = [_zipf_ids(np.random.RandomState(100 + i), n_per * BATCH)
+               .reshape(n_per, BATCH, SLOTS) for i in range(CONC)]
+
+    def warm(i):
+        for j in range(3):
+            clients[i].infer("ctr", streams[i][j])
+
+    errs = _concurrent(CONC, warm)
+    assert not errs, errs
+
+    rps = []
+    for _ in range(reps):
+        t0 = [0.0]
+        gate = threading.Barrier(CONC + 1)
+
+        def timed(i):
+            gate.wait()
+            for j in range(n_per):
+                clients[i].infer("ctr", streams[i][j])
+
+        threads = [threading.Thread(target=timed, args=(i,))
+                   for i in range(CONC)]
+        for t in threads:
+            t.start()
+        gate.wait()
+        t0[0] = time.perf_counter()
+        for t in threads:
+            t.join()
+        rps.append(CONC * n_per / (time.perf_counter() - t0[0]))
+    for c in clients:
+        c.close()
+    med = statistics.median(rps)
+    return {"concurrency": CONC, "requests_per_rep": CONC * n_per,
+            "batch_per_request": BATCH,
+            "qps": round(med, 1),
+            "examples_per_s": round(med * BATCH, 1),
+            "qps_all": [round(v, 1) for v in rps]}
+
+
+def bench_rollover(endpoint: str, srv: InferenceServer,
+                   trainer: PSClient, seconds: float) -> dict:
+    """Publish a new version mid-load; assert nothing drops or mixes."""
+    stop = threading.Event()
+    errs: list = []
+    mixed: list = []
+    seen: dict[int, int] = {}
+    lock = threading.Lock()
+    rs = np.random.RandomState(7)
+    q = _zipf_ids(rs, BATCH)
+
+    def hammer(i):
+        cli = InferenceClient(endpoint)
+        try:
+            while not stop.is_set():
+                scores, ver = cli.infer("ctr", q)
+                v = int(ver[0, 0])
+                with lock:
+                    seen[v] = seen.get(v, 0) + 1
+                    if not (ver == v).all():
+                        mixed.append(ver.tolist())
+        except Exception as e:
+            errs.append(f"{type(e).__name__}: {e}")
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(CONC // 2)]
+    for t in threads:
+        t.start()
+    time.sleep(seconds / 3)
+    published = trainer.publish_version("emb")
+    deadline = time.monotonic() + 10.0
+    emb = {}
+    while time.monotonic() < deadline:           # health tick = flip
+        emb = srv.health().get("emb", {})
+        if emb.get("tables", {}).get("emb", {}).get("version") \
+                == published:
+            break
+        time.sleep(0.05)
+    time.sleep(seconds / 3)                      # serve a while on v1
+    stop.set()
+    for t in threads:
+        t.join()
+    total = sum(seen.values())
+    ok = (not errs and not mixed and len(seen) == 2
+          and emb.get("rollovers") == 1 and emb.get("stale_serves") == 0)
+    return {"published_version": published,
+            "requests": total,
+            "dropped": len(errs),
+            "mixed_version_responses": len(mixed),
+            "responses_by_version": {str(k): v
+                                     for k, v in sorted(seen.items())},
+            "rollovers": emb.get("rollovers"),
+            "stale_serves": emb.get("stale_serves"),
+            "ok": ok,
+            "errors": errs[:3]}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_sparse.json"))
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions (median reported)")
+    ap.add_argument("--n-per", type=int, default=40,
+                    help="requests per client per rep")
+    ap.add_argument("--rollover-s", type=float, default=3.0,
+                    help="total rollover-under-load duration")
+    args = ap.parse_args()
+
+    results: dict = {
+        "model": f"SparseCTR dim={DIM} slots={SLOTS} over TCP PS "
+                 f"(vocab {VOCAB}, zipf a={ZIPF_A}, CPU)",
+        "serving_emb_cache_rows": CACHE_ROWS,
+        "reps": args.reps,
+    }
+    set_flags({"serving_emb": True,
+               "serving_emb_cache_rows": CACHE_ROWS,
+               "serving_batch_max": 32,
+               "serving_batch_timeout_s": 0.0005,
+               "serving_batch_min_queue": 0})
+    ps_srv = ParameterServer().start()
+    srv = InferenceServer({})
+    try:
+        trainer = PSClient(ps_srv.endpoint)
+        trainer.create_table("emb", DIM, optimizer="sgd", lr=0.5, seed=3)
+        tier = srv.attach_embeddings(PSClient(ps_srv.endpoint))
+        srv.add_model("ctr", SparseCTRPredictor(tier, "emb", SLOTS,
+                                                emb_dim=DIM, seed=0))
+        srv.start()
+
+        results["hot_qps"] = bench_qps(srv.endpoint, args.n_per,
+                                       args.reps)
+        emb = srv.health()["emb"]
+        hit_rate = emb["hit_rate"]
+        results["hot_qps"]["hit_rate"] = round(hit_rate, 4)
+        results["hot_qps"]["pulled_rows"] = emb["pulled_rows"]
+        results["hot_qps"]["hit_rate_floor"] = 0.9
+        results["hot_qps"]["hit_rate_ok"] = hit_rate >= 0.9
+        print(f"conc={CONC}  qps={results['hot_qps']['qps']:.1f}  "
+              f"examples/s={results['hot_qps']['examples_per_s']:.1f}  "
+              f"hit_rate={hit_rate:.4f}")
+
+        results["rollover"] = bench_rollover(srv.endpoint, srv, trainer,
+                                             args.rollover_s)
+        r = results["rollover"]
+        print(f"rollover: {r['requests']} requests, "
+              f"{r['dropped']} dropped, "
+              f"{r['mixed_version_responses']} mixed, "
+              f"by version {r['responses_by_version']}, ok={r['ok']}")
+        trainer.close()
+    finally:
+        srv.stop()
+        ps_srv.stop()
+        set_flags({"serving_emb": False, "serving_emb_cache_rows": 4096,
+                   "serving_batch_max": 0,
+                   "serving_batch_timeout_s": 0.005,
+                   "serving_batch_min_queue": 2})
+
+    results["parsed"] = {
+        "metric": f"sparse CTR serving QPS (concurrency {CONC}, "
+                  "zipfian stream, hot-row cache, CPU wire round-trips)",
+        "value": results["hot_qps"]["qps"],
+        "unit": "req/s",
+    }
+    ok = (results["hot_qps"]["hit_rate_ok"] and results["rollover"]["ok"])
+    results["ok"] = ok
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"wrote {args.out}  ok={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,7 +98,13 @@ Scenarios (all CPU-only, single process):
     lifecycle check and a no-hot-path-flag-reads defaults check.
     ``--campaign N [--seed S]`` runs an N-scenario campaign standalone
     (defaults checks + campaign only).
-16. **control-ha**: the ACTIVE controller of an HA pair dies silently
+16. **sparse-serve**: a PS-backed sparse-serving replica is SIGKILLed
+    mid-version-rollover under routed load (two ``--emb-ps`` subprocess
+    replicas over one PS fleet; the trainer publishes v1 right before
+    the kill) — zero requests dropped (idempotent infers fail over),
+    zero responses mixing two versions' rows, the survivor converges
+    to the published version on its health tick, zero stale serves.
+17. **control-ha**: the ACTIVE controller of an HA pair dies silently
     mid-flight (its last acts: a journaled-but-unfinished sticky drain
     and a spawn intent that never reported an endpoint) while a
     subprocess replica holds a LIVE token stream — the standby holds
@@ -337,6 +343,19 @@ def check_defaults_off() -> None:
           and sc["gen_sched_chunk"] > 0
           and sc["gen_sched_headroom"] >= 0,
           str(sc))
+    se = get_flags(["serving_emb", "serving_emb_cache_rows",
+                    "serving_emb_ttl_s"])
+    # behavior at defaults: attach_embeddings is a None no-op — the
+    # server constructs NO tier, polls no versions, ships no "emb"
+    # health block (the flag is read once, at server construction)
+    _srv = io.InferenceServer({})
+    check("defaults/serving_emb_off",
+          not se["serving_emb"]
+          and se["serving_emb_cache_rows"] > 0    # sane when opted in
+          and se["serving_emb_ttl_s"] == 0.0      # no TTL by default
+          and _srv.attach_embeddings(None) is None
+          and _srv._emb_tier is None,
+          str(se))
 
 
 def scenario_serving_wire(tmp: str) -> None:
@@ -2060,6 +2079,96 @@ def run_campaign(n: int, seed: int, tmp: str) -> None:
           f"err={err} ctor_reads={len(ctor)} hot_reads={hot}")
 
 
+def scenario_sparse_serve(tmp: str) -> None:
+    """SIGKILL a sparse-serving replica mid-version-rollover under
+    routed load: two subprocess replicas (``--emb-ps``) serve a CTR
+    endpoint over one PS fleet; the trainer publishes v1 and one
+    replica is SIGKILLed before it can flip — zero requests are
+    dropped (the router fails idempotent infers over), no response
+    ever mixes rows of two versions, the survivor converges to the
+    published version on its health tick, and zero stale serves
+    happen (the PS fleet stayed healthy throughout)."""
+    import threading
+    import time
+
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+    from paddle_tpu.serving import RoutedClient, SubprocessSpawner
+
+    monitor.reset_stats("serving/router/")
+    ps_srv = ParameterServer().start()
+    ps = PSClient(ps_srv.endpoint)
+    rc = None
+    spawner = SubprocessSpawner(extra_args=(
+        "--emb-ps", ps_srv.endpoint, "--emb-table", "emb:8:3"))
+    try:
+        ps.create_table("emb", 8, optimizer="sgd", lr=0.5, seed=3)
+        eps = [spawner.spawn() for _ in range(2)]
+        rc = RoutedClient(eps, probe_interval_s=0.25, timeout=10.0)
+        q = np.arange(12, dtype=np.int64).reshape(4, 3)
+        stop = threading.Event()
+        errors: list = []
+        mixed: list = []
+        seen: set = set()
+        n_ok = [0]
+        lock = threading.Lock()
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    scores, ver = rc.infer("ctr", q)
+                    v = int(ver[0, 0])
+                    with lock:
+                        n_ok[0] += 1
+                        seen.add(v)
+                        if not (ver == v).all():
+                            mixed.append(ver.tolist())
+            except Exception as e:
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)                    # serve a while at v0
+        ps.publish_version("emb")          # the trainer's push...
+        spawner.kill(eps[0])               # ...and a replica dies mid-
+        survivor = eps[1]                  # rollover, before it flips
+        emb = {}
+        deadline = time.monotonic() + 10.0
+        with io.InferenceClient(survivor, timeout=5.0) as c:
+            while time.monotonic() < deadline:
+                emb = c.health().get("emb", {})   # health tick = flip
+                if emb.get("tables", {}).get("emb", {}) \
+                        .get("version") == 1:
+                    break
+                time.sleep(0.1)
+        time.sleep(0.4)                    # serve a while at v1
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        check("sparse/zero_dropped_requests",
+              not errors and n_ok[0] > 10,
+              f"errors={errors[:2]} n={n_ok[0]}")
+        check("sparse/failover_fired",
+              monitor.get_stat("serving/router/failovers") >= 1,
+              str(monitor.export_stats("serving/router/")))
+        check("sparse/zero_mixed_version_responses", not mixed,
+              str(mixed[:2]))
+        check("sparse/versions_converged",
+              seen == {0, 1}
+              and emb.get("tables", {}).get("emb", {}).get("version") == 1
+              and emb.get("rollovers") == 1,
+              f"seen={seen} emb={emb}")
+        check("sparse/zero_stale_serves",
+              emb.get("stale_serves", -1) == 0, str(emb))
+    finally:
+        if rc is not None:
+            rc.close()
+        for ep in list(spawner.procs):
+            spawner.kill(ep)
+        ps.close()
+        ps_srv.stop()
+
+
 def scenario_kv_campaign(tmp: str) -> None:
     """A small fixed slice of the randomized KV chaos campaign (see
     ``run_campaign``): 5 scenarios at seed 0, plus the deterministic
@@ -2094,6 +2203,7 @@ SCENARIOS = (scenario_serving_wire, scenario_checkpoint,
              scenario_gen_disagg,
              scenario_gen_hotloop,
              scenario_gen_sched,
+             scenario_sparse_serve,
              scenario_kv_campaign)
 
 

@@ -164,6 +164,42 @@ def kv_rollup(docs: list[dict]) -> dict | None:
     }
 
 
+def emb_rollup(docs: list[dict]) -> dict | None:
+    """Merge replica ``emb`` health blocks (the PS-backed sparse
+    embedding serving tier, ``FLAGS_serving_emb``) into the fleet
+    scoreboard: hot-row hit rate over all lookups, pulled rows/bytes
+    off the PS fleet, stale serves (zero in a healthy fleet),
+    rollovers, and each table's per-replica version spread — more than
+    one version means a rollover is still propagating. None when no
+    replica runs the tier."""
+    docs = [d for d in docs if isinstance(d, dict)]
+    if not docs:
+        return None
+    counters: dict[str, float] = {}
+    versions: dict[str, set] = {}
+    for d in docs:
+        for k, v in d.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                counters[k] = counters.get(k, 0.0) + float(v)
+        for name, t in (d.get("tables") or {}).items():
+            if isinstance(t, dict) and "version" in t:
+                versions.setdefault(str(name), set()).add(
+                    int(t["version"]))
+    hits = counters.get("hits", 0.0)
+    lookups = hits + counters.get("misses", 0.0)
+    return {
+        "replicas": len(docs),
+        "hit_rate": hits / lookups if lookups > 0 else 0.0,
+        "lookups": lookups,
+        "pulled_rows": counters.get("pulled_rows", 0.0),
+        "pulled_bytes": counters.get("pulled_bytes", 0.0),
+        "stale_serves": counters.get("stale_serves", 0.0),
+        "rollovers": counters.get("rollovers", 0.0),
+        "evictions": counters.get("evictions", 0.0),
+        "versions": {n: sorted(vs) for n, vs in versions.items()},
+    }
+
+
 def sched_rollup(docs: list[dict],
                  wait_hists: dict[str, dict] | None = None) -> dict | None:
     """Merge engine ``sched`` policy blocks (the SLO-aware scheduler's
@@ -227,6 +263,7 @@ def build_report(scrapes: list[dict], *,
     tenant_docs: list[dict] = []
     kv_docs: list[dict] = []
     sched_docs: list[dict] = []
+    emb_docs: list[dict] = []
     hists: dict[str, list[dict]] = {}
     per_endpoint = []
     for s in scrapes:
@@ -243,6 +280,8 @@ def build_report(scrapes: list[dict], *,
                 kv_docs.append(g["kv"])
             if isinstance(g, dict) and isinstance(g.get("sched"), dict):
                 sched_docs.append(g["sched"])
+        if isinstance(s["health"].get("emb"), dict):
+            emb_docs.append(s["health"]["emb"])
         for name in PHASE_HISTOGRAMS + SCHED_HISTOGRAMS:
             h = (s["health"].get("histograms") or {}).get(name)
             if h and h.get("buckets"):
@@ -270,6 +309,7 @@ def build_report(scrapes: list[dict], *,
         "tenants": tenant_rollup(tenant_docs),
         "kv": kv_rollup(kv_docs),
         "sched": sched_rollup(sched_docs, merged),
+        "emb": emb_rollup(emb_docs),
     }
 
 
@@ -327,6 +367,24 @@ def render(report: dict) -> str:
         lines.append(f"  demotions {int(kv['demotions'])}  dropped "
                      f"{int(kv['dropped'])}  prefill recomputed "
                      f"{int(kv['prefill_recomputed'])} tok")
+    emb = report.get("emb")
+    if emb:
+        lines.append("")
+        spread = " ".join(
+            f"{t}={'/'.join(map(str, vs))}"
+            for t, vs in sorted(emb["versions"].items())) or "-"
+        lines.append(f"emb serving: {emb['replicas']} replica(s)  "
+                     f"table versions {spread}"
+                     + ("  [rollover propagating]"
+                        if any(len(v) > 1 for v in
+                               emb["versions"].values()) else ""))
+        lines.append(f"  hot-row hit rate {emb['hit_rate'] * 100:6.2f}%  "
+                     f"({int(emb['lookups'])} lookups, "
+                     f"{int(emb['evictions'])} evictions)")
+        lines.append(f"  pulled {int(emb['pulled_rows'])} row(s) / "
+                     f"{int(emb['pulled_bytes'])} B   rollovers "
+                     f"{int(emb['rollovers'])}   stale serves "
+                     f"{int(emb['stale_serves'])}")
     sc = report.get("sched")
     if sc:
         lines.append("")
